@@ -1,0 +1,41 @@
+"""spark_sklearn_tpu — a TPU-native framework with the capabilities of
+databricks/spark-sklearn.
+
+Instead of fanning (parameter x fold) tasks out to Spark executors over a
+broadcast dataset (reference: python/spark_sklearn/grid_search.py), this
+framework lowers the task grid onto a JAX/XLA device mesh: candidates become a
+``vmap`` axis, TPU chips a sharded mesh axis, and the dataset a replicated
+``jax.device_put`` array over ICI, with per-candidate fits re-expressed as
+jit-compiled training loops (Tier A) and a host-Python fallback preserving
+full scikit-learn generality (Tier B).
+
+Public API (mirrors the reference's __init__.py exports):
+  - GridSearchCV, RandomizedSearchCV   (reference: grid_search.py)
+  - Converter                          (reference: converter.py)
+  - KeyedEstimator, KeyedModel         (reference: keyed_models.py)
+  - gapply                             (reference: group_apply.py)
+  - CSRMatrix                          (reference: udt.py CSRVectorUDT)
+"""
+
+__version__ = "0.1.0"
+
+import spark_sklearn_tpu.models  # noqa: F401 — registers Tier-A families
+from spark_sklearn_tpu.search.grid import GridSearchCV, RandomizedSearchCV
+from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
+from spark_sklearn_tpu.convert.converter import Converter
+from spark_sklearn_tpu.keyed.keyed import KeyedEstimator, KeyedModel
+from spark_sklearn_tpu.keyed.gapply import gapply
+from spark_sklearn_tpu.sparse.csr import CSRMatrix
+
+__all__ = [
+    "GridSearchCV",
+    "RandomizedSearchCV",
+    "Converter",
+    "KeyedEstimator",
+    "KeyedModel",
+    "gapply",
+    "CSRMatrix",
+    "TpuConfig",
+    "build_mesh",
+    "__version__",
+]

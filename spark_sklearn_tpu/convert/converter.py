@@ -1,0 +1,144 @@
+"""Converter — fitted-model interchange.
+
+The reference's Converter (reference: python/spark_sklearn/converter.py)
+moves fitted models between sklearn and Spark MLlib's JVM objects via py4j,
+supporting exactly LogisticRegression{,Model} and LinearRegression{,Model},
+plus `toPandas` for Vector-column DataFrames.  The TPU rebuild has no JVM:
+the device-side representation of a fitted model is a **JAX parameter
+pytree** (SURVEY §2.3 substrate table, last row).  The Converter therefore
+maps:
+
+    sklearn fitted estimator  <->  TpuModel (family + param pytree + meta)
+
+and keeps the reference's method names as aliases (`toSKLearn`, `toTPU` in
+place of `toSpark`, `toPandas`).  Families covered (superset of the
+reference's two): LogisticRegression, LinearRegression, Ridge,
+ElasticNet/Lasso.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from spark_sklearn_tpu.models.base import resolve_family
+
+
+class TpuModel:
+    """A fitted model as the device representation: (family, pytree, meta).
+
+    `predict`/`decision_function` run the family's compiled functions — this
+    is what KeyedModel stores per key and what multi-chip inference shards.
+    """
+
+    def __init__(self, family, model: Dict[str, Any], static: Dict[str, Any],
+                 meta: Dict[str, Any]):
+        self.family = family
+        self.model = model
+        self.static = static
+        self.meta = meta
+
+    def predict(self, X):
+        import jax.numpy as jnp
+        X = jnp.asarray(np.asarray(X))
+        pred = self.family.predict(self.model, self.static, X, self.meta)
+        pred = np.asarray(pred)
+        if self.family.is_classifier:
+            return self.meta["classes"][pred]
+        return pred
+
+    def decision_function(self, X):
+        import jax.numpy as jnp
+        X = jnp.asarray(np.asarray(X))
+        return np.asarray(self.family.decision(
+            self.model, self.static, X, self.meta))
+
+    def __repr__(self):
+        return f"TpuModel(family={self.family.name})"
+
+
+class Converter:
+    """Convert fitted models between sklearn and the TPU pytree form.
+
+    API mirrors the reference (converter.py): the ctor takes an optional
+    legacy context argument (ignored — kept so `Converter(sc)` still works).
+    """
+
+    def __init__(self, sc=None):
+        self._sc = sc  # accepted for reference API compatibility; unused
+
+    # -- sklearn -> TPU (reference: toSpark) -----------------------------
+    def toTPU(self, sklearn_model) -> TpuModel:
+        import jax.numpy as jnp
+        family = resolve_family(sklearn_model)
+        if family is None:
+            raise ValueError(
+                f"Cannot convert {type(sklearn_model).__name__}: no "
+                f"registered TPU family (reference Converter supports "
+                f"LogisticRegression/LinearRegression only; this one also "
+                f"covers Ridge/ElasticNet/Lasso)")
+        if not hasattr(sklearn_model, "coef_"):
+            raise ValueError("model must be fitted (missing coef_)")
+        static = family.extract_params(sklearn_model)
+        coef = np.asarray(sklearn_model.coef_)
+        intercept = np.asarray(getattr(sklearn_model, "intercept_", 0.0))
+        meta: Dict[str, Any] = {"n_features": int(coef.shape[-1])}
+        if family.is_classifier:
+            classes = np.asarray(sklearn_model.classes_)
+            meta["n_classes"] = len(classes)
+            meta["classes"] = classes
+            model = {"coef": jnp.asarray(coef, jnp.float32),
+                     "intercept": jnp.asarray(
+                         np.atleast_1d(intercept), jnp.float32)}
+        else:
+            model = {"coef": jnp.asarray(coef.ravel(), jnp.float32),
+                     "intercept": jnp.asarray(
+                         np.asarray(intercept).reshape(()), jnp.float32)}
+        return TpuModel(family, model, static, meta)
+
+    # alias keeping the reference's verb ("to the distributed side")
+    toSpark = toTPU
+
+    # -- TPU -> sklearn (reference: toSKLearn) ---------------------------
+    def toSKLearn(self, tpu_model: TpuModel):
+        from sklearn import linear_model as lm
+
+        family = tpu_model.family
+        attrs = family.sklearn_attrs(
+            tpu_model.model, tpu_model.static, tpu_model.meta)
+        cls = {
+            "logistic_regression": lm.LogisticRegression,
+            "ridge": lm.Ridge,
+            "linear_regression": lm.LinearRegression,
+            "elastic_net": lm.ElasticNet,
+        }.get(family.name)
+        if cls is None:
+            raise ValueError(f"no sklearn counterpart for {family.name}")
+        valid = cls().get_params()
+        est = cls(**{k: v for k, v in tpu_model.static.items()
+                     if k in valid})
+        for k, v in attrs.items():
+            setattr(est, k, v)
+        return est
+
+    to_sklearn = toSKLearn
+
+    # -- DataFrame helper (reference: toPandas) --------------------------
+    def toPandas(self, df):
+        """Convert a pandas DataFrame whose cells may hold jax/numpy arrays
+        or CSRMatrix rows into a flat pandas DataFrame of numpy arrays —
+        the reference's Vector-column -> numpy behavior without a collect().
+        """
+        import pandas as pd
+        from spark_sklearn_tpu.sparse.csr import CSRMatrix
+
+        def _cell(v):
+            if isinstance(v, CSRMatrix):
+                return np.asarray(v.to_scipy().toarray()).ravel()
+            if hasattr(v, "__array__") and not np.isscalar(v):
+                return np.asarray(v)
+            return v
+
+        return pd.DataFrame(
+            {c: [_cell(v) for v in df[c]] for c in df.columns})

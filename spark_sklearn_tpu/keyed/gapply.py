@@ -1,0 +1,119 @@
+"""gapply — grouped pandas apply with a declared output schema.
+
+Reference: python/spark_sklearn/group_apply.py `gapply(grouped_data, func,
+schema, *cols)` — pre-`pandas_udf`-era grouped apply: collect each key's rows
+(collect_list(struct(...)) + shuffle), run a (key, pandas.DataFrame) ->
+pandas.DataFrame function per group, explode back with a declared schema.
+
+Here there is no shuffle machinery to work around (SURVEY §3.3): groups are
+contiguous slices after a host-side sort, and the declared-schema contract is
+kept because it is the part users depend on (column names, order, dtypes —
+validated against what `func` returns).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+Schema = Union[Sequence[tuple], Mapping[str, object], "pd.Series", None]
+
+
+def _normalize_schema(schema: Schema):
+    """schema -> ordered list of (name, numpy dtype or None)."""
+    if schema is None:
+        return None
+    if isinstance(schema, Mapping):
+        return [(k, np.dtype(v) if v is not None else None)
+                for k, v in schema.items()]
+    out = []
+    for item in schema:
+        if isinstance(item, str):
+            out.append((item, None))
+        else:
+            name, dtype = item
+            out.append((name, np.dtype(dtype) if dtype is not None else None))
+    return out
+
+
+def gapply(
+    grouped_data,
+    func: Callable,
+    schema: Schema = None,
+    *cols: str,
+    retainGroupColumns: bool = True,
+):
+    """Apply `func(key, pandas.DataFrame) -> pandas.DataFrame` per group.
+
+    Parameters mirror the reference:
+      grouped_data : a pandas ``DataFrameGroupBy`` (``df.groupby(keys)``) —
+        the analog of pyspark's GroupedData — or a ``(df, keys)`` tuple.
+      func : ``(key_tuple, pdf) -> pdf``: key is always a tuple (even for a
+        single key column), pdf contains `cols` (or all non-key columns).
+      schema : declared output schema — list of names, list of (name, dtype),
+        or {name: dtype}; validated against func's output.  None = infer.
+      *cols : the columns handed to func; default = all non-key columns.
+      retainGroupColumns : prepend key columns to the output (the
+        `spark.sql.retainGroupColumns` conf the reference reads).
+    """
+    if isinstance(grouped_data, tuple):
+        df, keys = grouped_data
+        if isinstance(keys, str):
+            keys = [keys]
+        gb = df.groupby(list(keys), sort=True)
+        key_names = list(keys)
+    else:
+        gb = grouped_data
+        keys_attr = gb.keys if not isinstance(gb.keys, str) else [gb.keys]
+        key_names = list(keys_attr)
+        df = gb.obj
+
+    value_cols = list(cols) if cols else [
+        c for c in df.columns if c not in key_names]
+    norm_schema = _normalize_schema(schema)
+
+    pieces = []
+    for key, pdf in gb:
+        if not isinstance(key, tuple):
+            key = (key,)
+        out = func(key, pdf[value_cols].reset_index(drop=True))
+        if not isinstance(out, pd.DataFrame):
+            raise TypeError(
+                f"func must return a pandas DataFrame, got {type(out)}")
+        if norm_schema is not None:
+            names = [n for n, _ in norm_schema]
+            missing = set(names) - set(out.columns)
+            if missing:
+                raise ValueError(
+                    f"func output is missing schema columns {sorted(missing)}")
+            out = out[names]
+            for n, dt in norm_schema:
+                if dt is not None:
+                    out[n] = out[n].astype(dt)
+        if retainGroupColumns:
+            for i, kn in enumerate(key_names):
+                if kn in out.columns:  # func already emitted the key column
+                    continue
+                out.insert(min(i, len(out.columns)), kn,
+                           [key[i]] * len(out))
+        pieces.append(out)
+
+    if not pieces:
+        # zero groups: build the declared schema with correct dtypes; with
+        # schema=None the func's output columns are unknowable without a
+        # group, so fall back to the input value columns (documented quirk)
+        out = pd.DataFrame()
+        if retainGroupColumns:
+            for kn in key_names:
+                out[kn] = pd.Series([], dtype=df[kn].dtype)
+        if norm_schema:
+            for n, dt in norm_schema:
+                out[n] = pd.Series([], dtype=dt if dt is not None
+                                   else object)
+        else:
+            for c in value_cols:
+                out[c] = pd.Series([], dtype=df[c].dtype)
+        return out
+    return pd.concat(pieces, ignore_index=True)

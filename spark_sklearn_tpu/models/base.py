@@ -1,0 +1,113 @@
+"""Tier-A estimator family protocol and registry.
+
+The reference runs `clone(estimator).set_params(**p).fit(X[train], y[train])`
+as arbitrary host Python inside each Spark task (reference: grid_search.py ->
+sklearn _fit_and_score).  A TPU cannot run arbitrary Python; instead each
+supported estimator *family* re-expresses fit/predict/score as pure JAX
+functions with fixed shapes:
+
+    fit(dynamic, static, data, train_w, meta)  -> model pytree
+    predict(model, static, X, meta)            -> encoded predictions
+    decision(model, static, X, meta)           -> scores/logits (optional)
+
+- `dynamic`: dict of scalar hyperparameters that batch under vmap (C, alpha..)
+- `static`:  dict of trace-shaping hyperparameters (penalty, hidden sizes..)
+- `train_w`: per-sample weight mask (ragged CV folds -> fixed shapes,
+  SURVEY §7.3 #2)
+- `meta`:    host-side data facts (n_classes, classes_, feature means...)
+
+The registry maps BOTH sklearn estimator classes and our own native
+estimators to a family, so a user's existing `sklearn.linear_model.
+LogisticRegression` instance is dispatched to the compiled path with no code
+change — the same drop-in contract the reference had.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+_FAMILIES_BY_CLASSNAME: Dict[str, Any] = {}
+
+
+def register_family(family, *qualified_names: str):
+    """Register a family under fully-qualified estimator class names
+    (e.g. "sklearn.linear_model._logistic.LogisticRegression")."""
+    for qn in qualified_names:
+        _FAMILIES_BY_CLASSNAME[qn] = family
+    return family
+
+
+def _qualname(cls: Type) -> str:
+    return f"{cls.__module__}.{cls.__name__}"
+
+
+def resolve_family(estimator) -> Optional[Any]:
+    """Find the Tier-A family for an estimator instance, or None (-> Tier B).
+
+    Matching is by qualified class name, then by bare class name with module
+    prefix "sklearn." — robust to sklearn's private-module shuffling.
+    """
+    cls = type(estimator)
+    qn = _qualname(cls)
+    if qn in _FAMILIES_BY_CLASSNAME:
+        return _FAMILIES_BY_CLASSNAME[qn]
+    # tolerate sklearn's private-module shuffling, but ONLY for sklearn
+    # classes — a third-party class that happens to be named
+    # "LogisticRegression" must not silently get the compiled fit
+    if qn.startswith("sklearn."):
+        for known, fam in _FAMILIES_BY_CLASSNAME.items():
+            if known.startswith("sklearn.") and \
+                    known.split(".")[-1] == cls.__name__:
+                return fam
+    return None
+
+
+class Family:
+    """Base class for Tier-A families (documentation of the protocol)."""
+
+    name: str = "base"
+    #: dynamic (vmap-batchable) hyperparameter names -> numpy dtype
+    dynamic_params: Dict[str, Any] = {}
+    #: True for classifiers (label-encode y, default scorer = accuracy)
+    is_classifier: bool = False
+
+    # --- host side -------------------------------------------------------
+    @classmethod
+    def extract_params(cls, estimator) -> Dict[str, Any]:
+        """estimator instance -> full param dict (host)."""
+        return dict(estimator.get_params(deep=False))
+
+    @classmethod
+    def prepare_data(cls, X, y, dtype=np.float32):
+        """-> (data: dict of arrays ready for device, meta: dict of host
+        facts).  Called once per search, not per candidate."""
+        raise NotImplementedError
+
+    # --- device side (pure, jit/vmap-safe) -------------------------------
+    @classmethod
+    def fit(cls, dynamic, static, data, train_w, meta):
+        raise NotImplementedError
+
+    @classmethod
+    def predict(cls, model, static, X, meta):
+        raise NotImplementedError
+
+    @classmethod
+    def decision(cls, model, static, X, meta):
+        """Margins/logits for log-loss & AUC scorers; optional."""
+        raise NotImplementedError
+
+    # --- interop ---------------------------------------------------------
+    @classmethod
+    def sklearn_attrs(cls, model, static, meta) -> Dict[str, Any]:
+        """Fitted-attribute dict (coef_, intercept_, classes_...) used by
+        Converter and by refit write-back."""
+        raise NotImplementedError
+
+
+def encode_labels(y):
+    """Host-side label encoding shared by all classifier families."""
+    classes, y_enc = np.unique(y, return_inverse=True)
+    return classes, y_enc.astype(np.int32)

@@ -1,0 +1,1 @@
+from spark_sklearn_tpu.ops.solvers import lbfgs, LBFGSResult

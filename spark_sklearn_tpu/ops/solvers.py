@@ -1,0 +1,162 @@
+"""Batched, jit/vmap-safe solvers.
+
+The reference never solves anything itself — each Spark task calls
+`estimator.fit`, which reaches scipy's L-BFGS / liblinear / libsvm on a CPU
+executor (reference: grid_search.py -> sklearn _fit_and_score -> est.fit).
+On TPU the solver must BE the program: fixed-shape, static control flow, no
+Python in the loop, batchable with `vmap` over hyperparameter candidates so
+the MXU sees one big batched problem instead of thousands of small ones.
+
+`lbfgs` is a limited-memory BFGS with rolling history buffers and an Armijo
+backtracking line search, written entirely with `lax.while_loop`/`fori_loop`
+so that XLA compiles one program per (shape, max_iter) and `vmap` lifts it
+over candidates (a batched while_loop runs until every lane converges).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LBFGSResult(NamedTuple):
+    x: jnp.ndarray
+    fun: jnp.ndarray
+    grad_norm: jnp.ndarray
+    n_iter: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def _two_loop(g, s_mem, y_mem, rho, gamma, total, n_valid, m):
+    """Two-loop recursion over a rolling history buffer.
+
+    `total` is the number of pairs ever inserted (ring head = total % m);
+    `n_valid = min(total, m)`.  Slot `(total - 1 - i) % m` holds the i-th most
+    recent pair; slots with i >= n_valid are masked out so the same program
+    serves warmup and steady state.
+    """
+
+    def bwd(i, carry):
+        q, alpha = carry
+        idx = jnp.mod(total - 1 - i, m)
+        valid = i < n_valid
+        a = rho[idx] * jnp.dot(s_mem[idx], q)
+        a = jnp.where(valid, a, 0.0)
+        q = q - a * y_mem[idx]
+        alpha = alpha.at[idx].set(a)
+        return q, alpha
+
+    q, alpha = lax.fori_loop(0, m, bwd, (g, jnp.zeros((m,), g.dtype)))
+    r = gamma * q
+
+    def fwd(i, r):
+        idx = jnp.mod(total - n_valid + i, m)
+        valid = i < n_valid
+        b = rho[idx] * jnp.dot(y_mem[idx], r)
+        corr = (alpha[idx] - b) * s_mem[idx]
+        return r + jnp.where(valid, corr, 0.0)
+
+    r = lax.fori_loop(0, m, fwd, r)
+    return -r
+
+
+@partial(jax.jit, static_argnums=(0, 2, 4, 6))
+def lbfgs(
+    fun: Callable,
+    x0: jnp.ndarray,
+    max_iter: int = 100,
+    tol: float = 1e-4,
+    history: int = 10,
+    c1: float = 1e-4,
+    ls_max: int = 30,
+) -> LBFGSResult:
+    """Minimise `fun(x) -> scalar` from flat `x0`.
+
+    Matches the role scipy's lbfgs plays for sklearn's LogisticRegression
+    (sum-loss objective, gradient-infinity-norm stopping at `tol`).
+    """
+    m = history
+    d = x0.shape[0]
+    dtype = x0.dtype
+    vg = jax.value_and_grad(fun)
+    f0, g0 = vg(x0)
+
+    state = dict(
+        x=x0, f=f0, g=g0,
+        s_mem=jnp.zeros((m, d), dtype),
+        y_mem=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype),
+        gamma=jnp.asarray(1.0, dtype),
+        n_valid=jnp.asarray(0, jnp.int32),
+        it=jnp.asarray(0, jnp.int32),
+    )
+
+    def gnorm(g):
+        return jnp.max(jnp.abs(g))
+
+    def cond(st):
+        return jnp.logical_and(st["it"] < max_iter, gnorm(st["g"]) > tol)
+
+    def body(st):
+        x, f, g = st["x"], st["f"], st["g"]
+        p = _two_loop(g, st["s_mem"], st["y_mem"], st["rho"], st["gamma"],
+                      st["n_valid"], jnp.minimum(st["n_valid"], m), m)
+        dginit = jnp.dot(g, p)
+        # fall back to steepest descent if the direction lost descent-ness
+        bad = dginit >= 0
+        p = jnp.where(bad, -g, p)
+        dginit = jnp.where(bad, -jnp.dot(g, g), dginit)
+
+        # first step: scale so the initial trial is modest
+        a0 = jnp.where(
+            st["it"] == 0,
+            jnp.minimum(jnp.asarray(1.0, dtype),
+                        1.0 / (gnorm(g) + jnp.finfo(dtype).eps)),
+            jnp.asarray(1.0, dtype),
+        )
+
+        def ls_cond(carry):
+            alpha, k, fnew = carry
+            armijo = fnew <= f + c1 * alpha * dginit
+            return jnp.logical_and(k < ls_max, jnp.logical_not(armijo))
+
+        def ls_body(carry):
+            alpha, k, _ = carry
+            alpha = alpha * 0.5
+            return alpha, k + 1, fun(x + alpha * p)
+
+        alpha, _, _ = lax.while_loop(
+            ls_cond, ls_body, (a0, jnp.asarray(0, jnp.int32), fun(x + a0 * p)))
+
+        x_new = x + alpha * p
+        f_new, g_new = vg(x_new)
+        # reject non-finite steps outright (error_score semantics handle the
+        # rest at the search layer)
+        ok = jnp.isfinite(f_new)
+        x_new = jnp.where(ok, x_new, x)
+        f_new = jnp.where(ok, f_new, f)
+        g_new = jnp.where(ok, g_new, g)
+
+        s = x_new - x
+        yv = g_new - g
+        sy = jnp.dot(s, yv)
+        update = sy > 1e-10
+        head = jnp.mod(st["n_valid"], m)
+        s_mem = jnp.where(update, st["s_mem"].at[head].set(s), st["s_mem"])
+        y_mem = jnp.where(update, st["y_mem"].at[head].set(yv), st["y_mem"])
+        rho = jnp.where(update, st["rho"].at[head].set(1.0 / sy), st["rho"])
+        gamma = jnp.where(update, sy / (jnp.dot(yv, yv) + jnp.finfo(dtype).eps),
+                          st["gamma"])
+        n_valid = jnp.where(update, st["n_valid"] + 1, st["n_valid"])
+
+        return dict(x=x_new, f=f_new, g=g_new, s_mem=s_mem, y_mem=y_mem,
+                    rho=rho, gamma=gamma, n_valid=n_valid, it=st["it"] + 1)
+
+    st = lax.while_loop(cond, body, state)
+    return LBFGSResult(
+        x=st["x"], fun=st["f"], grad_norm=gnorm(st["g"]), n_iter=st["it"],
+        converged=gnorm(st["g"]) <= tol)

@@ -1,0 +1,2 @@
+from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh, replicate, shard_leading
+from spark_sklearn_tpu.parallel.taskgrid import CompileGroup, build_compile_groups, build_fold_masks

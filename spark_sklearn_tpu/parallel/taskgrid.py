@@ -1,0 +1,136 @@
+"""Lowering of the (ParameterGrid x KFold) task list onto arrays.
+
+The reference builds `[(params, train, test) for params in grid for train,
+test in cv.split(X, y)]` and ships one pickled closure per element to a Spark
+executor (reference: grid_search.py _fit; call stack SURVEY §3.1).  Under XLA
+the same grid must become *arrays*:
+
+  - candidate params split into a STATIC part (changes the traced program:
+    strings, bools, shape-determining ints) and a DYNAMIC part (numeric leaves
+    that can batch under `vmap`).  Candidates sharing a static signature form
+    one **compile group** — one XLA program, vmapped over the group.
+  - folds become fixed-shape **masks** (n_folds, n_samples): 1.0 where the
+    sample is in the train (resp. test) split.  Ragged train splits all get
+    identical shapes this way (SURVEY §7.3 hard part #2), and every estimator
+    fit is a weighted fit with the mask as sample_weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CompileGroup:
+    """One statically-shaped batch of candidates: a single jit program,
+    vmapped over `n_candidates`."""
+
+    static_params: Dict[str, Any]                # shared by every candidate
+    dynamic_params: Dict[str, np.ndarray]        # each shape (n_candidates,)
+    candidate_indices: np.ndarray                # (n_candidates,) into the
+                                                 # original candidate order
+    params_list: List[Dict[str, Any]]            # original dicts, group order
+
+    @property
+    def n_candidates(self) -> int:
+        return len(self.candidate_indices)
+
+
+def _is_dynamic_value(v: Any) -> bool:
+    """A value can batch under vmap iff it is a real number that does not
+    change the traced program.  Bools and ints used as sizes/switches are
+    conservatively static unless the family says otherwise."""
+    return isinstance(v, (float, np.floating)) and not isinstance(v, bool)
+
+
+def build_compile_groups(
+    candidate_params: Sequence[Mapping[str, Any]],
+    dynamic_names: Optional[Sequence[str]] = None,
+    dynamic_dtypes: Optional[Mapping[str, Any]] = None,
+) -> List[CompileGroup]:
+    """Partition candidates into compile groups by static signature.
+
+    `dynamic_names`: param names the estimator family promises are pure
+    numeric leaves of the traced fit (e.g. C, alpha, l1_ratio, tol,
+    learning_rate_init).  Anything else — and any dynamic-name whose value is
+    non-numeric (e.g. C="auto") — is static for that candidate.
+    """
+    dynamic_names = set(dynamic_names or ())
+    dynamic_dtypes = dict(dynamic_dtypes or {})
+    groups: Dict[Tuple, Dict[str, Any]] = {}
+    for idx, params in enumerate(candidate_params):
+        static, dynamic = {}, {}
+        for k, v in params.items():
+            if k in dynamic_names and (
+                _is_dynamic_value(v)
+                or isinstance(v, (int, np.integer))
+                and not isinstance(v, bool)
+            ):
+                dynamic[k] = v
+            else:
+                static[k] = v
+        key = (
+            tuple(sorted((k, _hashable(v)) for k, v in static.items())),
+            tuple(sorted(dynamic)),
+        )
+        g = groups.setdefault(
+            key, {"static": static, "dyn": {k: [] for k in dynamic},
+                  "idx": [], "plist": []})
+        for k, v in dynamic.items():
+            g["dyn"][k].append(v)
+        g["idx"].append(idx)
+        g["plist"].append(dict(params))
+    out = []
+    for g in groups.values():
+        dyn = {
+            k: np.asarray(v, dtype=dynamic_dtypes.get(k, np.float32))
+            for k, v in g["dyn"].items()
+        }
+        out.append(
+            CompileGroup(
+                static_params=g["static"],
+                dynamic_params=dyn,
+                candidate_indices=np.asarray(g["idx"], dtype=np.int64),
+                params_list=g["plist"],
+            )
+        )
+    # deterministic order: by first candidate index
+    out.sort(key=lambda g: g.candidate_indices[0])
+    return out
+
+
+def _hashable(v: Any):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return (v.shape, v.tobytes())
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def build_fold_masks(
+    cv_splits: Sequence[Tuple[np.ndarray, np.ndarray]],
+    n_samples: int,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_idx, test_idx) pairs -> dense (n_folds, n_samples) masks.
+
+    Reference counterpart: each Spark task slices X[train]/X[test] with ragged
+    index arrays (grid_search.py -> sklearn _fit_and_score).  Fixed-shape
+    masks keep every (candidate x fold) XLA program identical.
+    """
+    n_folds = len(cv_splits)
+    train = np.zeros((n_folds, n_samples), dtype=dtype)
+    test = np.zeros((n_folds, n_samples), dtype=dtype)
+    for i, (tr, te) in enumerate(cv_splits):
+        train[i, tr] = 1.0
+        test[i, te] = 1.0
+    return train, test

@@ -1,0 +1,598 @@
+"""GridSearchCV / RandomizedSearchCV — the flagship feature.
+
+Drop-in replacements for the reference's `spark_sklearn.GridSearchCV(sc,
+estimator, param_grid)` (reference: python/spark_sklearn/grid_search.py) and
+for sklearn's own search estimators.  API compatibility notes:
+
+  - ``GridSearchCV(estimator, param_grid, ...)`` — sklearn-style; ALSO
+    accepts the reference's legacy ``GridSearchCV(sc, estimator, param_grid)``
+    calling convention: if the first positional argument has no
+    ``get_params``, it is treated as a legacy Spark context and ignored (the
+    mesh plays its role).
+  - ``cv_results_`` schema matches sklearn's `_format_results`
+    (sklearn/model_selection/_search.py:1208-1290): `params`, masked
+    `param_*` arrays, `split{i}_test_*`, `mean/std/rank_test_*`,
+    `mean/std_fit_time`, `mean/std_score_time`, optional train scores.
+  - `best_index_/best_params_/best_score_/best_estimator_/refit_time_`,
+    `multimetric_`, `n_splits_`, `scorer_` follow sklearn
+    (_search.py:1148-1202).
+
+Execution: two tiers (SURVEY §7.0).
+  Tier A (compiled): estimator family recognised by the registry -> the
+    (candidates x folds) grid becomes nested `vmap` axes of one jitted
+    program per compile group, sharded over the mesh "task" axis; the dataset
+    is device_put replicated (the TPU-native `sc.broadcast`).
+  Tier B (host): any other estimator -> real `clone(est).set_params(**p)
+    .fit(...)` via sklearn `_fit_and_score` fanned out with joblib — full
+    sklearn generality, exactly the reference's per-task semantics.
+"""
+
+from __future__ import annotations
+
+import numbers
+import time
+import warnings
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+
+from sklearn.base import BaseEstimator, MetaEstimatorMixin, clone, is_classifier
+from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
+
+from spark_sklearn_tpu.models.base import resolve_family
+from spark_sklearn_tpu.parallel import mesh as mesh_lib
+from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
+from spark_sklearn_tpu.parallel.taskgrid import (
+    build_compile_groups,
+    build_fold_masks,
+)
+from spark_sklearn_tpu.search.scorers import resolve_scoring
+
+
+def _looks_like_estimator(obj) -> bool:
+    return hasattr(obj, "get_params") and (
+        hasattr(obj, "fit") or hasattr(obj, "predict"))
+
+
+def _is_multimetric(scorer_names) -> bool:
+    return not (len(scorer_names) == 1 and scorer_names[0] == "score")
+
+
+class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
+    """Shared engine: candidate generation is the only subclass hook
+    (`_get_candidates`), mirroring sklearn's `_run_search` split
+    (_search.py:1708/2109)."""
+
+    def __init__(self, estimator, *, scoring=None, n_jobs=None, refit=True,
+                 cv=None, verbose=0, error_score=np.nan,
+                 return_train_score=False, backend=None,
+                 config: Optional[TpuConfig] = None):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.n_jobs = n_jobs
+        self.refit = refit
+        self.cv = cv
+        self.verbose = verbose
+        self.error_score = error_score
+        self.return_train_score = return_train_score
+        self.backend = backend          # None=auto, "tpu"=compiled, "host"
+        self.config = config
+
+    # -- candidate generation -------------------------------------------
+    def _get_candidates(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    # -- sklearn plumbing -----------------------------------------------
+    def _check_refit_for_multimetric(self, scorer_names):
+        if self.refit is not False and (
+            not isinstance(self.refit, str) or self.refit not in scorer_names
+        ) and not callable(self.refit):
+            raise ValueError(
+                "For multi-metric scoring, refit must be set to a scorer "
+                f"name or a callable; got {self.refit!r}")
+
+    @property
+    def _refit_metric(self):
+        if isinstance(self.refit, str):
+            return self.refit
+        return "score"
+
+    def fit(self, X, y=None, *, groups=None, **fit_params):
+        estimator = self.estimator
+        candidates = list(self._get_candidates())
+        cv = check_cv(self.cv, y, classifier=is_classifier(estimator))
+        X_arr = X if hasattr(X, "shape") else np.asarray(X)
+        splits = list(cv.split(X_arr, y, groups))
+        self.n_splits_ = len(splits)
+
+        if self.verbose > 0:
+            print(f"Fitting {self.n_splits_} folds for each of "
+                  f"{len(candidates)} candidates, totalling "
+                  f"{self.n_splits_ * len(candidates)} fits")
+
+        # multimetric refit misconfiguration must fail BEFORE the sweep,
+        # not after hours of fits (sklearn validates up front too)
+        if isinstance(self.scoring, (list, tuple, set, dict)):
+            self._check_refit_for_multimetric(
+                list(self.scoring.keys())
+                if isinstance(self.scoring, dict) else list(self.scoring))
+
+        family = None if self.backend == "host" else resolve_family(estimator)
+        use_compiled = family is not None
+        # groups is fine on the compiled path: the splits above already
+        # encode it and only fold masks reach the device.  fit_params is
+        # not: arbitrary kwargs cannot enter a traced fit.
+        if use_compiled and fit_params:
+            if self.backend == "tpu":
+                raise ValueError(
+                    "fit_params are not supported on the compiled path; "
+                    "use backend='host'")
+            use_compiled = False
+        if use_compiled:
+            try:
+                resolve_scoring(self.scoring, family)
+            except (KeyError, TypeError):
+                if self.backend == "tpu":
+                    raise
+                use_compiled = False
+
+        if use_compiled:
+            try:
+                out = self._fit_compiled(family, X_arr, y, candidates, splits)
+            except Exception as exc:  # unsupported static combo and the like
+                if self.backend == "tpu":
+                    raise
+                warnings.warn(
+                    f"compiled search path failed ({exc!r}); falling back "
+                    "to the host backend", UserWarning)
+                out = self._fit_host(X_arr, y, candidates, splits,
+                                     fit_params)
+        else:
+            out = self._fit_host(X_arr, y, candidates, splits, fit_params)
+        (test_scores, train_scores, fit_times, score_times, scorer_names,
+         self.scorer_) = out
+
+        self.multimetric_ = _is_multimetric(scorer_names)
+        if self.multimetric_:
+            self._check_refit_for_multimetric(scorer_names)
+
+        results = self._format_results(
+            candidates, test_scores, train_scores, fit_times, score_times,
+            scorer_names)
+        self.cv_results_ = results
+
+        refit_metric = self._refit_metric
+        if self.refit or not self.multimetric_:
+            self.best_index_ = self._select_best_index(
+                self.refit, refit_metric, results)
+            if not callable(self.refit):
+                self.best_score_ = results[
+                    f"mean_test_{refit_metric}"][self.best_index_]
+            self.best_params_ = results["params"][self.best_index_]
+
+        if self.refit:
+            # Refit on the "driver", exactly like the reference
+            # (grid_search.py: best_estimator_ = clone(base).set_params(
+            #  **best_params).fit(X, y)); our native estimators run their own
+            # compiled fit here.
+            self.best_estimator_ = clone(estimator).set_params(
+                **self.best_params_)
+            t0 = time.perf_counter()
+            if y is not None:
+                self.best_estimator_.fit(X, y, **fit_params)
+            else:
+                self.best_estimator_.fit(X, **fit_params)
+            self.refit_time_ = time.perf_counter() - t0
+            if hasattr(self.best_estimator_, "classes_"):
+                self.classes_ = self.best_estimator_.classes_
+        if hasattr(X_arr, "shape") and len(getattr(X_arr, "shape", ())) == 2:
+            self.n_features_in_ = X_arr.shape[1]
+        return self
+
+    @staticmethod
+    def _select_best_index(refit, refit_metric, results):
+        if callable(refit):
+            best_index = refit(results)
+            if not isinstance(best_index, numbers.Integral):
+                raise TypeError("best_index_ returned is not an integer")
+            if best_index < 0 or best_index >= len(results["params"]):
+                raise IndexError("best_index_ index out of range")
+            return best_index
+        return results[f"rank_test_{refit_metric}"].argmin()
+
+    # ------------------------------------------------------------------
+    # Tier A: compiled path
+    # ------------------------------------------------------------------
+    def _fit_compiled(self, family, X, y, candidates, splits):
+        from sklearn.metrics import check_scoring
+        config = self.config or TpuConfig()
+        dtype = config.dtype or np.float32
+        scorers, _ = resolve_scoring(self.scoring, family)
+        scorer_names = list(scorers)
+
+        X = np.asarray(X)
+        data, meta = family.prepare_data(X, y, dtype=dtype)
+        n_samples = X.shape[0]
+        train_masks, test_masks = build_fold_masks(
+            splits, n_samples, dtype=dtype)
+        n_folds = len(splits)
+        n_cand = len(candidates)
+        return_train = self.return_train_score
+
+        base_params = family.extract_params(self.estimator)
+        dyn_names = list(family.dynamic_params)
+        groups = build_compile_groups(
+            candidates, dyn_names, family.dynamic_params)
+
+        mesh = build_mesh(config)
+        n_task_shards = mesh.shape[mesh_lib.TASK_AXIS]
+        repl = mesh_lib.replicated_sharding(mesh)
+        task_shard = mesh_lib.task_sharding(mesh)
+
+        data_dev = {k: jax.device_put(v, repl) for k, v in data.items()}
+        train_dev = jax.device_put(train_masks, repl)
+        test_dev = jax.device_put(test_masks, repl)
+
+        test_scores = {s: np.empty((n_cand, n_folds)) for s in scorer_names}
+        train_scores = ({s: np.empty((n_cand, n_folds))
+                         for s in scorer_names} if return_train else None)
+        fit_times = np.empty((n_cand, n_folds))
+        score_times = np.empty((n_cand, n_folds))
+
+        # bound peak HBM: chunk each compile group so one launch holds at
+        # most max_tasks_per_batch (candidate x fold) program instances;
+        # every chunk of a group is padded to one uniform width so the
+        # group's two jitted programs compile exactly once
+        max_cand_per_batch = max(
+            n_task_shards,
+            mesh_lib.pad_to_multiple(
+                max(1, config.max_tasks_per_batch // max(n_folds, 1)),
+                n_task_shards))
+
+        for group in groups:
+            static = {**base_params, **group.static_params}
+            nc = group.n_candidates
+            nc_batch = min(mesh_lib.pad_to_multiple(nc, n_task_shards),
+                           max_cand_per_batch)
+
+            def fit_batch(dyn_arrs, data_d, train_m, static=static):
+                def one_cand(dyn_scalars):
+                    def one_fold(w):
+                        return family.fit(dyn_scalars, static, data_d, w,
+                                          meta)
+                    return jax.vmap(one_fold)(train_m)
+                return jax.vmap(one_cand)(dyn_arrs)
+
+            def score_batch(models, data_d, test_m, train_m, static=static):
+                def one_cand(model_c):
+                    def one_fold(model, w_test, w_train):
+                        te = {s: fn(family, model, static, data_d, meta,
+                                    w_test) for s, fn in scorers.items()}
+                        tr = ({s: fn(family, model, static, data_d, meta,
+                                     w_train) for s, fn in scorers.items()}
+                              if return_train else {})
+                        return te, tr
+                    return jax.vmap(one_fold)(
+                        model_c, test_m, train_m)
+                return jax.vmap(one_cand)(models)
+
+            fit_jit = jax.jit(fit_batch, out_shardings=task_shard)
+            score_jit = jax.jit(score_batch)
+
+            for lo in range(0, nc, nc_batch):
+                hi = min(lo + nc_batch, nc)
+                dyn = {}
+                for k, arr in group.dynamic_params.items():
+                    chunk = arr[lo:hi]
+                    if len(chunk) != nc_batch:
+                        chunk = np.concatenate(
+                            [chunk, np.repeat(chunk[-1:],
+                                              nc_batch - len(chunk),
+                                              axis=0)])
+                    dyn[k] = jax.device_put(chunk, task_shard)
+                if not dyn:
+                    # all-static group: vmap still needs a batched operand
+                    # to define the candidate axis (families ignore unknown
+                    # keys)
+                    dyn["_pad"] = jax.device_put(
+                        np.zeros(nc_batch, dtype=dtype), task_shard)
+
+                t0 = time.perf_counter()
+                models = fit_jit(dyn, data_dev, train_dev)
+                jax.block_until_ready(models)
+                t_fit = time.perf_counter() - t0
+
+                t0 = time.perf_counter()
+                te, tr = score_jit(models, data_dev, test_dev, train_dev)
+                te = jax.device_get(te)
+                tr = jax.device_get(tr)
+                t_score = time.perf_counter() - t0
+                del models
+
+                idx = group.candidate_indices[lo:hi]
+                fit_times[idx, :] = t_fit / (nc_batch * n_folds)
+                score_times[idx, :] = t_score / (nc_batch * n_folds)
+                for s in scorer_names:
+                    test_scores[s][idx, :] = np.asarray(te[s])[:hi - lo]
+                    if return_train:
+                        train_scores[s][idx, :] = \
+                            np.asarray(tr[s])[:hi - lo]
+
+        self._handle_error_score(test_scores, train_scores, scorer_names)
+        # scorer_ keeps the sklearn-facing objects so .score() works the
+        # sklearn way even though CV scoring ran compiled
+        if self.scoring is None or isinstance(self.scoring, str):
+            scorer_attr = check_scoring(self.estimator, self.scoring)
+        else:
+            from sklearn.metrics._scorer import _check_multimetric_scoring
+            scorer_attr = _check_multimetric_scoring(
+                self.estimator, self.scoring)
+        return (test_scores, train_scores, fit_times, score_times,
+                scorer_names, scorer_attr)
+
+    def _handle_error_score(self, test_scores, train_scores, scorer_names):
+        """Reproduce sklearn's error_score semantics (_validation.py:666,
+        _search.py:1107 _warn_or_raise_about_fit_failures): a failed fit
+        contributes `error_score` instead of aborting — on TPU "failure" is a
+        non-finite score (XLA cannot raise)."""
+        any_bad = np.zeros(next(iter(test_scores.values())).shape, bool)
+        for s in scorer_names:
+            any_bad |= ~np.isfinite(test_scores[s])
+        n_bad = int(any_bad.sum())
+        if n_bad == 0:
+            return
+        if isinstance(self.error_score, str) and self.error_score == "raise":
+            raise ValueError(
+                f"{n_bad} fits produced non-finite scores and "
+                "error_score='raise'")
+        warnings.warn(
+            f"{n_bad} fits failed (non-finite scores); replacing with "
+            f"error_score={self.error_score!r}.", UserWarning)
+        for s in scorer_names:
+            bad = ~np.isfinite(test_scores[s])
+            test_scores[s][bad] = self.error_score
+            if train_scores is not None:
+                badt = ~np.isfinite(train_scores[s])
+                train_scores[s][badt] = self.error_score
+
+    # ------------------------------------------------------------------
+    # Tier B: host fallback (full sklearn generality)
+    # ------------------------------------------------------------------
+    def _fit_host(self, X, y, candidates, splits, fit_params):
+        from joblib import Parallel, delayed
+        from sklearn.metrics import check_scoring
+        from sklearn.metrics._scorer import _check_multimetric_scoring
+        from sklearn.model_selection._validation import _fit_and_score
+
+        estimator = self.estimator
+        if callable(self.scoring) or self.scoring is None or isinstance(
+                self.scoring, str):
+            scorer_obj = check_scoring(estimator, self.scoring)
+            scorers: Any = {"score": scorer_obj}
+            scorer_attr: Any = scorer_obj
+            scorer_for_fs: Any = scorer_obj
+        else:
+            scorers = _check_multimetric_scoring(estimator, self.scoring)
+            scorer_attr = dict(scorers)
+            scorer_for_fs = scorers
+        scorer_names = list(scorers)
+
+        n_folds = len(splits)
+        tasks = [
+            (ci, fi, params, train, test)
+            for ci, params in enumerate(candidates)
+            for fi, (train, test) in enumerate(splits)
+        ]
+
+        def run(params, train, test):
+            return _fit_and_score(
+                clone(estimator), X, y, scorer=scorer_for_fs,
+                train=train, test=test, verbose=self.verbose,
+                parameters=params, fit_params=fit_params or None,
+                score_params=None,
+                return_train_score=self.return_train_score,
+                return_times=True, error_score=self.error_score)
+
+        n_jobs = self.n_jobs if self.n_jobs is not None else 1
+        results = Parallel(n_jobs=n_jobs)(
+            delayed(run)(params, train, test)
+            for _, _, params, train, test in tasks)
+
+        n_cand = len(candidates)
+        test_scores = {s: np.empty((n_cand, n_folds)) for s in scorer_names}
+        train_scores = ({s: np.empty((n_cand, n_folds))
+                        for s in scorer_names}
+                        if self.return_train_score else None)
+        fit_times = np.empty((n_cand, n_folds))
+        score_times = np.empty((n_cand, n_folds))
+        n_failed = 0
+        for (ci, fi, _, _, _), res in zip(tasks, results):
+            if res.get("fit_error") is not None:
+                n_failed += 1
+            ts = res["test_scores"]
+            if not isinstance(ts, dict):
+                ts = {"score": ts}
+            for s in scorer_names:
+                test_scores[s][ci, fi] = ts.get(s, np.nan)
+            if self.return_train_score:
+                trs = res.get("train_scores", {})
+                if not isinstance(trs, dict):
+                    trs = {"score": trs}
+                for s in scorer_names:
+                    train_scores[s][ci, fi] = trs.get(s, np.nan)
+            fit_times[ci, fi] = res["fit_time"]
+            score_times[ci, fi] = res["score_time"]
+        if n_failed:
+            warnings.warn(
+                f"{n_failed} fits failed; their score was set to "
+                f"error_score={self.error_score!r}.", UserWarning)
+        return (test_scores, train_scores, fit_times, score_times,
+                scorer_names, scorer_attr)
+
+    # ------------------------------------------------------------------
+    # cv_results_ assembly — sklearn _format_results schema
+    # (_search.py:1208-1290)
+    # ------------------------------------------------------------------
+    def _format_results(self, candidates, test_scores, train_scores,
+                        fit_times, score_times, scorer_names):
+        from scipy.stats import rankdata
+
+        n_candidates = len(candidates)
+        results: Dict[str, Any] = {}
+
+        def _store(key_name, array, weights=None, splits=False, rank=False):
+            array = np.asarray(array, dtype=np.float64).reshape(
+                n_candidates, -1)
+            if splits:
+                for i in range(array.shape[1]):
+                    results[f"split{i}_{key_name}"] = array[:, i]
+            array_means = np.average(array, axis=1, weights=weights)
+            results[f"mean_{key_name}"] = array_means
+            array_stds = np.sqrt(np.average(
+                (array - array_means[:, None]) ** 2, axis=1,
+                weights=weights))
+            results[f"std_{key_name}"] = array_stds
+            if rank:
+                if np.isnan(array_means).any():
+                    rank_arr = rankdata(
+                        np.where(np.isnan(array_means), np.inf,
+                                 -array_means), method="min")
+                else:
+                    rank_arr = rankdata(-array_means, method="min")
+                results[f"rank_{key_name}"] = rank_arr.astype(np.int32)
+
+        _store("fit_time", fit_times)
+        _store("score_time", score_times)
+
+        param_results: Dict[str, Any] = defaultdict(
+            lambda: np.ma.MaskedArray(np.empty(n_candidates), mask=True,
+                                      dtype=object))
+        for cand_idx, params in enumerate(candidates):
+            for name, value in params.items():
+                param_results[f"param_{name}"][cand_idx] = value
+        results.update(param_results)
+        results["params"] = list(candidates)
+
+        for s in scorer_names:
+            _store(f"test_{s}", test_scores[s], splits=True, rank=True)
+            if self.return_train_score:
+                _store(f"train_{s}", train_scores[s], splits=True)
+        return results
+
+    # -- prediction delegation (sklearn parity) -------------------------
+    def _check_is_fitted(self, method):
+        if not self.refit:
+            raise AttributeError(
+                f"This {type(self).__name__} instance was initialized with "
+                f"refit=False; {method} is unavailable.")
+        if not hasattr(self, "best_estimator_"):
+            raise AttributeError(
+                f"This {type(self).__name__} instance is not fitted yet; "
+                "call fit() first")
+
+    def predict(self, X):
+        self._check_is_fitted("predict")
+        return self.best_estimator_.predict(X)
+
+    def predict_proba(self, X):
+        self._check_is_fitted("predict_proba")
+        return self.best_estimator_.predict_proba(X)
+
+    def predict_log_proba(self, X):
+        self._check_is_fitted("predict_log_proba")
+        return self.best_estimator_.predict_log_proba(X)
+
+    def decision_function(self, X):
+        self._check_is_fitted("decision_function")
+        return self.best_estimator_.decision_function(X)
+
+    def transform(self, X):
+        self._check_is_fitted("transform")
+        return self.best_estimator_.transform(X)
+
+    def inverse_transform(self, X):
+        self._check_is_fitted("inverse_transform")
+        return self.best_estimator_.inverse_transform(X)
+
+    def score(self, X, y=None):
+        self._check_is_fitted("score")
+        if callable(self.scoring):
+            return self.scoring(self.best_estimator_, X, y)
+        if self.scorer_ is not None and not isinstance(self.scorer_, dict):
+            return self.scorer_(self.best_estimator_, X, y)
+        if isinstance(self.scorer_, dict) and isinstance(self.refit, str):
+            return self.scorer_[self.refit](self.best_estimator_, X, y)
+        return self.best_estimator_.score(X, y)
+
+
+class GridSearchCV(BaseSearchTPU):
+    """Exhaustive search over a parameter grid on a TPU mesh.
+
+    Accepts both calling conventions:
+      GridSearchCV(estimator, param_grid, ...)            (sklearn)
+      GridSearchCV(sc, estimator, param_grid, ...)        (reference legacy —
+        `sc` is accepted and ignored; the JAX mesh replaces the Spark
+        cluster.  Reference: grid_search.py GridSearchCV(self, sc, ...).)
+    """
+
+    def __init__(self, estimator, param_grid=None, *args, scoring=None,
+                 n_jobs=None, refit=True, cv=None, verbose=0,
+                 error_score=np.nan, return_train_score=False, backend=None,
+                 config=None):
+        if not _looks_like_estimator(estimator) and param_grid is not None \
+                and _looks_like_estimator(param_grid):
+            # legacy (sc, estimator, param_grid) convention
+            estimator = param_grid
+            param_grid = args[0] if args else None
+            args = args[1:]
+        if args:
+            raise TypeError(f"unexpected positional arguments: {args!r}")
+        if param_grid is None:
+            raise TypeError("param_grid is required")
+        super().__init__(
+            estimator, scoring=scoring, n_jobs=n_jobs, refit=refit, cv=cv,
+            verbose=verbose, error_score=error_score,
+            return_train_score=return_train_score, backend=backend,
+            config=config)
+        self.param_grid = param_grid
+
+    def _get_candidates(self):
+        return list(ParameterGrid(self.param_grid))
+
+
+class RandomizedSearchCV(BaseSearchTPU):
+    """Randomized search: candidates drawn by sklearn's ParameterSampler
+    (identical sampling semantics — _search.py:2109), evaluated on the mesh.
+
+    Legacy `(sc, estimator, param_distributions)` convention accepted like
+    GridSearchCV."""
+
+    def __init__(self, estimator, param_distributions=None, *args, n_iter=10,
+                 scoring=None, n_jobs=None, refit=True, cv=None, verbose=0,
+                 random_state=None, error_score=np.nan,
+                 return_train_score=False, backend=None, config=None):
+        if not _looks_like_estimator(estimator) and \
+                param_distributions is not None and \
+                _looks_like_estimator(param_distributions):
+            estimator = param_distributions
+            param_distributions = args[0] if args else None
+            args = args[1:]
+        if args:
+            raise TypeError(f"unexpected positional arguments: {args!r}")
+        if param_distributions is None:
+            raise TypeError("param_distributions is required")
+        super().__init__(
+            estimator, scoring=scoring, n_jobs=n_jobs, refit=refit, cv=cv,
+            verbose=verbose, error_score=error_score,
+            return_train_score=return_train_score, backend=backend,
+            config=config)
+        self.param_distributions = param_distributions
+        self.n_iter = n_iter
+        self.random_state = random_state
+
+    def _get_candidates(self):
+        return list(ParameterSampler(
+            self.param_distributions, self.n_iter,
+            random_state=self.random_state))

@@ -1,0 +1,174 @@
+"""JAX scorer registry for the compiled (Tier A) search path.
+
+The reference passes sklearn scorer objects into `_fit_and_score` on CPU
+executors (reference: grid_search.py -> sklearn scorers).  Inside a jitted
+program a scorer must be a pure function over fixed-shape arrays, with the
+test fold expressed as a weight mask.  Every scorer here matches the sklearn
+metric of the same name on dense inputs (oracle-tested in
+tests/test_scorers.py).
+
+Weighted-mask convention: `w` is 1.0 on the fold's samples, 0.0 elsewhere;
+all means are weighted means over `w`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EPS = 1e-12
+
+
+def _wsum(w):
+    return jnp.sum(w) + EPS
+
+
+def _accuracy(family, model, static, data, meta, w):
+    pred = family.predict(model, static, data["X"], meta)
+    return jnp.sum(w * (pred == data["y"])) / _wsum(w)
+
+
+def _neg_log_loss(family, model, static, data, meta, w):
+    proba = family.predict_proba(model, static, data["X"], meta)
+    p = jnp.clip(proba[jnp.arange(proba.shape[0]), data["y"]], 1e-15, 1.0)
+    return -(jnp.sum(w * -jnp.log(p)) / _wsum(w))
+
+
+def _binary_counts(family, model, static, data, meta, w, positive=1):
+    pred = family.predict(model, static, data["X"], meta)
+    y = data["y"]
+    tp = jnp.sum(w * ((pred == positive) & (y == positive)))
+    fp = jnp.sum(w * ((pred == positive) & (y != positive)))
+    fn = jnp.sum(w * ((pred != positive) & (y == positive)))
+    return tp, fp, fn
+
+
+def _f1(family, model, static, data, meta, w):
+    tp, fp, fn = _binary_counts(family, model, static, data, meta, w)
+    return 2 * tp / jnp.maximum(2 * tp + fp + fn, EPS)
+
+
+def _precision(family, model, static, data, meta, w):
+    tp, fp, fn = _binary_counts(family, model, static, data, meta, w)
+    return tp / jnp.maximum(tp + fp, EPS)
+
+
+def _recall(family, model, static, data, meta, w):
+    tp, fp, fn = _binary_counts(family, model, static, data, meta, w)
+    return tp / jnp.maximum(tp + fn, EPS)
+
+
+def _f1_macro(family, model, static, data, meta, w):
+    pred = family.predict(model, static, data["X"], meta)
+    y = data["y"]
+    k = meta["n_classes"]
+
+    def per_class(c):
+        tp = jnp.sum(w * ((pred == c) & (y == c)))
+        fp = jnp.sum(w * ((pred == c) & (y != c)))
+        fn = jnp.sum(w * ((pred != c) & (y == c)))
+        return 2 * tp / jnp.maximum(2 * tp + fp + fn, EPS)
+
+    return jnp.mean(jax.vmap(per_class)(jnp.arange(k)))
+
+
+def _roc_auc(family, model, static, data, meta, w):
+    """Weighted binary AUC via the rank/Mann-Whitney statistic."""
+    s = family.decision(model, static, data["X"], meta)
+    y = data["y"].astype(s.dtype)
+    order = jnp.argsort(s)
+    s_s, y_s, w_s = s[order], y[order], w[order]
+    # weighted rank = cumulative weight; ties handled approximately (exact
+    # tie-averaging needs segment means — acceptable for continuous margins)
+    cw = jnp.cumsum(w_s) - 0.5 * w_s
+    pos = jnp.sum(w_s * y_s)
+    neg = jnp.sum(w_s * (1.0 - y_s))
+    rank_pos = jnp.sum(w_s * y_s * cw)
+    return (rank_pos - 0.5 * pos * pos) / jnp.maximum(pos * neg, EPS)
+
+
+def _r2(family, model, static, data, meta, w):
+    pred = family.predict(model, static, data["X"], meta)
+    y = data["y"]
+    ybar = jnp.sum(w * y) / _wsum(w)
+    ss_res = jnp.sum(w * (y - pred) ** 2)
+    ss_tot = jnp.sum(w * (y - ybar) ** 2)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, EPS)
+
+
+def _neg_mse(family, model, static, data, meta, w):
+    pred = family.predict(model, static, data["X"], meta)
+    return -(jnp.sum(w * (data["y"] - pred) ** 2) / _wsum(w))
+
+
+def _neg_rmse(family, model, static, data, meta, w):
+    return -jnp.sqrt(-_neg_mse(family, model, static, data, meta, w))
+
+
+def _neg_mae(family, model, static, data, meta, w):
+    pred = family.predict(model, static, data["X"], meta)
+    return -(jnp.sum(w * jnp.abs(data["y"] - pred)) / _wsum(w))
+
+
+def _neg_median_ae(family, model, static, data, meta, w):
+    # weighted median via sorting on |err| with mask-weights
+    pred = family.predict(model, static, data["X"], meta)
+    err = jnp.abs(data["y"] - pred)
+    order = jnp.argsort(err)
+    e_s, w_s = err[order], w[order]
+    cw = jnp.cumsum(w_s)
+    half = 0.5 * jnp.sum(w_s)
+    idx = jnp.searchsorted(cw, half)
+    return -e_s[jnp.clip(idx, 0, err.shape[0] - 1)]
+
+
+def _max_error(family, model, static, data, meta, w):
+    pred = family.predict(model, static, data["X"], meta)
+    return -jnp.max(w * jnp.abs(data["y"] - pred))
+
+
+SCORERS: Dict[str, Callable] = {
+    "accuracy": _accuracy,
+    "neg_log_loss": _neg_log_loss,
+    "f1": _f1,
+    "f1_macro": _f1_macro,
+    "precision": _precision,
+    "recall": _recall,
+    "roc_auc": _roc_auc,
+    "r2": _r2,
+    "neg_mean_squared_error": _neg_mse,
+    "neg_root_mean_squared_error": _neg_rmse,
+    "neg_mean_absolute_error": _neg_mae,
+    "neg_median_absolute_error": _neg_median_ae,
+    "max_error": _max_error,
+}
+
+
+def resolve_scoring(scoring, family):
+    """scoring arg -> ordered {name: jax scorer}.  None uses the estimator
+    default (accuracy / r2) like sklearn's check_scoring."""
+    if scoring is None:
+        name = "accuracy" if family.is_classifier else "r2"
+        return {"score": SCORERS[name]}, "score"
+    if isinstance(scoring, str):
+        if scoring not in SCORERS:
+            raise KeyError(
+                f"scoring={scoring!r} has no compiled implementation; "
+                f"available: {sorted(SCORERS)} (or use backend='host')")
+        return {"score": SCORERS[scoring]}, "score"
+    if isinstance(scoring, (list, tuple, set)):
+        return {s: SCORERS[s] for s in scoring}, None
+    if isinstance(scoring, dict):
+        out = {}
+        for name, s in scoring.items():
+            if not isinstance(s, str) or s not in SCORERS:
+                raise KeyError(
+                    f"multimetric entry {name}={s!r} not compiled; use "
+                    f"backend='host'")
+            out[name] = SCORERS[s]
+        return out, None
+    raise TypeError(f"Unsupported scoring spec for the compiled path: "
+                    f"{scoring!r}; use backend='host'")

@@ -1,0 +1,82 @@
+"""Sparse row-matrix container — the UDT replacement.
+
+The reference's CSRVectorUDT (reference: python/spark_sklearn/udt.py) teaches
+Spark DataFrames to carry scipy `csr_matrix` rows so sparse features reach
+sklearn without densifying.  There is no Spark SQL engine here; the
+equivalent capability is a typed container that moves CSR data between
+scipy, numpy (pandas cells), and JAX:
+
+  - `CSRMatrix.from_scipy` / `.to_scipy` — lossless scipy round trip
+  - `.to_dense()` — jnp dense array (the TPU compute format; XLA has no
+    first-class CSR, and for MXU-sized problems dense is the fast path)
+  - `.to_bcoo()` — `jax.experimental.sparse.BCOO` for genuinely sparse
+    compute
+  - `.serialize()` / `CSRMatrix.deserialize` — the UDT contract (sqlType/
+    serialize/deserialize) as a plain tuple-of-arrays schema
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class CSRMatrix:
+    """Compressed sparse row matrix: (data, indices, indptr, shape)."""
+
+    def __init__(self, data, indices, indptr, shape: Tuple[int, int]):
+        self.data = np.asarray(data)
+        self.indices = np.asarray(indices, dtype=np.int32)
+        self.indptr = np.asarray(indptr, dtype=np.int32)
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    # -- scipy bridge ----------------------------------------------------
+    @classmethod
+    def from_scipy(cls, m) -> "CSRMatrix":
+        m = m.tocsr()
+        return cls(m.data, m.indices, m.indptr, m.shape)
+
+    def to_scipy(self):
+        from scipy.sparse import csr_matrix
+        return csr_matrix((self.data, self.indices, self.indptr),
+                          shape=self.shape)
+
+    # -- device bridges --------------------------------------------------
+    def to_dense(self, dtype=np.float32):
+        import jax.numpy as jnp
+        return jnp.asarray(self.to_scipy().toarray().astype(dtype))
+
+    def to_bcoo(self, dtype=np.float32):
+        from jax.experimental import sparse as jsparse
+        coo = self.to_scipy().tocoo()
+        idx = np.stack([coo.row, coo.col], axis=1).astype(np.int32)
+        return jsparse.BCOO(
+            (coo.data.astype(dtype), idx), shape=self.shape)
+
+    # -- UDT-style serialization (reference: udt.py sqlType/serialize) ---
+    def serialize(self):
+        return (self.data, self.indices, self.indptr,
+                np.asarray(self.shape, dtype=np.int64))
+
+    @classmethod
+    def deserialize(cls, datum) -> "CSRMatrix":
+        data, indices, indptr, shape = datum
+        return cls(data, indices, indptr, tuple(int(s) for s in shape))
+
+    # -- conveniences ----------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(len(self.data))
+
+    def __repr__(self):
+        return (f"CSRMatrix(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.data.dtype})")
+
+    def __eq__(self, other):
+        if not isinstance(other, CSRMatrix):
+            return NotImplemented
+        return (self.shape == other.shape
+                and np.array_equal(self.data, other.data)
+                and np.array_equal(self.indices, other.indices)
+                and np.array_equal(self.indptr, other.indptr))

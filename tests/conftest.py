@@ -1,0 +1,42 @@
+"""Test configuration: emulate an 8-device mesh on CPU.
+
+The reference tests the "distributed" paths on a single machine with a real
+`local[*]` SparkContext (reference: test_utils.py MLlibTestCase — SURVEY §4).
+The analog here: force the host platform and split it into 8 virtual XLA
+devices, so every sharding/collective path executes for real in one process.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+# the machine's axon sitecustomize imports jax before this conftest runs, so
+# the env var alone is too late — force the platform through the live config
+# (backends have not initialised yet at collection time)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def digits():
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)
+    return (X / 16.0).astype(np.float32), y
+
+
+@pytest.fixture(scope="session")
+def diabetes():
+    from sklearn.datasets import load_diabetes
+    X, y = load_diabetes(return_X_y=True)
+    # standardise for solver conditioning parity
+    X = ((X - X.mean(0)) / (X.std(0) + 1e-12)).astype(np.float32)
+    return X, y.astype(np.float32)

@@ -1,0 +1,268 @@
+"""Component tests: Converter, KeyedEstimator/KeyedModel, gapply, CSRMatrix,
+multimetric scoring — the reference's non-search features (SURVEY §2.2 rows
+3-6) plus regression tests for review findings.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.cluster import KMeans
+from sklearn.decomposition import PCA
+from sklearn.linear_model import LinearRegression as SkLinReg
+from sklearn.linear_model import LogisticRegression as SkLogReg
+
+import spark_sklearn_tpu as sst
+
+
+@pytest.fixture()
+def keyed_df():
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "k": np.repeat(["a", "b", "c"], 30),
+        "x": [rng.normal(size=4) for _ in range(90)],
+    })
+    slopes = {"a": 1.0, "b": -2.0, "c": 0.5}
+    df["y"] = [slopes[k] * v.sum() + 0.01 * rng.normal()
+               for k, v in zip(df.k, df.x)]
+    return df
+
+
+class TestConverter:
+    def test_logreg_roundtrip(self, digits):
+        X, y = digits
+        sk = SkLogReg(max_iter=100).fit(X, y)
+        conv = sst.Converter()
+        tm = conv.toTPU(sk)
+        assert np.mean(tm.predict(X[:100]) == sk.predict(X[:100])) == 1.0
+        back = conv.toSKLearn(tm)
+        np.testing.assert_allclose(back.coef_, sk.coef_)
+        np.testing.assert_array_equal(back.classes_, sk.classes_)
+        assert np.all(back.predict(X[:100]) == sk.predict(X[:100]))
+
+    def test_linreg_roundtrip(self, diabetes):
+        X, y = diabetes
+        sk = SkLinReg().fit(X, y)
+        conv = sst.Converter()
+        tm = conv.toTPU(sk)
+        np.testing.assert_allclose(
+            tm.predict(X[:20]), sk.predict(X[:20]), rtol=1e-4, atol=1e-2)
+        back = conv.toSKLearn(tm)
+        np.testing.assert_allclose(back.coef_, sk.coef_, rtol=1e-6)
+
+    def test_unsupported_model_raises(self, digits):
+        X, y = digits
+        km = KMeans(n_clusters=2, n_init=2).fit(X)
+        with pytest.raises(ValueError, match="no registered TPU family"):
+            sst.Converter().toTPU(km)
+
+    def test_legacy_sc_arg(self):
+        assert sst.Converter(object()) is not None
+
+    def test_topandas_cells(self):
+        import scipy.sparse as sp
+        m = sp.random(3, 5, density=0.5, format="csr", random_state=0)
+        df = pd.DataFrame({
+            "a": [1, 2, 3],
+            "v": [np.ones(2), np.zeros(2), np.arange(2.0)],
+            "s": [sst.CSRMatrix.from_scipy(m[i]) for i in range(3)],
+        })
+        out = sst.Converter().toPandas(df)
+        assert out["s"][0].shape == (5,)
+        np.testing.assert_allclose(out["s"][1], m[1].toarray().ravel())
+
+
+class TestKeyedModels:
+    def test_predictor_fleet(self, keyed_df):
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        out = km.transform(keyed_df)
+        assert np.max(np.abs(out["output"] - keyed_df["y"])) < 0.1
+        assert len(km.keyedModels) == 3
+        assert set(km.keyedModels.columns) == {"k", "estimator"}
+
+    def test_transformer_fleet(self, keyed_df):
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=PCA(n_components=2), keyCols=["k"], xCol="x",
+            estimatorType="transformer")
+        out = ke.fit(keyed_df).transform(keyed_df)
+        assert out["output"].iloc[0].shape == (2,)
+
+    def test_clusterer_fleet(self, keyed_df):
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=KMeans(n_clusters=2, n_init=2), keyCols=["k"],
+            xCol="x", estimatorType="clusterer")
+        out = ke.fit(keyed_df).transform(keyed_df)
+        assert out["output"].dtype == np.int64
+
+    def test_unseen_key_gives_nan(self, keyed_df):
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        out = km.transform(pd.DataFrame(
+            {"k": ["zz"], "x": [np.zeros(4)]}))
+        assert np.isnan(out["output"].iloc[0])
+
+    def test_duplicate_index_labels(self, keyed_df):
+        """Regression: .loc-based reassembly multiplied rows (review #2)."""
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        dup = pd.concat([keyed_df.head(2), keyed_df.head(2)])
+        out = km.transform(dup)
+        assert len(out) == 4
+
+    def test_nan_key_row_kept(self, keyed_df):
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        df = pd.DataFrame({"k": ["a", None], "x": [np.zeros(4)] * 2,
+                           "y": [0.0, 0.0]})
+        out = km.transform(df)
+        assert len(out) == 2
+        assert np.isnan(out["output"].iloc[1])
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            sst.KeyedEstimator()
+        with pytest.raises(ValueError):
+            sst.KeyedEstimator(sklearnEstimator=SkLinReg(),
+                               estimatorType="oracle")
+        with pytest.raises(ValueError):
+            sst.KeyedEstimator(sklearnEstimator=PCA(), yCol="y")
+
+    def test_missing_column_raises(self, keyed_df):
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["nope"], xCol="x",
+            yCol="y")
+        with pytest.raises(KeyError):
+            ke.fit(keyed_df)
+
+
+class TestGapply:
+    def test_basic_sum(self):
+        df = pd.DataFrame({"g": [1, 1, 2, 2, 2], "v": [1., 2., 3., 4., 5.]})
+        out = sst.gapply(df.groupby("g"),
+                         lambda k, p: pd.DataFrame({"s": [p.v.sum()]}),
+                         [("s", "float64")])
+        assert out.to_dict("list") == {"g": [1, 2], "s": [3.0, 12.0]}
+
+    def test_oracle_vs_pandas_groupby(self):
+        """Property-style oracle the reference used (test_gapply.py vs a
+        pandas groupby oracle — SURVEY §4)."""
+        rng = np.random.default_rng(1)
+        df = pd.DataFrame({
+            "a": rng.integers(0, 5, 100),
+            "b": rng.integers(0, 3, 100),
+            "v": rng.normal(size=100),
+        })
+        out = sst.gapply(
+            df.groupby(["a", "b"]),
+            lambda k, p: pd.DataFrame({"m": [p.v.mean()]}),
+            [("m", "float64")])
+        oracle = df.groupby(["a", "b"])["v"].mean().reset_index(name="m")
+        pd.testing.assert_frame_equal(
+            out.sort_values(["a", "b"]).reset_index(drop=True),
+            oracle.sort_values(["a", "b"]).reset_index(drop=True),
+            check_dtype=False)
+
+    def test_no_retain_group_columns(self):
+        df = pd.DataFrame({"g": [1, 1, 2], "v": [1., 2., 3.]})
+        out = sst.gapply(df.groupby("g"),
+                         lambda k, p: pd.DataFrame({"s": [p.v.sum()]}),
+                         [("s", "float64")], retainGroupColumns=False)
+        assert list(out.columns) == ["s"]
+
+    def test_func_emits_key_column(self):
+        """Regression: insert collision when func returns the key (review
+        #5)."""
+        df = pd.DataFrame({"g": [1, 1, 2], "v": [1., 2., 3.]})
+        out = sst.gapply(
+            df.groupby("g"),
+            lambda k, p: pd.DataFrame({"g": [k[0]], "s": [p.v.sum()]}),
+            None)
+        assert set(out.columns) == {"g", "s"}
+
+    def test_multirow_output_and_tuple_form(self):
+        df = pd.DataFrame({"g": [1, 1, 2], "v": [1., 2., 3.]})
+        out = sst.gapply(
+            (df, "g"),
+            lambda k, p: pd.DataFrame({"v2": p.v * 2}),
+            [("v2", "float64")])
+        assert len(out) == 3
+        assert list(out["v2"]) == [2., 4., 6.]
+
+    def test_schema_dtype_cast(self):
+        df = pd.DataFrame({"g": [1, 2], "v": [1., 2.]})
+        out = sst.gapply(df.groupby("g"),
+                         lambda k, p: pd.DataFrame({"s": [int(p.v.sum())]}),
+                         {"s": "int32"})
+        assert out["s"].dtype == np.int32
+
+
+class TestCSR:
+    def test_roundtrips(self):
+        import scipy.sparse as sp
+        m = sp.random(10, 7, density=0.3, format="csr", random_state=0)
+        c = sst.CSRMatrix.from_scipy(m)
+        assert np.allclose(c.to_scipy().toarray(), m.toarray())
+        assert np.allclose(np.asarray(c.to_dense()), m.toarray())
+        assert sst.CSRMatrix.deserialize(c.serialize()) == c
+        assert c.nnz == m.nnz
+
+    def test_bcoo(self):
+        import scipy.sparse as sp
+        m = sp.random(5, 5, density=0.4, format="csr", random_state=1)
+        c = sst.CSRMatrix.from_scipy(m)
+        b = c.to_bcoo()
+        assert np.allclose(np.asarray(b.todense()), m.toarray())
+
+
+class TestMultimetric:
+    def test_multimetric_compiled(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [0.1, 1.0]}, cv=3,
+            scoring=["accuracy", "neg_log_loss"],
+            refit="accuracy").fit(X, y)
+        assert gs.multimetric_
+        for s in ("accuracy", "neg_log_loss"):
+            assert f"mean_test_{s}" in gs.cv_results_
+            assert f"rank_test_{s}" in gs.cv_results_
+        # regression (review #1): scorer_ must hold sklearn-callable
+        # scorers after a compiled multimetric fit
+        val = gs.score(X, y)
+        assert 0.9 < val <= 1.0
+
+    def test_multimetric_requires_refit_name(self, digits):
+        X, y = digits
+        with pytest.raises(ValueError, match="refit must be set"):
+            sst.GridSearchCV(
+                SkLogReg(max_iter=50), {"C": [1.0]}, cv=3,
+                scoring=["accuracy", "f1_macro"]).fit(X, y)
+
+
+class TestFamilyResolution:
+    def test_third_party_lookalike_not_hijacked(self, digits):
+        """Regression (review #4): a non-sklearn class named
+        LogisticRegression must go to Tier B, not the compiled family."""
+        from spark_sklearn_tpu.models.base import resolve_family
+
+        class LogisticRegression:  # deliberately shadowing name
+            def get_params(self, deep=False):
+                return {}
+
+            def fit(self, X, y):
+                return self
+
+        assert resolve_family(LogisticRegression()) is None
+
+    def test_class_weight_falls_back_to_host(self, digits):
+        """Regression (review #3): class_weight must not be silently
+        dropped by the compiled family."""
+        X, y = digits
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                SkLogReg(max_iter=100, class_weight="balanced"),
+                {"C": [1.0]}, cv=3).fit(X, y)
+        assert gs.best_score_ > 0.9

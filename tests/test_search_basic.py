@@ -1,0 +1,192 @@
+"""Oracle tests for the flagship path (SURVEY §7.2): our GridSearchCV on a
+virtual 8-device mesh vs sklearn's serial GridSearchCV on the same splits.
+
+This is the reference's single most important testing idea transplanted
+(SURVEY §4): the reference vendored sklearn's own search tests and re-pointed
+them at spark_sklearn.GridSearchCV(sc, ...); here the oracle is sklearn run
+serially, scores must agree to float32-training tolerance and the
+cv_results_ key schema must agree exactly.
+"""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LogisticRegression as SkLogReg
+from sklearn.linear_model import Ridge as SkRidge
+from sklearn.model_selection import GridSearchCV as SkGridSearchCV
+from sklearn.model_selection import KFold, StratifiedKFold
+
+import spark_sklearn_tpu as sst
+
+
+def _expected_keys(n_splits, scorer="score", train=False):
+    keys = {"mean_fit_time", "std_fit_time", "mean_score_time",
+            "std_score_time", "params",
+            f"mean_test_{scorer}", f"std_test_{scorer}",
+            f"rank_test_{scorer}"}
+    keys |= {f"split{i}_test_{scorer}" for i in range(n_splits)}
+    if train:
+        keys |= {f"mean_train_{scorer}", f"std_train_{scorer}"}
+        keys |= {f"split{i}_train_{scorer}" for i in range(n_splits)}
+    return keys
+
+
+class TestGridSearchLogReg:
+    def test_matches_sklearn_oracle(self, digits):
+        X, y = digits
+        grid = {"C": [0.01, 0.1, 1.0, 10.0]}
+        cv = StratifiedKFold(n_splits=3)
+
+        ours = sst.GridSearchCV(
+            SkLogReg(max_iter=200), grid, cv=cv).fit(X, y)
+        theirs = SkGridSearchCV(
+            SkLogReg(max_iter=200), grid, cv=cv).fit(X, y)
+
+        a = ours.cv_results_["mean_test_score"]
+        b = theirs.cv_results_["mean_test_score"]
+        np.testing.assert_allclose(a, b, atol=5e-3)
+        assert ours.best_params_ == theirs.best_params_
+        # schema parity (sklearn _search.py:1208-1290)
+        assert _expected_keys(3) <= set(ours.cv_results_)
+        assert "param_C" in ours.cv_results_
+        assert isinstance(ours.cv_results_["param_C"], np.ma.MaskedArray)
+
+    def test_best_estimator_predicts(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [0.1, 1.0]}, cv=3).fit(X, y)
+        assert gs.best_estimator_ is not None
+        assert gs.predict(X[:10]).shape == (10,)
+        assert gs.score(X, y) > 0.9
+        assert gs.refit_time_ > 0
+        assert gs.n_splits_ == 3
+        assert not gs.multimetric_
+        assert np.array_equal(gs.classes_, np.unique(y))
+
+    def test_legacy_sc_convention(self, digits):
+        """Reference API: GridSearchCV(sc, estimator, grid) — grid_search.py."""
+        X, y = digits
+
+        class FakeSparkContext:
+            pass
+
+        gs = sst.GridSearchCV(
+            FakeSparkContext(), SkLogReg(max_iter=50), {"C": [1.0]},
+            cv=3).fit(X, y)
+        assert gs.best_score_ > 0.9
+
+    def test_return_train_score(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [0.1, 1.0]}, cv=3,
+            return_train_score=True).fit(X, y)
+        assert _expected_keys(3, train=True) <= set(gs.cv_results_)
+        # train score >= test score in aggregate for this easy problem
+        assert (gs.cv_results_["mean_train_score"].mean()
+                >= gs.cv_results_["mean_test_score"].mean() - 1e-3)
+
+    def test_multinomial_and_binary(self, digits):
+        X, y = digits
+        # binary subset
+        m = y < 2
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [1.0]}, cv=3).fit(X[m], y[m])
+        assert gs.best_score_ > 0.98
+
+    def test_verbose_prints(self, digits, capsys):
+        X, y = digits
+        sst.GridSearchCV(
+            SkLogReg(max_iter=50), {"C": [1.0, 2.0]}, cv=3,
+            verbose=1).fit(X, y)
+        out = capsys.readouterr().out
+        assert "Fitting 3 folds for each of 2 candidates" in out
+
+
+class TestGridSearchRidge:
+    def test_ridge_oracle(self, diabetes):
+        X, y = diabetes
+        grid = {"alpha": [0.1, 1.0, 10.0, 100.0]}
+        cv = KFold(n_splits=4)
+        ours = sst.GridSearchCV(SkRidge(), grid, cv=cv).fit(X, y)
+        theirs = SkGridSearchCV(SkRidge(), grid, cv=cv).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=2e-3)
+        assert ours.best_params_ == theirs.best_params_
+
+
+class TestRandomizedSearch:
+    def test_randomized_matches_sampler(self, digits):
+        X, y = digits
+        from scipy.stats import loguniform
+        dist = {"C": loguniform(1e-3, 1e2)}
+        ours = sst.RandomizedSearchCV(
+            SkLogReg(max_iter=100), dist, n_iter=5, cv=3,
+            random_state=42).fit(X, y)
+        theirs = sst.RandomizedSearchCV(
+            SkLogReg(max_iter=100), dist, n_iter=5, cv=3,
+            random_state=42, backend="host").fit(X, y)
+        # same random_state -> identical candidates (sklearn ParameterSampler)
+        assert [p["C"] for p in ours.cv_results_["params"]] == \
+               [p["C"] for p in theirs.cv_results_["params"]]
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=5e-3)
+
+
+class TestTierBFallback:
+    def test_unregistered_estimator_runs_on_host(self, digits):
+        X, y = digits
+        from sklearn.tree import DecisionTreeClassifier
+        gs = sst.GridSearchCV(
+            DecisionTreeClassifier(random_state=0),
+            {"max_depth": [2, 4]}, cv=3).fit(X, y)
+        assert set(gs.cv_results_["params"][0]) == {"max_depth"}
+        assert gs.best_score_ > 0.5
+
+    def test_host_backend_forced(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [1.0]}, cv=3,
+            backend="host").fit(X, y)
+        assert gs.best_score_ > 0.9
+
+
+class TestErrorScore:
+    def test_error_score_masks_failures(self, digits):
+        X, y = digits
+        # C large enough to overflow float32 exp -> non-finite path exercised
+        # by an impossible tol; instead force failure via Tier B with a
+        # broken estimator
+        from sklearn.base import BaseEstimator, ClassifierMixin
+
+        class Broken(ClassifierMixin, BaseEstimator):
+            def __init__(self, fail=True):
+                self.fail = fail
+
+            def fit(self, X, y):
+                if self.fail:
+                    raise ValueError("boom")
+                self.classes_ = np.unique(y)
+                return self
+
+            def predict(self, X):
+                return np.zeros(len(X), dtype=int)
+
+        with pytest.warns(UserWarning):
+            gs = sst.GridSearchCV(
+                Broken(), {"fail": [True, False]}, cv=3,
+                error_score=0.0).fit(X, y)
+        assert gs.cv_results_["mean_test_score"][0] == 0.0
+
+
+class TestCompileGroups:
+    def test_mixed_static_dynamic_grid(self, digits):
+        """penalty=None vs l2 forces two compile groups (SURVEY §7.3 #3)."""
+        X, y = digits
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100),
+            [{"C": [0.5, 1.0], "penalty": ["l2"]},
+             {"penalty": [None]}],
+            cv=3).fit(X, y)
+        assert len(gs.cv_results_["params"]) == 3
+        assert np.all(np.isfinite(gs.cv_results_["mean_test_score"]))
