@@ -69,13 +69,17 @@ class Converter:
         self._sc = sc  # accepted for reference API compatibility; unused
 
     # -- sklearn -> TPU (reference: toSpark) -----------------------------
+    #: families whose fitted state is representable as (coef, intercept)
+    _CONVERTIBLE = {"logistic_regression", "ridge", "linear_regression",
+                    "elastic_net"}
+
     def toTPU(self, sklearn_model) -> TpuModel:
         import jax.numpy as jnp
         family = resolve_family(sklearn_model)
-        if family is None:
+        if family is None or family.name not in self._CONVERTIBLE:
             raise ValueError(
-                f"Cannot convert {type(sklearn_model).__name__}: no "
-                f"registered TPU family (reference Converter supports "
+                f"Cannot convert {type(sklearn_model).__name__}: not a "
+                f"linear-model family (reference Converter supports "
                 f"LogisticRegression/LinearRegression only; this one also "
                 f"covers Ridge/ElasticNet/Lasso)")
         if not hasattr(sklearn_model, "coef_"):

@@ -51,6 +51,9 @@ def resolve_family(estimator) -> Optional[Any]:
     """
     cls = type(estimator)
     qn = _qualname(cls)
+    if qn == "sklearn.pipeline.Pipeline":
+        from spark_sklearn_tpu.models.pipeline import make_pipeline_family
+        return make_pipeline_family(estimator)
     if qn in _FAMILIES_BY_CLASSNAME:
         return _FAMILIES_BY_CLASSNAME[qn]
     # tolerate sklearn's private-module shuffling, but ONLY for sklearn

@@ -112,6 +112,121 @@ class LogisticRegressionFamily(Family):
                     "converged": res.converged, "n_iter": res.n_iter}
 
     @classmethod
+    def fit_task_batched(cls, dynamic, static, data, train_w, meta):
+        """All (candidate x fold) tasks as ONE wide-matmul program.
+
+        `dynamic` leaves and `train_w` carry a leading task axis B; the
+        logits for every task come from a single `X @ W_all` contraction of
+        width B*k, which keeps the MXU tiles full (a vmap of per-task fits
+        leaves them mostly empty for small k).  Returns model pytrees with
+        leading axis B.
+        """
+        from spark_sklearn_tpu.ops.solvers import glm_lbfgs_batched
+
+        X = data["X"]
+        n, d = X.shape
+        k = meta["n_classes"]
+        B = train_w.shape[0]
+        C = jnp.asarray(dynamic.get("C", static.get("C", 1.0)), X.dtype)
+        C = jnp.broadcast_to(C, (B,))
+        tol = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("tol", static.get("tol", 1e-4)), X.dtype), (B,))
+        max_iter = int(static.get("max_iter", 100))
+        fit_intercept = bool(static.get("fit_intercept", True))
+        penalty = static.get("penalty", "l2")
+        l1_ratio = static.get("l1_ratio", 0.0)
+        if penalty == "deprecated":
+            penalty = "l2" if not l1_ratio else "elasticnet"
+        if penalty not in ("l2", None, "none"):
+            raise ValueError(
+                f"penalty={penalty!r} is not compiled; use the host backend")
+        if static.get("class_weight") is not None:
+            raise ValueError(
+                "class_weight is not compiled; use the host backend")
+        inv_C = (1.0 / C) if penalty == "l2" else jnp.zeros_like(C)
+        wT = train_w.T                                        # (n, B)
+
+        if k == 2:
+            yb = data["y"].astype(X.dtype)                    # (n,)
+
+            def Ax(x):                                        # -> Z (n, B)
+                Z = X @ x[:, :d].T                            # ONE matmul
+                return Z + x[None, :, d] if fit_intercept else Z
+
+            def data_loss(Z):
+                per = jnp.logaddexp(0.0, Z) - yb[:, None] * Z
+                return jnp.sum(wT * per, axis=0)
+
+            def data_grad(Z):                                 # dL/dZ (n, B)
+                return wT * (jax.nn.sigmoid(Z) - yb[:, None])
+
+            def AT(G):                                        # -> (B, d+1)
+                gW = G.T @ X                                  # ONE matmul
+                gb = jnp.sum(G, axis=0) if fit_intercept else \
+                    jnp.zeros((B,), X.dtype)
+                return jnp.concatenate([gW, gb[:, None]], axis=1)
+
+            def reg_loss(x):
+                return 0.5 * inv_C * jnp.sum(x[:, :d] ** 2, axis=1)
+
+            def reg_grad(x):
+                g = inv_C[:, None] * x[:, :d]
+                return jnp.concatenate(
+                    [g, jnp.zeros((B, 1), X.dtype)], axis=1)
+
+            res = glm_lbfgs_batched(
+                Ax, data_loss, data_grad, AT, reg_loss, reg_grad,
+                jnp.zeros((B, d + 1), X.dtype), max_iter=max_iter, tol=tol)
+            W = res.x[:, :d]
+            b = res.x[:, d]
+            if not fit_intercept:
+                b = jnp.zeros_like(b)
+            return {"coef": W[:, None, :], "intercept": b[:, None],
+                    "converged": res.converged, "n_iter": res.n_iter}
+
+        y1h = data["y1h"]                                     # (n, k)
+        kd = k * d
+
+        def Ax(x):                                            # -> Z (n,B,k)
+            W = x[:, :kd].reshape(B, k, d)
+            Z = jnp.einsum("nd,bkd->nbk", X, W)               # ONE matmul
+            return Z + x[None, :, kd:] if fit_intercept else Z
+
+        def data_loss(Z):
+            lse = jax.scipy.special.logsumexp(Z, axis=2)      # (n, B)
+            fit_term = lse - jnp.einsum("nbk,nk->nb", Z, y1h)
+            return jnp.sum(wT * fit_term, axis=0)
+
+        def data_grad(Z):                                     # (n, B, k)
+            P = jax.nn.softmax(Z, axis=2)
+            return wT[:, :, None] * (P - y1h[:, None, :])
+
+        def AT(G):                                            # -> (B, D)
+            gW = jnp.einsum("nbk,nd->bkd", G, X)              # ONE matmul
+            gW = gW.reshape(B, kd)
+            gb = jnp.sum(G, axis=0) if fit_intercept else \
+                jnp.zeros((B, k), X.dtype)
+            return jnp.concatenate([gW, gb], axis=1)
+
+        def reg_loss(x):
+            return 0.5 * inv_C * jnp.sum(x[:, :kd] ** 2, axis=1)
+
+        def reg_grad(x):
+            g = inv_C[:, None] * x[:, :kd]
+            return jnp.concatenate(
+                [g, jnp.zeros((B, k), X.dtype)], axis=1)
+
+        res = glm_lbfgs_batched(
+            Ax, data_loss, data_grad, AT, reg_loss, reg_grad,
+            jnp.zeros((B, kd + k), X.dtype), max_iter=max_iter, tol=tol)
+        W = res.x[:, :kd].reshape(B, k, d)
+        b = res.x[:, kd:]
+        if not fit_intercept:
+            b = jnp.zeros_like(b)
+        return {"coef": W, "intercept": b,
+                "converged": res.converged, "n_iter": res.n_iter}
+
+    @classmethod
     def decision(cls, model, static, X, meta):
         Z = X @ model["coef"].T + model["intercept"]
         if meta["n_classes"] == 2:

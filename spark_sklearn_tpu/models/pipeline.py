@@ -1,0 +1,130 @@
+"""Compiled sklearn Pipeline support.
+
+Reference behavior: a Pipeline is just another estimator cloned and fitted
+whole inside each Spark task, with grid keys like "mlp__alpha" routed by
+sklearn's set_params (BASELINE config #5).  Here a Pipeline whose
+transformers are all registered preprocessing steps and whose final step is
+a compiled family becomes a **fused family**: transformer statistics are
+weighted by the fold mask, the transform feeds the final fit inside the same
+XLA program (no materialised intermediates), and "step__param" grid keys are
+routed to dynamic/static leaves (SURVEY §7.3 hard part #5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_sklearn_tpu.models import preprocessing as prep
+from spark_sklearn_tpu.models.base import resolve_family
+
+
+class PipelineFamily:
+    """Instance-level family (duck-typed to the Family protocol) built for a
+    concrete sklearn Pipeline."""
+
+    def __init__(self, steps: List[Tuple[str, Any]], final_name: str,
+                 final_family):
+        self.steps = steps              # [(name, StepImpl), ...] transformers
+        self.final_name = final_name
+        self.final = final_family
+        self.name = f"pipeline({'+'.join(n for n, _ in steps)}" \
+                    f"+{final_family.name})"
+        self.is_classifier = final_family.is_classifier
+        self.dynamic_params = {
+            f"{final_name}__{k}": v
+            for k, v in final_family.dynamic_params.items()
+        }
+
+    # -- host side -------------------------------------------------------
+    def extract_params(self, estimator) -> Dict[str, Any]:
+        out = {}
+        for sname, step_est in estimator.named_steps.items():
+            for k, v in step_est.get_params(deep=False).items():
+                out[f"{sname}__{k}"] = v
+        return out
+
+    def prepare_data(self, X, y, dtype=np.float32):
+        return self.final.prepare_data(X, y, dtype=dtype)
+
+    def _split_static(self, static):
+        per_step: Dict[str, Dict[str, Any]] = {n: {} for n, _ in self.steps}
+        per_step[self.final_name] = {}
+        for key, v in static.items():
+            if "__" not in key:
+                continue
+            sname, pname = key.split("__", 1)
+            if sname in per_step:
+                per_step[sname][pname] = v
+        return per_step
+
+    # -- device side -----------------------------------------------------
+    def fit(self, dynamic, static, data, train_w, meta):
+        per_step = self._split_static(static)
+        final_dynamic = {
+            k.split("__", 1)[1]: v for k, v in dynamic.items()
+            if k.startswith(f"{self.final_name}__")
+        }
+        X = data["X"]
+        states = []
+        for sname, step in self.steps:
+            st = step.fit(per_step[sname], X, train_w)
+            X = step.apply(per_step[sname], st, X)
+            states.append(st)
+        final_model = self.final.fit(
+            final_dynamic, per_step[self.final_name],
+            {**data, "X": X}, train_w, meta)
+        return {"steps": states, "final": final_model}
+
+    def _transform(self, model, static, X):
+        per_step = self._split_static(static)
+        for (sname, step), st in zip(self.steps, model["steps"]):
+            X = step.apply(per_step[sname], st, X)
+        return X
+
+    def _final_static(self, static):
+        return self._split_static(static)[self.final_name]
+
+    def predict(self, model, static, X, meta):
+        X = self._transform(model, static, X)
+        return self.final.predict(model["final"], self._final_static(static),
+                                  X, meta)
+
+    def decision(self, model, static, X, meta):
+        X = self._transform(model, static, X)
+        return self.final.decision(model["final"],
+                                   self._final_static(static), X, meta)
+
+    def predict_proba(self, model, static, X, meta):
+        X = self._transform(model, static, X)
+        return self.final.predict_proba(
+            model["final"], self._final_static(static), X, meta)
+
+    def sklearn_attrs(self, model, static, meta):
+        return self.final.sklearn_attrs(
+            model["final"], self._final_static(static), meta)
+
+
+def make_pipeline_family(pipeline) -> Optional[PipelineFamily]:
+    """Pipeline instance -> PipelineFamily, or None when any step is outside
+    the compiled registries (-> Tier B host path runs the pipeline whole)."""
+    try:
+        steps = list(pipeline.steps)
+    except AttributeError:
+        return None
+    if not steps:
+        return None
+    *transformers, (final_name, final_est) = steps
+    resolved = []
+    for sname, t in transformers:
+        if t is None or t == "passthrough":
+            continue
+        step = prep.resolve_step(t)
+        if step is None:
+            return None
+        resolved.append((sname, step))
+    final_family = resolve_family(final_est)
+    if final_family is None or isinstance(final_family, PipelineFamily):
+        return None
+    return PipelineFamily(resolved, final_name, final_family)

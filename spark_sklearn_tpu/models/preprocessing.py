@@ -1,0 +1,116 @@
+"""Preprocessing steps as pure, mask-weighted JAX functions.
+
+The reference runs sklearn transformers unchanged inside each Spark task
+(e.g. BASELINE config #5: Pipeline(StandardScaler + MLPClassifier) —
+reference: grid_search.py fits the whole pipeline per task).  Under vmap a
+transformer is a pair of pure functions with the fold expressed as a weight
+mask — `fit_transform` statistics must be *weighted* statistics so each fold
+sees only its training rows while shapes stay fixed:
+
+    fit(static, X, w)          -> state pytree  (weighted stats)
+    apply(static, state, X)    -> X'            (full-length transform)
+
+These are deliberately tiny: XLA fuses them into the downstream matmuls, so
+a pipeline costs nothing extra on TPU (no materialised intermediate the way
+Spark materialises RDDs between stages).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+EPS = 1e-12
+
+
+class StandardScalerStep:
+    name = "standard_scaler"
+    dynamic_params: dict = {}
+
+    @staticmethod
+    def fit(static, X, w):
+        wsum = jnp.sum(w) + EPS
+        with_mean = bool(static.get("with_mean", True))
+        with_std = bool(static.get("with_std", True))
+        mean = (w @ X) / wsum
+        # variance is always about the true mean (sklearn computes var_
+        # even when with_mean=False); only the shift is disabled
+        var = (w @ ((X - mean) ** 2)) / wsum
+        scale = jnp.where(var > 0, jnp.sqrt(var), 1.0)
+        if not with_std:
+            scale = jnp.ones_like(scale)
+        if not with_mean:
+            mean = jnp.zeros_like(mean)
+        return {"mean": mean, "scale": scale}
+
+    @staticmethod
+    def apply(static, state, X):
+        return (X - state["mean"]) / state["scale"]
+
+
+class MinMaxScalerStep:
+    name = "minmax_scaler"
+    dynamic_params: dict = {}
+
+    @staticmethod
+    def fit(static, X, w):
+        big = jnp.asarray(jnp.finfo(X.dtype).max, X.dtype)
+        masked_min = jnp.min(jnp.where(w[:, None] > 0, X, big), axis=0)
+        masked_max = jnp.max(jnp.where(w[:, None] > 0, X, -big), axis=0)
+        lo, hi = static.get("feature_range", (0.0, 1.0))
+        span = masked_max - masked_min
+        scale = jnp.where(span > 0, (hi - lo) / span, 1.0)
+        return {"min": masked_min, "scale": scale, "lo": lo}
+
+    @staticmethod
+    def apply(static, state, X):
+        return (X - state["min"]) * state["scale"] + state["lo"]
+
+
+class MaxAbsScalerStep:
+    name = "maxabs_scaler"
+    dynamic_params: dict = {}
+
+    @staticmethod
+    def fit(static, X, w):
+        m = jnp.max(jnp.abs(X) * (w[:, None] > 0), axis=0)
+        return {"scale": jnp.where(m > 0, m, 1.0)}
+
+    @staticmethod
+    def apply(static, state, X):
+        return X / state["scale"]
+
+
+class NormalizerStep:
+    """Stateless per-row normalisation (norm in l1/l2/max)."""
+
+    name = "normalizer"
+    dynamic_params: dict = {}
+
+    @staticmethod
+    def fit(static, X, w):
+        return {}
+
+    @staticmethod
+    def apply(static, state, X):
+        norm = static.get("norm", "l2")
+        if norm == "l1":
+            d = jnp.sum(jnp.abs(X), axis=1, keepdims=True)
+        elif norm == "max":
+            d = jnp.max(jnp.abs(X), axis=1, keepdims=True)
+        else:
+            d = jnp.linalg.norm(X, axis=1, keepdims=True)
+        return X / jnp.maximum(d, EPS)
+
+
+#: sklearn transformer class name -> step implementation
+STEP_REGISTRY = {
+    "StandardScaler": StandardScalerStep,
+    "MinMaxScaler": MinMaxScalerStep,
+    "MaxAbsScaler": MaxAbsScalerStep,
+    "Normalizer": NormalizerStep,
+}
+
+
+def resolve_step(transformer) -> object | None:
+    return STEP_REGISTRY.get(type(transformer).__name__)

@@ -231,9 +231,36 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         repl = mesh_lib.replicated_sharding(mesh)
         task_shard = mesh_lib.task_sharding(mesh)
 
-        data_dev = {k: jax.device_put(v, repl) for k, v in data.items()}
-        train_dev = jax.device_put(train_masks, repl)
-        test_dev = jax.device_put(test_masks, repl)
+        if config.n_data_shards > 1:
+            # large-X mode: shard samples over the "data" mesh axis instead
+            # of replicating (the TPU-native answer to X not fitting one
+            # chip's HBM) — sample-axis reductions inside the families
+            # become XLA collectives over ICI automatically.  Sample counts
+            # are padded to the shard count with zero-weight rows.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            nd = config.n_data_shards
+            n_pad = mesh_lib.pad_to_multiple(n_samples, nd)
+            if n_pad != n_samples:
+                pad = n_pad - n_samples
+                data = {k: np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                    for k, v in data.items()}
+                train_masks = np.concatenate(
+                    [train_masks, np.zeros((n_folds, pad),
+                                           train_masks.dtype)], axis=1)
+                test_masks = np.concatenate(
+                    [test_masks, np.zeros((n_folds, pad),
+                                          test_masks.dtype)], axis=1)
+            sample_shard = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+            mask_shard = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
+            data_dev = {k: jax.device_put(v, sample_shard)
+                        for k, v in data.items()}
+            train_dev = jax.device_put(train_masks, mask_shard)
+            test_dev = jax.device_put(test_masks, mask_shard)
+        else:
+            data_dev = {k: jax.device_put(v, repl) for k, v in data.items()}
+            train_dev = jax.device_put(train_masks, repl)
+            test_dev = jax.device_put(test_masks, repl)
 
         test_scores = {s: np.empty((n_cand, n_folds)) for s in scorer_names}
         train_scores = ({s: np.empty((n_cand, n_folds))
@@ -245,17 +272,48 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         # most max_tasks_per_batch (candidate x fold) program instances;
         # every chunk of a group is padded to one uniform width so the
         # group's two jitted programs compile exactly once
+        max_tasks = config.max_tasks_per_batch
+        hint = getattr(family, "max_tasks_hint", None)
+        if hint is not None:
+            # families with big per-task workspaces (e.g. SVC kernel and
+            # decision caches) bound their own launch width
+            max_tasks = min(max_tasks, max(n_folds, hint(n_samples, meta)))
         max_cand_per_batch = max(
             n_task_shards,
             mesh_lib.pad_to_multiple(
-                max(1, config.max_tasks_per_batch // max(n_folds, 1)),
+                max(1, max_tasks // max(n_folds, 1)),
                 n_task_shards))
+
+        task_batched = hasattr(family, "fit_task_batched")
+        if config.n_data_shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            tb_mask_shard = NamedSharding(
+                mesh, P(mesh_lib.TASK_AXIS, mesh_lib.DATA_AXIS))
+        else:
+            tb_mask_shard = task_shard
 
         for group in groups:
             static = {**base_params, **group.static_params}
             nc = group.n_candidates
             nc_batch = min(mesh_lib.pad_to_multiple(nc, n_task_shards),
                            max_cand_per_batch)
+
+            if task_batched:
+                # flatten (candidate x fold) into one leading task axis and
+                # let the family turn it into wide-matmul width (candidate-
+                # major order: task t = (cand t//n_folds, fold t%n_folds))
+                w_task = np.tile(train_masks, (nc_batch, 1))
+                w_task_dev = jax.device_put(w_task, tb_mask_shard)
+
+                def fit_batch_tb(dyn_t, data_d, w_t,
+                                 static={**static, "__n_folds__": n_folds}):
+                    model = family.fit_task_batched(
+                        dyn_t, static, data_d, w_t, meta)
+                    return jax.tree_util.tree_map(
+                        lambda l: l.reshape(
+                            (nc_batch, n_folds) + l.shape[1:]), model)
+
+                fit_jit = jax.jit(fit_batch_tb)
 
             def fit_batch(dyn_arrs, data_d, train_m, static=static):
                 def one_cand(dyn_scalars):
@@ -278,7 +336,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                         model_c, test_m, train_m)
                 return jax.vmap(one_cand)(models)
 
-            fit_jit = jax.jit(fit_batch, out_shardings=task_shard)
+            if not task_batched:
+                fit_jit = jax.jit(fit_batch, out_shardings=task_shard)
             score_jit = jax.jit(score_batch)
 
             for lo in range(0, nc, nc_batch):
@@ -291,8 +350,10 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                             [chunk, np.repeat(chunk[-1:],
                                               nc_batch - len(chunk),
                                               axis=0)])
+                    if task_batched:
+                        chunk = np.repeat(chunk, n_folds, axis=0)
                     dyn[k] = jax.device_put(chunk, task_shard)
-                if not dyn:
+                if not dyn and not task_batched:
                     # all-static group: vmap still needs a batched operand
                     # to define the candidate axis (families ignore unknown
                     # keys)
@@ -300,7 +361,10 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                         np.zeros(nc_batch, dtype=dtype), task_shard)
 
                 t0 = time.perf_counter()
-                models = fit_jit(dyn, data_dev, train_dev)
+                if task_batched:
+                    models = fit_jit(dyn, data_dev, w_task_dev)
+                else:
+                    models = fit_jit(dyn, data_dev, train_dev)
                 jax.block_until_ready(models)
                 t_fit = time.perf_counter() - t0
 

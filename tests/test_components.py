@@ -52,7 +52,7 @@ class TestConverter:
     def test_unsupported_model_raises(self, digits):
         X, y = digits
         km = KMeans(n_clusters=2, n_init=2).fit(X)
-        with pytest.raises(ValueError, match="no registered TPU family"):
+        with pytest.raises(ValueError, match="Cannot convert"):
             sst.Converter().toTPU(km)
 
     def test_legacy_sc_arg(self):
@@ -266,3 +266,49 @@ class TestFamilyResolution:
                 SkLogReg(max_iter=100, class_weight="balanced"),
                 {"C": [1.0]}, cv=3).fit(X, y)
         assert gs.best_score_ > 0.9
+
+
+class TestReviewRegressions:
+    def test_binary_logreg_n_equals_batch(self):
+        """Regression: _bcast shape heuristic corrupted binary fits when
+        n_samples == n_tasks (review finding on solver broadcasting)."""
+        import jax
+        from sklearn.linear_model import LogisticRegression as SkLogReg
+        rng = np.random.default_rng(0)
+        n = 150  # 50 candidates x 3 folds = 150 tasks == 150 samples
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        yb = (X[:, 0] + 0.2 * rng.normal(size=n) > 0).astype(int)
+        grid = {"C": list(np.logspace(-2, 2, 50))}
+        ours = sst.GridSearchCV(SkLogReg(max_iter=100), grid, cv=3,
+                                backend="tpu").fit(X, yb)
+        theirs = sst.GridSearchCV(SkLogReg(max_iter=100), grid, cv=3,
+                                  backend="host").fit(X, yb)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.02)
+
+    def test_standard_scaler_with_mean_false_parity(self, digits):
+        """Regression: with_mean=False must still scale by std-about-mean."""
+        from sklearn.linear_model import LogisticRegression as SkLogReg
+        from sklearn.model_selection import GridSearchCV as SkGS
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+        X, y = digits
+        X = X + 3.0  # non-zero mean so the bug would bite
+        pipe = Pipeline([("scale", StandardScaler(with_mean=False)),
+                         ("clf", SkLogReg(max_iter=200))])
+        ours = sst.GridSearchCV(pipe, {"clf__C": [1.0]}, cv=3,
+                                backend="tpu").fit(X, y)
+        theirs = SkGS(pipe, {"clf__C": [1.0]}, cv=3).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=7e-3)
+
+    def test_converter_rejects_svc(self, digits):
+        """Regression: SVC registration must not open Converter.toTPU to
+        non-linear families with a delayed KeyError."""
+        from sklearn.svm import SVC
+        X, y = digits
+        svc = SVC(kernel="linear").fit(X[:100], y[:100])
+        with pytest.raises(ValueError, match="Cannot convert"):
+            sst.Converter().toTPU(svc)

@@ -1,0 +1,93 @@
+"""MLP and Pipeline compiled-family tests (BASELINE config #5 path)."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LogisticRegression as SkLogReg
+from sklearn.neural_network import MLPClassifier, MLPRegressor
+from sklearn.pipeline import Pipeline, make_pipeline
+from sklearn.preprocessing import StandardScaler
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.models.base import resolve_family
+
+
+class TestMLP:
+    def test_mlp_classifier_learns(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(64,), max_iter=30,
+                          random_state=0),
+            {"alpha": [1e-4, 1e-2]}, cv=3, backend="tpu").fit(X, y)
+        assert gs.cv_results_["mean_test_score"].max() > 0.9
+        assert gs.best_estimator_ is not None
+
+    def test_mlp_regressor_learns(self, diabetes):
+        X, y = diabetes
+        yn = (y - y.mean()) / y.std()
+        gs = sst.GridSearchCV(
+            MLPRegressor(hidden_layer_sizes=(32,), max_iter=100,
+                         random_state=0),
+            {"alpha": [1e-4]}, cv=3, backend="tpu").fit(X, yn)
+        assert gs.cv_results_["mean_test_score"].max() > 0.2
+
+    def test_mlp_close_to_sklearn(self, digits):
+        """Accuracy parity band (not exact — different shuffles/init)."""
+        X, y = digits
+        ours = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(50,), max_iter=50,
+                          random_state=0),
+            {"alpha": [1e-4]}, cv=3, backend="tpu").fit(X, y)
+        theirs = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(50,), max_iter=50,
+                          random_state=0),
+            {"alpha": [1e-4]}, cv=3, backend="host").fit(X, y)
+        assert abs(ours.best_score_ - theirs.best_score_) < 0.05
+
+    def test_early_stopping_falls_back(self, digits):
+        X, y = digits
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                MLPClassifier(hidden_layer_sizes=(16,), max_iter=20,
+                              early_stopping=True, random_state=0),
+                {"alpha": [1e-4]}, cv=3).fit(X, y)
+        assert gs.best_score_ > 0.5
+
+
+class TestPipeline:
+    def test_resolves_to_compiled_family(self):
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("clf", SkLogReg())])
+        fam = resolve_family(pipe)
+        assert fam is not None
+        assert fam.dynamic_params == {"clf__C": np.float32,
+                                      "clf__tol": np.float32}
+
+    def test_unsupported_step_returns_none(self):
+        from sklearn.decomposition import PCA
+        pipe = Pipeline([("pca", PCA(2)), ("clf", SkLogReg())])
+        assert resolve_family(pipe) is None
+
+    def test_pipeline_grid_oracle(self, digits):
+        """Config #5 shape: scaler + estimator with step__param routing."""
+        from sklearn.model_selection import GridSearchCV as SkGS
+        X, y = digits
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("clf", SkLogReg(max_iter=200))])
+        grid = {"clf__C": [0.1, 1.0, 10.0]}
+        ours = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
+        theirs = SkGS(pipe, grid, cv=3).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=7e-3)
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_pipeline_mlp_grid(self, digits):
+        X, y = digits
+        pipe = make_pipeline(
+            StandardScaler(),
+            MLPClassifier(hidden_layer_sizes=(32,), max_iter=30,
+                          random_state=0))
+        grid = {"mlpclassifier__alpha": [1e-4, 1e-1]}
+        gs = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
+        assert gs.cv_results_["mean_test_score"].max() > 0.9
+        assert set(gs.best_params_) == {"mlpclassifier__alpha"}

@@ -1,0 +1,59 @@
+"""SVC compiled-family tests (BASELINE config #2 path) vs sklearn oracle."""
+
+import numpy as np
+import pytest
+from sklearn.svm import SVC
+
+import spark_sklearn_tpu as sst
+
+
+class TestSVC:
+    def test_binary_rbf_close_to_sklearn(self, digits):
+        X, y = digits
+        m = y < 2
+        Xb, yb = X[m][:200], y[m][:200]
+        ours = sst.GridSearchCV(
+            SVC(kernel="rbf"), {"C": [1.0], "gamma": [0.05]}, cv=3,
+            backend="tpu").fit(Xb, yb)
+        theirs = sst.GridSearchCV(
+            SVC(kernel="rbf"), {"C": [1.0], "gamma": [0.05]}, cv=3,
+            backend="host").fit(Xb, yb)
+        assert abs(ours.best_score_ - theirs.best_score_) < 0.03
+
+    def test_multiclass_grid_close_to_sklearn(self, digits):
+        X, y = digits
+        Xs, ys = X[:500], y[:500]
+        grid = {"C": [0.5, 5.0], "gamma": [0.01, 0.05]}
+        ours = sst.GridSearchCV(
+            SVC(kernel="rbf"), grid, cv=3, backend="tpu").fit(Xs, ys)
+        theirs = sst.GridSearchCV(
+            SVC(kernel="rbf"), grid, cv=3, backend="host").fit(Xs, ys)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.05)
+        assert ours.best_score_ > 0.9
+
+    def test_linear_kernel(self, digits):
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        gs = sst.GridSearchCV(
+            SVC(kernel="linear"), {"C": [1.0]}, cv=3,
+            backend="tpu").fit(Xs, ys)
+        assert gs.best_score_ > 0.85
+
+    def test_gamma_scale_static(self, digits):
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        gs = sst.GridSearchCV(
+            SVC(), {"C": [1.0, 10.0]}, cv=3, backend="tpu").fit(Xs, ys)
+        assert gs.best_score_ > 0.85
+
+    def test_precomputed_falls_back(self, digits):
+        X, y = digits
+        Xs = X[:100]
+        K = Xs @ Xs.T
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                SVC(kernel="precomputed"), {"C": [1.0]},
+                cv=3).fit(np.asarray(K), y[:100])
+        assert gs.best_score_ > 0.5
