@@ -184,17 +184,25 @@ class MLPClassifierFamily(Family):
 
         def epoch(carry, ek):
             p, st = carry
-            perm = jax.random.permutation(ek, n_pad) % n
-            batches = perm.reshape(n_batches, batch_size)
+            # pad with index 0 at ZERO weight (a modulo wrap would silently
+            # double-count wrapped samples at full weight)
+            perm = jax.random.permutation(ek, n)
+            idx_pad = jnp.concatenate(
+                [perm, jnp.zeros((n_pad - n,), perm.dtype)])
+            wmul = jnp.concatenate(
+                [jnp.ones((n,), dtype), jnp.zeros((n_pad - n,), dtype)])
+            batches = idx_pad.reshape(n_batches, batch_size)
+            wmuls = wmul.reshape(n_batches, batch_size)
 
-            def one_batch(c, idx):
+            def one_batch(c, inp):
                 p_, st_ = c
-                w_idx = train_w[idx]
+                idx, wm = inp
+                w_idx = train_w[idx] * wm
                 g = grad_fn(p_, idx, w_idx, alpha)
                 p_, st_ = update(p_, g, st_)
                 return (p_, st_), None
 
-            (p, st), _ = jax.lax.scan(one_batch, (p, st), batches)
+            (p, st), _ = jax.lax.scan(one_batch, (p, st), (batches, wmuls))
             return (p, st), None
 
         epoch_keys = jax.random.split(key, max_iter)

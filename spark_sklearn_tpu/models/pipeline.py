@@ -127,4 +127,10 @@ def make_pipeline_family(pipeline) -> Optional[PipelineFamily]:
     final_family = resolve_family(final_est)
     if final_family is None or isinstance(final_family, PipelineFamily):
         return None
+    from spark_sklearn_tpu.models.base import Family
+    if getattr(final_family.fit, "__func__", final_family.fit) is \
+            Family.fit.__func__:
+        # families exposing only fit_task_batched (SVC) can't compose with
+        # per-task fold-transformed inputs yet -> whole pipeline to Tier B
+        return None
     return PipelineFamily(resolved, final_name, final_family)

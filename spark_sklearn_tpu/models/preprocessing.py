@@ -113,4 +113,9 @@ STEP_REGISTRY = {
 
 
 def resolve_step(transformer) -> object | None:
+    # sklearn classes only — a third-party class merely NAMED StandardScaler
+    # must not silently get the compiled transform (same guard as
+    # base.resolve_family)
+    if not type(transformer).__module__.startswith("sklearn."):
+        return None
     return STEP_REGISTRY.get(type(transformer).__name__)

@@ -44,11 +44,9 @@ from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
 from spark_sklearn_tpu.models.base import resolve_family
 from spark_sklearn_tpu.parallel import mesh as mesh_lib
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
-from spark_sklearn_tpu.parallel.taskgrid import (
-    build_compile_groups,
-    build_fold_masks,
-)
+from spark_sklearn_tpu.parallel.taskgrid import build_compile_groups
 from spark_sklearn_tpu.search.scorers import resolve_scoring
+from spark_sklearn_tpu.utils.native import fold_masks
 
 
 def _looks_like_estimator(obj) -> bool:
@@ -215,13 +213,16 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         X = np.asarray(X)
         data, meta = family.prepare_data(X, y, dtype=dtype)
         n_samples = X.shape[0]
-        train_masks, test_masks = build_fold_masks(
-            splits, n_samples, dtype=dtype)
+        train_masks, test_masks = fold_masks(splits, n_samples, dtype=dtype)
         n_folds = len(splits)
         n_cand = len(candidates)
         return_train = self.return_train_score
 
         base_params = family.extract_params(self.estimator)
+        if hasattr(family, "observe_candidates"):
+            # e.g. tree families need the grid-wide max n_estimators to fix
+            # the compiled program's static tree count
+            family.observe_candidates(candidates, base_params, meta)
         dyn_names = list(family.dynamic_params)
         groups = build_compile_groups(
             candidates, dyn_names, family.dynamic_params)
@@ -601,18 +602,25 @@ class GridSearchCV(BaseSearchTPU):
         cluster.  Reference: grid_search.py GridSearchCV(self, sc, ...).)
     """
 
-    def __init__(self, estimator, param_grid=None, *args, scoring=None,
-                 n_jobs=None, refit=True, cv=None, verbose=0,
+    def __init__(self, estimator, param_grid=None, legacy_grid=None, *,
+                 scoring=None, n_jobs=None, refit=True, cv=None, verbose=0,
                  error_score=np.nan, return_train_score=False, backend=None,
                  config=None):
-        if not _looks_like_estimator(estimator) and param_grid is not None \
-                and _looks_like_estimator(param_grid):
-            # legacy (sc, estimator, param_grid) convention
+        # third positional slot exists only for the reference's legacy
+        # (sc, estimator, param_grid) convention; it is an explicit named
+        # parameter (not *args) because sklearn's get_params/clone/repr
+        # introspect __init__ and reject varargs
+        if not _looks_like_estimator(estimator) and \
+                _looks_like_estimator(param_grid):
             estimator = param_grid
-            param_grid = args[0] if args else None
-            args = args[1:]
-        if args:
-            raise TypeError(f"unexpected positional arguments: {args!r}")
+            param_grid = legacy_grid
+            legacy_grid = None
+        elif legacy_grid is not None:
+            # slot exists only for the legacy (sc, est, grid) convention;
+            # a stray third positional (e.g. scoring) must not be swallowed
+            raise TypeError(
+                f"unexpected positional argument {legacy_grid!r}; pass "
+                "scoring/cv/... as keywords")
         if param_grid is None:
             raise TypeError("param_grid is required")
         super().__init__(
@@ -621,6 +629,7 @@ class GridSearchCV(BaseSearchTPU):
             return_train_score=return_train_score, backend=backend,
             config=config)
         self.param_grid = param_grid
+        self.legacy_grid = legacy_grid
 
     def _get_candidates(self):
         return list(ParameterGrid(self.param_grid))
@@ -633,20 +642,23 @@ class RandomizedSearchCV(BaseSearchTPU):
     Legacy `(sc, estimator, param_distributions)` convention accepted like
     GridSearchCV."""
 
-    def __init__(self, estimator, param_distributions=None, *args, n_iter=10,
+    def __init__(self, estimator, param_distributions=None,
+                 legacy_distributions=None, *, n_iter=10,
                  scoring=None, n_jobs=None, refit=True, cv=None, verbose=0,
                  random_state=None, error_score=np.nan,
                  return_train_score=False, backend=None, config=None):
         if not _looks_like_estimator(estimator) and \
-                param_distributions is not None and \
                 _looks_like_estimator(param_distributions):
             estimator = param_distributions
-            param_distributions = args[0] if args else None
-            args = args[1:]
-        if args:
-            raise TypeError(f"unexpected positional arguments: {args!r}")
+            param_distributions = legacy_distributions
+            legacy_distributions = None
+        elif legacy_distributions is not None:
+            raise TypeError(
+                f"unexpected positional argument {legacy_distributions!r}; "
+                "pass n_iter/scoring/... as keywords")
         if param_distributions is None:
             raise TypeError("param_distributions is required")
+        self.legacy_distributions = legacy_distributions
         super().__init__(
             estimator, scoring=scoring, n_jobs=n_jobs, refit=refit, cv=cv,
             verbose=verbose, error_score=error_score,

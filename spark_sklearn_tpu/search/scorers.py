@@ -26,19 +26,25 @@ def _wsum(w):
     return jnp.sum(w) + EPS
 
 
+def _feats(data):
+    """Families that pre-transform the dataset (binned trees) carry their
+    own representation; predict implementations know which they expect."""
+    return data["X"] if "X" in data else data["codes"]
+
+
 def _accuracy(family, model, static, data, meta, w):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     return jnp.sum(w * (pred == data["y"])) / _wsum(w)
 
 
 def _neg_log_loss(family, model, static, data, meta, w):
-    proba = family.predict_proba(model, static, data["X"], meta)
+    proba = family.predict_proba(model, static, _feats(data), meta)
     p = jnp.clip(proba[jnp.arange(proba.shape[0]), data["y"]], 1e-15, 1.0)
     return -(jnp.sum(w * -jnp.log(p)) / _wsum(w))
 
 
 def _binary_counts(family, model, static, data, meta, w, positive=1):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     y = data["y"]
     tp = jnp.sum(w * ((pred == positive) & (y == positive)))
     fp = jnp.sum(w * ((pred == positive) & (y != positive)))
@@ -62,7 +68,7 @@ def _recall(family, model, static, data, meta, w):
 
 
 def _f1_macro(family, model, static, data, meta, w):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     y = data["y"]
     k = meta["n_classes"]
 
@@ -77,7 +83,7 @@ def _f1_macro(family, model, static, data, meta, w):
 
 def _roc_auc(family, model, static, data, meta, w):
     """Weighted binary AUC via the rank/Mann-Whitney statistic."""
-    s = family.decision(model, static, data["X"], meta)
+    s = family.decision(model, static, _feats(data), meta)
     y = data["y"].astype(s.dtype)
     order = jnp.argsort(s)
     s_s, y_s, w_s = s[order], y[order], w[order]
@@ -91,7 +97,7 @@ def _roc_auc(family, model, static, data, meta, w):
 
 
 def _r2(family, model, static, data, meta, w):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     y = data["y"]
     ybar = jnp.sum(w * y) / _wsum(w)
     ss_res = jnp.sum(w * (y - pred) ** 2)
@@ -100,7 +106,7 @@ def _r2(family, model, static, data, meta, w):
 
 
 def _neg_mse(family, model, static, data, meta, w):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     return -(jnp.sum(w * (data["y"] - pred) ** 2) / _wsum(w))
 
 
@@ -109,13 +115,13 @@ def _neg_rmse(family, model, static, data, meta, w):
 
 
 def _neg_mae(family, model, static, data, meta, w):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     return -(jnp.sum(w * jnp.abs(data["y"] - pred)) / _wsum(w))
 
 
 def _neg_median_ae(family, model, static, data, meta, w):
     # weighted median via sorting on |err| with mask-weights
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     err = jnp.abs(data["y"] - pred)
     order = jnp.argsort(err)
     e_s, w_s = err[order], w[order]
@@ -126,7 +132,7 @@ def _neg_median_ae(family, model, static, data, meta, w):
 
 
 def _max_error(family, model, static, data, meta, w):
-    pred = family.predict(model, static, data["X"], meta)
+    pred = family.predict(model, static, _feats(data), meta)
     return -jnp.max(w * jnp.abs(data["y"] - pred))
 
 

@@ -45,6 +45,10 @@ class CSRMatrix:
     # -- device bridges --------------------------------------------------
     def to_dense(self, dtype=np.float32):
         import jax.numpy as jnp
+        if dtype == np.float32:
+            from spark_sklearn_tpu.utils.native import csr_to_dense
+            return jnp.asarray(csr_to_dense(
+                self.data, self.indices, self.indptr, self.shape))
         return jnp.asarray(self.to_scipy().toarray().astype(dtype))
 
     def to_bcoo(self, dtype=np.float32):

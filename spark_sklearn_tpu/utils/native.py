@@ -1,0 +1,145 @@
+"""ctypes bridge to the native host runtime (native/libtpusk.so).
+
+Every function has a numpy fallback, so the package works without the build
+step; `make -C native` enables the native paths.  See
+native/tpusk_native.cpp for what lives there and why (SURVEY §2.3: these are
+the TPU rebuild's host-side analogs of the Spark data plane the reference
+delegated to the JVM).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "native", "libtpusk.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        if lib.tpusk_abi_version() != 1:
+            return None
+        lib.fold_masks_fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        lib.csr_to_dense_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
+        lib.quantile_bin_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def fold_masks(
+    cv_splits: Sequence[Tuple[np.ndarray, np.ndarray]],
+    n_samples: int,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_idx, test_idx) pairs -> dense (n_folds, n) masks.
+
+    Native path when libtpusk.so is built and dtype is float32; numpy
+    fallback otherwise (identical output, tested in test_native.py).
+    """
+    lib = _load()
+    n_folds = len(cv_splits)
+    if lib is None or dtype != np.float32:
+        from spark_sklearn_tpu.parallel.taskgrid import build_fold_masks
+        return build_fold_masks(cv_splits, n_samples, dtype)
+    train_idx = np.ascontiguousarray(
+        np.concatenate([tr for tr, _ in cv_splits]), dtype=np.int64)
+    test_idx = np.ascontiguousarray(
+        np.concatenate([te for _, te in cv_splits]), dtype=np.int64)
+    train_offs = np.zeros(n_folds + 1, np.int64)
+    test_offs = np.zeros(n_folds + 1, np.int64)
+    np.cumsum([len(tr) for tr, _ in cv_splits], out=train_offs[1:])
+    np.cumsum([len(te) for _, te in cv_splits], out=test_offs[1:])
+    train = np.empty((n_folds, n_samples), np.float32)
+    test = np.empty((n_folds, n_samples), np.float32)
+    lib.fold_masks_fill(
+        _i64ptr(train_idx), _i64ptr(train_offs),
+        _i64ptr(test_idx), _i64ptr(test_offs),
+        n_folds, n_samples, _fptr(train), _fptr(test))
+    return train, test
+
+
+def csr_to_dense(data, indices, indptr, shape, n_threads: int = 0
+                 ) -> np.ndarray:
+    """CSR buffers -> dense float32 (native multi-threaded when built)."""
+    lib = _load()
+    n_rows, n_cols = int(shape[0]), int(shape[1])
+    if lib is None:
+        from scipy.sparse import csr_matrix
+        return csr_matrix((data, indices, indptr),
+                          shape=shape).toarray().astype(np.float32)
+    if n_threads <= 0:
+        n_threads = os.cpu_count() or 1
+    data = np.ascontiguousarray(data, np.float32)
+    indices = np.ascontiguousarray(indices, np.int32)
+    indptr = np.ascontiguousarray(indptr, np.int32)
+    out = np.empty((n_rows, n_cols), np.float32)
+    lib.csr_to_dense_f32(
+        _fptr(data),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n_rows, n_cols, _fptr(out), n_threads)
+    return out
+
+
+def quantile_bin(X: np.ndarray, n_bins: int = 256, n_threads: int = 0
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-feature quantile binning -> (edges (d, n_bins-1), codes uint8
+    (n, d)).  Prep stage for histogram-based tree learners."""
+    X = np.ascontiguousarray(X, np.float32)
+    n, d = X.shape
+    lib = _load()
+    if lib is None:
+        qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+        edges = np.quantile(X, qs, axis=0,
+                            method="lower").T.astype(np.float32)
+        edges = np.ascontiguousarray(edges)
+        codes = np.empty((n, d), np.uint8)
+        for f in range(d):
+            codes[:, f] = np.searchsorted(edges[f], X[:, f], side="right")
+        return edges, codes
+    if n_threads <= 0:
+        n_threads = os.cpu_count() or 1
+    edges = np.empty((d, n_bins - 1), np.float32)
+    codes = np.empty((n, d), np.uint8)
+    lib.quantile_bin_f32(
+        _fptr(X), n, d, n_bins, _fptr(edges),
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n_threads)
+    return edges, codes

@@ -190,3 +190,18 @@ class TestCompileGroups:
             cv=3).fit(X, y)
         assert len(gs.cv_results_["params"]) == 3
         assert np.all(np.isfinite(gs.cv_results_["mean_test_score"]))
+
+
+class TestSklearnEstimatorContract:
+    def test_clone_and_repr(self, digits):
+        """Search estimators must satisfy sklearn's introspection contract
+        (get_params/clone/repr) — regression for *args in __init__."""
+        from sklearn.base import clone
+        from sklearn.linear_model import LogisticRegression as SkLogReg
+        gs = sst.GridSearchCV(SkLogReg(), {"C": [1.0]}, cv=3)
+        gs2 = clone(gs)
+        assert gs2.param_grid == {"C": [1.0]}
+        assert "GridSearchCV" in repr(gs)
+        rs = sst.RandomizedSearchCV(SkLogReg(), {"C": [1.0]}, n_iter=1)
+        assert clone(rs).n_iter == 1
+        assert "RandomizedSearchCV" in repr(rs)

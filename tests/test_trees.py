@@ -1,0 +1,96 @@
+"""Tree-family tests (BASELINE configs #3/#4 paths) vs sklearn oracles.
+
+Histogram trees are not bit-identical to exact CART; parity is asserted at
+the accuracy/R2 level (SURVEY §4: oracle = serial sklearn on same splits).
+"""
+
+import numpy as np
+import pytest
+from sklearn.ensemble import (
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+import spark_sklearn_tpu as sst
+
+
+class TestGBDT:
+    def test_gbr_close_to_sklearn(self, diabetes):
+        X, y = diabetes
+        grid = {"learning_rate": [0.05, 0.1], "n_estimators": [30, 60]}
+        ours = sst.GridSearchCV(
+            GradientBoostingRegressor(max_depth=3, random_state=0),
+            grid, cv=3, backend="tpu").fit(X, y)
+        theirs = sst.GridSearchCV(
+            GradientBoostingRegressor(max_depth=3, random_state=0),
+            grid, cv=3, backend="host").fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.1)
+        assert ours.best_score_ > 0.3
+
+    def test_gbc_multiclass(self, digits):
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        gs = sst.GridSearchCV(
+            GradientBoostingClassifier(n_estimators=15, max_depth=2,
+                                       random_state=0),
+            {"learning_rate": [0.1, 0.3]}, cv=3, backend="tpu").fit(Xs, ys)
+        assert gs.cv_results_["mean_test_score"].max() > 0.8
+
+    def test_n_estimators_dynamic_single_compile(self, diabetes):
+        """n_estimators variation must share ONE compile group (masked
+        prefix trick), not one group per value."""
+        from spark_sklearn_tpu.models.base import resolve_family
+        from spark_sklearn_tpu.parallel.taskgrid import build_compile_groups
+        est = GradientBoostingRegressor()
+        fam = resolve_family(est)
+        cands = [{"n_estimators": v} for v in (10, 50, 100)]
+        groups = build_compile_groups(
+            cands, list(fam.dynamic_params), fam.dynamic_params)
+        assert len(groups) == 1
+
+    def test_more_trees_changes_result(self, diabetes):
+        X, y = diabetes
+        gs = sst.GridSearchCV(
+            GradientBoostingRegressor(max_depth=2, random_state=0),
+            {"n_estimators": [5, 60]}, cv=3, backend="tpu").fit(X, y)
+        scores = gs.cv_results_["mean_test_score"]
+        assert scores[1] > scores[0]  # 60 trees beat 5 on diabetes
+
+
+class TestRandomForest:
+    def test_rfc_close_to_sklearn(self, digits):
+        X, y = digits
+        Xs, ys = X[:400], y[:400]
+        ours = sst.GridSearchCV(
+            RandomForestClassifier(n_estimators=25, random_state=0),
+            {"max_depth": [5]}, cv=3, backend="tpu").fit(Xs, ys)
+        theirs = sst.GridSearchCV(
+            RandomForestClassifier(n_estimators=25, random_state=0),
+            {"max_depth": [5]}, cv=3, backend="host").fit(Xs, ys)
+        assert abs(ours.best_score_ - theirs.best_score_) < 0.07
+        assert ours.best_score_ > 0.80
+
+    def test_rfc_randomized_search_config3_shape(self, digits):
+        """Config #3 shape: RandomizedSearchCV over (n_estimators,
+        max_depth)."""
+        from scipy.stats import randint
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        rs = sst.RandomizedSearchCV(
+            RandomForestClassifier(random_state=0),
+            {"n_estimators": randint(10, 30),
+             "max_depth": randint(3, 5)},
+            n_iter=4, cv=3, random_state=7, backend="tpu").fit(Xs, ys)
+        assert np.all(np.isfinite(rs.cv_results_["mean_test_score"]))
+        assert rs.best_score_ > 0.75
+
+    def test_rfr_regression(self, diabetes):
+        X, y = diabetes
+        gs = sst.GridSearchCV(
+            RandomForestRegressor(n_estimators=25, random_state=0),
+            {"max_depth": [5]}, cv=3, backend="tpu").fit(X, y)
+        assert gs.best_score_ > 0.3
