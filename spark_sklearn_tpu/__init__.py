@@ -26,6 +26,12 @@ from spark_sklearn_tpu.convert.converter import Converter
 from spark_sklearn_tpu.keyed.keyed import KeyedEstimator, KeyedModel
 from spark_sklearn_tpu.keyed.gapply import gapply
 from spark_sklearn_tpu.sparse.csr import CSRMatrix
+from spark_sklearn_tpu.utils.session import (
+    TpuSession,
+    createLocalSparkSession,
+    createLocalTpuSession,
+    init_distributed,
+)
 
 __all__ = [
     "GridSearchCV",
@@ -36,6 +42,10 @@ __all__ = [
     "gapply",
     "CSRMatrix",
     "TpuConfig",
+    "TpuSession",
     "build_mesh",
+    "createLocalTpuSession",
+    "createLocalSparkSession",
+    "init_distributed",
     "__version__",
 ]

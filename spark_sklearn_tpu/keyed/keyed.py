@@ -86,6 +86,12 @@ class KeyedEstimator(BaseEstimator):
         if missing:
             raise KeyError(f"DataFrame is missing columns: {missing}")
 
+        fleet = None
+        if self.estimatorType == "predictor":
+            fleet = self._try_fit_compiled(df)
+        if fleet is not None:
+            return fleet
+
         models: Dict[tuple, Any] = {}
         for key, pdf in df.groupby(self.keyCols, sort=True):
             if not isinstance(key, tuple):
@@ -102,6 +108,87 @@ class KeyedEstimator(BaseEstimator):
             outputCol=self.outputCol,
             estimatorType=self.estimatorType, models=models)
 
+    def _try_fit_compiled(self, df) -> Optional["KeyedModel"]:
+        """The TPU-native per-key fleet: keys become ONE vmap axis.
+
+        Groups are padded to the longest group with zero sample weights
+        (same fixed-shape trick as CV fold masks), every key's estimator is
+        fitted by one jitted vmapped program, and the fleet lives as a
+        stacked parameter pytree with a leading key axis — replacing the
+        reference's pickled-estimator-per-row DataFrame column (reference:
+        keyed_models.py stores cloudpickled sklearn models; SURVEY §3.2).
+        Returns None when the estimator has no compiled family (-> host
+        loop, full sklearn generality).
+        """
+        from spark_sklearn_tpu.models.base import resolve_family
+
+        family = resolve_family(self.sklearnEstimator)
+        if family is None or not family.has_per_task_fit():
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        work = df.reset_index(drop=True)   # positional index for gathers
+        keys, slices = [], []
+        for key, pdf in work.groupby(self.keyCols, sort=True):
+            if not isinstance(key, tuple):
+                key = (key,)
+            keys.append(key)
+            slices.append(pdf)
+        G = len(keys)
+        L = max(len(p) for p in slices)
+
+        X_all = _stack_x(work[self.xCol]).astype(np.float32)
+        d = X_all.shape[1]
+        y_all = np.asarray(work[self.yCol])
+        try:
+            _, meta = family.prepare_data(X_all, y_all)
+        except Exception:
+            return None
+        static = family.extract_params(self.sklearnEstimator)
+
+        if family.is_classifier:
+            lookup = {v: i for i, v in enumerate(meta["classes"])}
+            enc = np.array([lookup[v] for v in y_all], np.float64)
+        else:
+            enc = np.asarray(y_all, np.float64)
+        Xs = np.zeros((G, L, d), np.float32)
+        ys = np.zeros((G, L), np.float64)
+        ws = np.zeros((G, L), np.float32)
+        for i, pdf in enumerate(slices):
+            m = len(pdf)
+            pos = pdf.index.to_numpy()
+            Xs[i, :m] = X_all[pos]
+            ys[i, :m] = enc[pos]
+            ws[i, :m] = 1.0
+
+        def fit_one(Xg, yg, wg):
+            if family.is_classifier:
+                k = meta["n_classes"]
+                data_g = {"X": Xg, "y": yg.astype(jnp.int32),
+                          "y1h": jax.nn.one_hot(
+                              yg.astype(jnp.int32), k, dtype=Xg.dtype)}
+            else:
+                data_g = {"X": Xg, "y": yg.astype(Xg.dtype)}
+            return family.fit({}, static, data_g, wg, meta)
+
+        # ys already holds encoded class indices (classifiers) or raw
+        # targets (regressors) from the fill loop above
+        ys_dev = jnp.asarray(ys, jnp.int32 if family.is_classifier
+                             else jnp.float32)
+
+        try:
+            models = jax.jit(jax.vmap(fit_one))(
+                jnp.asarray(Xs), ys_dev, jnp.asarray(ws))
+        except Exception:
+            return None  # uncompilable static combo -> host loop
+        return KeyedModel(
+            keyCols=self.keyCols, xCol=self.xCol, yCol=self.yCol,
+            outputCol=self.outputCol, estimatorType=self.estimatorType,
+            models=None, fleet=dict(
+                family=family, models=models, meta=meta, static=static,
+                key_index={k: i for i, k in enumerate(keys)}))
+
 
 class KeyedModel:
     """The fitted per-key fleet.  `keyedModels` exposes the per-key
@@ -109,17 +196,32 @@ class KeyedModel:
     the pickling)."""
 
     def __init__(self, keyCols, xCol, yCol, outputCol, estimatorType,
-                 models: Dict[tuple, Any]):
+                 models: Optional[Dict[tuple, Any]], fleet=None):
         self.keyCols = list(keyCols)
         self.xCol = xCol
         self.yCol = yCol
         self.outputCol = outputCol
         self.estimatorType = estimatorType
-        self.models = models
+        self.models = models            # host fleet: {key: fitted sklearn}
+        self.fleet = fleet              # compiled fleet: stacked pytrees
+
+    @property
+    def backend(self) -> str:
+        return "tpu" if self.fleet is not None else "host"
 
     @property
     def keyedModels(self) -> pd.DataFrame:
         rows = []
+        if self.fleet is not None:
+            import jax
+            fam = self.fleet["family"]
+            for key, i in self.fleet["key_index"].items():
+                leaf = jax.tree_util.tree_map(
+                    lambda a: a[i], self.fleet["models"])
+                attrs = fam.sklearn_attrs(
+                    leaf, self.fleet["static"], self.fleet["meta"])
+                rows.append(dict(zip(self.keyCols, key), estimator=attrs))
+            return pd.DataFrame(rows)
         for key, est in self.models.items():
             rows.append(dict(zip(self.keyCols, key), estimator=est))
         return pd.DataFrame(rows)
@@ -138,8 +240,17 @@ class KeyedModel:
         for key, pdf in work.groupby(self.keyCols, sort=False, dropna=False):
             if not isinstance(key, tuple):
                 key = (key,)
-            est = self.models.get(key)
             pos = pdf.index.to_numpy()
+            if self.fleet is not None:
+                vals = self._fleet_predict(key, pdf)
+                if vals is None:
+                    for p in pos:
+                        out_values[p] = np.nan
+                else:
+                    for p, v in zip(pos, vals):
+                        out_values[p] = v
+                continue
+            est = self.models.get(key)
             if est is None:
                 fill = None if self.estimatorType == "transformer" else np.nan
                 for p in pos:
@@ -160,3 +271,21 @@ class KeyedModel:
         res = df.copy()
         res[self.outputCol] = pd.Series(out_values, index=orig_index)
         return res
+
+    def _fleet_predict(self, key, pdf):
+        """Batched predict from the stacked-pytree fleet (one gather on the
+        key axis + the family's compiled predict)."""
+        import jax
+        import jax.numpy as jnp
+        idx = self.fleet["key_index"].get(key)
+        if idx is None:
+            return None
+        fam = self.fleet["family"]
+        model = jax.tree_util.tree_map(
+            lambda a: a[idx], self.fleet["models"])
+        X = jnp.asarray(_stack_x(pdf[self.xCol]), jnp.float32)
+        pred = np.asarray(fam.predict(
+            model, self.fleet["static"], X, self.fleet["meta"]))
+        if fam.is_classifier:
+            return list(self.fleet["meta"]["classes"][pred])
+        return list(pred.astype(np.float64))

@@ -76,6 +76,14 @@ class Family:
     #: True for classifiers (label-encode y, default scorer = accuracy)
     is_classifier: bool = False
 
+    @classmethod
+    def has_per_task_fit(cls) -> bool:
+        """True when the family implements the per-task `fit` (some, like
+        SVC, only provide the task-batched form and cannot be composed by
+        dispatchers that need one fit per vmap lane)."""
+        return getattr(cls.fit, "__func__", cls.fit) is not \
+            Family.fit.__func__
+
     # --- host side -------------------------------------------------------
     @classmethod
     def extract_params(cls, estimator) -> Dict[str, Any]:

@@ -37,6 +37,9 @@ class PipelineFamily:
             for k, v in final_family.dynamic_params.items()
         }
 
+    def has_per_task_fit(self) -> bool:
+        return True
+
     # -- host side -------------------------------------------------------
     def extract_params(self, estimator) -> Dict[str, Any]:
         out = {}
@@ -127,9 +130,7 @@ def make_pipeline_family(pipeline) -> Optional[PipelineFamily]:
     final_family = resolve_family(final_est)
     if final_family is None or isinstance(final_family, PipelineFamily):
         return None
-    from spark_sklearn_tpu.models.base import Family
-    if getattr(final_family.fit, "__func__", final_family.fit) is \
-            Family.fit.__func__:
+    if not final_family.has_per_task_fit():
         # families exposing only fit_task_batched (SVC) can't compose with
         # per-task fold-transformed inputs yet -> whole pipeline to Tier B
         return None

@@ -179,15 +179,14 @@ class GradientBoostingClassifierFamily(GradientBoostingRegressorFamily):
                 jax.random.uniform(k_t, (n,)) < subsample).astype(
                 jnp.float32)
 
-            def per_class(c_key, g_c, h_c):
+            def per_class(g_c, h_c):
                 return grow_tree(codes, g_c[:, None], h_c, w_t, depth,
                                  N_BINS, min_child_weight=min_leaf,
                                  reg_lambda=1e-6)
 
             G = (P - y1h)                              # (n, k)
             H = P * (1.0 - P)                          # (n, k)
-            trees_k = jax.vmap(per_class, in_axes=(0, 1, 1))(
-                jax.random.split(k_t, k), G, H)
+            trees_k = jax.vmap(per_class, in_axes=(1, 1))(G, H)
             delta = jax.vmap(
                 lambda tr: predict_tree(tr, codes, depth)[:, 0],
                 in_axes=0, out_axes=1)(trees_k)        # (n, k)
@@ -207,6 +206,9 @@ class GradientBoostingClassifierFamily(GradientBoostingRegressorFamily):
 
     @classmethod
     def decision(cls, model, static, X, meta):
+        if meta["n_classes"] == 2:
+            # scorer contract: binary decision is a 1-D margin
+            return model["logits"][:, 1] - model["logits"][:, 0]
         return model["logits"]
 
     @classmethod
@@ -336,8 +338,8 @@ class RandomForestRegressorFamily(RandomForestClassifierFamily):
     @classmethod
     def _max_features(cls, static, d):
         mf = static.get("max_features", 1.0)   # sklearn regressor default
-        if mf == 1.0:
-            return d
+        if isinstance(mf, float) and mf == 1.0:
+            return d                            # int 1 means ONE feature
         return RandomForestClassifierFamily._max_features.__func__(
             cls, static, d)
 

@@ -133,8 +133,7 @@ def grow_tree(codes, g, h, w, max_depth, n_bins, min_child_weight=1e-3,
         splitting = do_split[local] & jnp.logical_not(frozen)
         node = jnp.where(splitting,
                          2 * node + 1 + go_right.astype(jnp.int32), node)
-        frozen = frozen | (jnp.logical_not(do_split[local])
-                           & jnp.logical_not(frozen) & True)
+        frozen = frozen | jnp.logical_not(do_split[local])
 
     # everything still unfrozen at the last level is a leaf
     is_leaf = is_leaf.at[node].set(True)

@@ -45,6 +45,13 @@ class TpuConfig:
     # one compiled batch; bounds peak HBM for big grids (the search chunks
     # each compile group to at most this many tasks per launch).
     max_tasks_per_batch: int = 8192
+    # checkpoint/resume (SURVEY §5.4): completed chunks stream to
+    # <checkpoint_dir>/search_<fingerprint>.jsonl and a restarted identical
+    # search skips them.
+    checkpoint_dir: Optional[str] = None
+    # profiling (SURVEY §5.1): wrap the sweep in a jax.profiler trace whose
+    # artifacts land here (open with tensorboard / perfetto).
+    profile_dir: Optional[str] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

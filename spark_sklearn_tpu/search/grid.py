@@ -269,6 +269,28 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         fit_times = np.empty((n_cand, n_folds))
         score_times = np.empty((n_cand, n_folds))
 
+        ckpt = None
+        if config.checkpoint_dir:
+            from spark_sklearn_tpu.utils.checkpoint import (
+                SearchCheckpoint, fingerprint)
+            key = fingerprint(
+                type(self.estimator).__name__, base_params, candidates,
+                scorer_names, n_folds, return_train,
+                X[: min(64, n_samples)], np.asarray(train_masks))
+            ckpt = SearchCheckpoint(config.checkpoint_dir, key)
+
+        profiler_cm = None
+        if config.profile_dir:
+            import jax.profiler as _prof
+            profiler_cm = _prof.trace(config.profile_dir)
+            profiler_cm.__enter__()
+        self.search_report_ = {
+            "backend": "tpu", "n_compile_groups": len(groups),
+            "n_launches": 0, "n_chunks_resumed": 0,
+            "fit_wall_s": 0.0, "score_wall_s": 0.0,
+            "mesh": {"task": n_task_shards,
+                     "data": config.n_data_shards}}
+
         # bound peak HBM: chunk each compile group so one launch holds at
         # most max_tasks_per_batch (candidate x fold) program instances;
         # every chunk of a group is padded to one uniform width so the
@@ -285,6 +307,38 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 max(1, max_tasks // max(n_folds, 1)),
                 n_task_shards))
 
+        try:
+            self._run_groups(
+                groups=groups, base_params=base_params, family=family,
+                meta=meta, scorers=scorers, scorer_names=scorer_names,
+                data_dev=data_dev, train_dev=train_dev, test_dev=test_dev,
+                train_masks=train_masks, mesh=mesh, config=config,
+                n_task_shards=n_task_shards, task_shard=task_shard,
+                max_cand_per_batch=max_cand_per_batch, n_folds=n_folds,
+                dtype=dtype, return_train=return_train,
+                test_scores=test_scores, train_scores=train_scores,
+                fit_times=fit_times, score_times=score_times, ckpt=ckpt)
+        finally:
+            if profiler_cm is not None:
+                profiler_cm.__exit__(None, None, None)
+
+        self._handle_error_score(test_scores, train_scores, scorer_names)
+        # scorer_ keeps the sklearn-facing objects so .score() works the
+        # sklearn way even though CV scoring ran compiled
+        if self.scoring is None or isinstance(self.scoring, str):
+            scorer_attr = check_scoring(self.estimator, self.scoring)
+        else:
+            from sklearn.metrics._scorer import _check_multimetric_scoring
+            scorer_attr = _check_multimetric_scoring(
+                self.estimator, self.scoring)
+        return (test_scores, train_scores, fit_times, score_times,
+                scorer_names, scorer_attr)
+
+    def _run_groups(self, *, groups, base_params, family, meta, scorers,
+                    scorer_names, data_dev, train_dev, test_dev, train_masks,
+                    mesh, config, n_task_shards, task_shard,
+                    max_cand_per_batch, n_folds, dtype, return_train,
+                    test_scores, train_scores, fit_times, score_times, ckpt):
         task_batched = hasattr(family, "fit_task_batched")
         if config.n_data_shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -292,8 +346,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 mesh, P(mesh_lib.TASK_AXIS, mesh_lib.DATA_AXIS))
         else:
             tb_mask_shard = task_shard
-
-        for group in groups:
+        report = self.search_report_
+        for gi, group in enumerate(groups):
             static = {**base_params, **group.static_params}
             nc = group.n_candidates
             nc_batch = min(mesh_lib.pad_to_multiple(nc, n_task_shards),
@@ -343,6 +397,24 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
             for lo in range(0, nc, nc_batch):
                 hi = min(lo + nc_batch, nc)
+                idx = group.candidate_indices[lo:hi]
+                chunk_id = f"{gi}:{lo}:{hi}"
+                if ckpt is not None:
+                    rec = ckpt.get(chunk_id)
+                    if rec is not None and return_train and \
+                            rec.get("train") is None:
+                        rec = None  # written without train scores: recompute
+                    if rec is not None:
+                        for s_ in scorer_names:
+                            test_scores[s_][idx, :] = np.asarray(
+                                rec["test"][s_])
+                            if return_train:
+                                train_scores[s_][idx, :] = np.asarray(
+                                    rec["train"][s_])
+                        fit_times[idx, :] = rec["fit_t"]
+                        score_times[idx, :] = rec["score_t"]
+                        report["n_chunks_resumed"] += 1
+                        continue
                 dyn = {}
                 for k, arr in group.dynamic_params.items():
                     chunk = arr[lo:hi]
@@ -376,7 +448,6 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 t_score = time.perf_counter() - t0
                 del models
 
-                idx = group.candidate_indices[lo:hi]
                 fit_times[idx, :] = t_fit / (nc_batch * n_folds)
                 score_times[idx, :] = t_score / (nc_batch * n_folds)
                 for s in scorer_names:
@@ -384,18 +455,18 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     if return_train:
                         train_scores[s][idx, :] = \
                             np.asarray(tr[s])[:hi - lo]
-
-        self._handle_error_score(test_scores, train_scores, scorer_names)
-        # scorer_ keeps the sklearn-facing objects so .score() works the
-        # sklearn way even though CV scoring ran compiled
-        if self.scoring is None or isinstance(self.scoring, str):
-            scorer_attr = check_scoring(self.estimator, self.scoring)
-        else:
-            from sklearn.metrics._scorer import _check_multimetric_scoring
-            scorer_attr = _check_multimetric_scoring(
-                self.estimator, self.scoring)
-        return (test_scores, train_scores, fit_times, score_times,
-                scorer_names, scorer_attr)
+                report["n_launches"] += 1
+                report["fit_wall_s"] += t_fit
+                report["score_wall_s"] += t_score
+                if ckpt is not None:
+                    ckpt.put(chunk_id, {
+                        "test": {s: test_scores[s][idx, :].tolist()
+                                 for s in scorer_names},
+                        "train": ({s: train_scores[s][idx, :].tolist()
+                                   for s in scorer_names}
+                                  if return_train else None),
+                        "fit_t": t_fit / (nc_batch * n_folds),
+                        "score_t": t_score / (nc_batch * n_folds)})
 
     def _handle_error_score(self, test_scores, train_scores, scorer_names):
         """Reproduce sklearn's error_score semantics (_validation.py:666,
@@ -450,6 +521,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             for ci, params in enumerate(candidates)
             for fi, (train, test) in enumerate(splits)
         ]
+        self.search_report_ = {
+            "backend": "host", "n_tasks": len(tasks),
+            "n_jobs": self.n_jobs if self.n_jobs is not None else 1}
 
         def run(params, train, test):
             return _fit_and_score(

@@ -1,0 +1,66 @@
+"""Session bootstrap — reference util.py / SURVEY §3.5 parity.
+
+The reference's `createLocalSparkSession(appName)` launches an in-process
+JVM (reference: python/spark_sklearn/util.py).  On TPU there is nothing to
+launch for single-host — `jax.devices()` just works — so the "session" is a
+TpuConfig + Mesh pair; multi-host adds one `jax.distributed.initialize`
+call (the control-plane analog of Spark's driver bootstrap; data-plane
+collectives ride ICI/DCN via XLA — SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
+
+
+class TpuSession:
+    """Holds the mesh + config a process uses for searches and fleets."""
+
+    def __init__(self, config: Optional[TpuConfig] = None,
+                 appName: str = "spark-sklearn-tpu"):
+        self.appName = appName
+        self.config = config or TpuConfig()
+        self.mesh = build_mesh(self.config)
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    def stop(self):  # reference API symmetry (SparkSession.stop)
+        pass
+
+    def __repr__(self):
+        return (f"TpuSession(appName={self.appName!r}, "
+                f"mesh={dict(self.mesh.shape)})")
+
+
+def createLocalTpuSession(appName: str = "spark-sklearn-tpu",
+                          config: Optional[TpuConfig] = None) -> TpuSession:
+    """Drop-in analog of the reference's createLocalSparkSession."""
+    return TpuSession(config=config, appName=appName)
+
+
+# alias so reference-style imports keep working
+createLocalSparkSession = createLocalTpuSession
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap: one call per host before building the mesh
+    (SURVEY §7.3 #6 — everything else is 'same code, bigger mesh').
+
+    With no arguments, defers entirely to jax.distributed's environment
+    auto-detection (TPU pod metadata / cluster env vars)."""
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,54 @@
+"""Shared test harness — reference test_utils.py parity (SURVEY §2.2 #8).
+
+The reference gives suites a class-scoped SparkContext (`MLlibTestCase`)
+and a `fixtureReuseSparkSession` decorator so one JVM serves a whole
+module.  The analog: one TpuSession (mesh + config) per test class /
+decorated fixture — meshes are cheap, but the pattern keeps parity for
+suites ported from the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+import unittest
+
+from spark_sklearn_tpu.utils.session import TpuSession, createLocalTpuSession
+
+
+class TpuTestCase(unittest.TestCase):
+    """Class-scoped session, mirroring the reference's MLlibTestCase
+    (class-scoped `sc`/`spark` attributes)."""
+
+    session: TpuSession = None
+    sc = None      # reference-attribute name kept for ported suites
+
+    @classmethod
+    def setUpClass(cls):
+        super().setUpClass()
+        cls.session = createLocalTpuSession(appName=cls.__name__)
+        cls.sc = cls.session
+
+    @classmethod
+    def tearDownClass(cls):
+        cls.session.stop()
+        super().tearDownClass()
+
+
+_shared_session = None
+
+
+def fixtureReuseTpuSession(fn):
+    """Decorator handing a module-shared TpuSession to the wrapped callable
+    as its first argument — the reference's fixtureReuseSparkSession."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        global _shared_session
+        if _shared_session is None:
+            _shared_session = createLocalTpuSession()
+        return fn(_shared_session, *args, **kwargs)
+
+    return wrapper
+
+
+fixtureReuseSparkSession = fixtureReuseTpuSession  # reference alias

@@ -312,3 +312,119 @@ class TestReviewRegressions:
         svc = SVC(kernel="linear").fit(X[:100], y[:100])
         with pytest.raises(ValueError, match="Cannot convert"):
             sst.Converter().toTPU(svc)
+
+
+class TestKeyedTierA:
+    def test_compiled_fleet_linear(self, keyed_df):
+        """Linear estimators take the vmapped stacked-pytree path."""
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        assert km.backend == "tpu"
+        out = km.transform(keyed_df)
+        assert np.max(np.abs(out["output"] - keyed_df["y"])) < 0.1
+        assert len(km.keyedModels) == 3
+
+    def test_compiled_fleet_classifier(self):
+        rng = np.random.default_rng(3)
+        df = pd.DataFrame({
+            "k": np.repeat(["a", "b"], 60),
+            "x": [rng.normal(size=3) for _ in range(120)],
+        })
+        # per-key different decision boundaries
+        df["y"] = np.where(
+            np.repeat([1.0, -1.0], 60) * [v[0] for v in df.x] > 0,
+            "pos", "neg")
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLogReg(max_iter=100), keyCols=["k"],
+            xCol="x", yCol="y").fit(df)
+        assert km.backend == "tpu"
+        out = km.transform(df)
+        acc = np.mean(out["output"] == df["y"])
+        assert acc > 0.9
+
+    def test_unseen_key_fleet_nan(self, keyed_df):
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        out = km.transform(pd.DataFrame({"k": ["zz"], "x": [np.zeros(4)]}))
+        assert np.isnan(out["output"].iloc[0])
+
+    def test_host_fallback_for_unregistered(self, keyed_df):
+        from sklearn.tree import DecisionTreeRegressor
+        km = sst.KeyedEstimator(
+            sklearnEstimator=DecisionTreeRegressor(max_depth=3),
+            keyCols=["k"], xCol="x", yCol="y").fit(keyed_df)
+        assert km.backend == "host"
+        out = km.transform(keyed_df)
+        assert np.isfinite(out["output"]).all()
+
+
+class TestCheckpointAndSession:
+    def test_checkpoint_resume(self, digits, tmp_path):
+        """SURVEY §5.4: a rerun of an identical search skips completed
+        chunks."""
+        from sklearn.linear_model import LogisticRegression as LR
+        X, y = digits
+        cfg = sst.TpuConfig(checkpoint_dir=str(tmp_path))
+        g1 = sst.GridSearchCV(LR(max_iter=50), {"C": [0.1, 1.0]}, cv=3,
+                              backend="tpu", config=cfg, refit=False)
+        g1.fit(X, y)
+        assert g1.search_report_["n_chunks_resumed"] == 0
+        assert g1.search_report_["n_launches"] >= 1
+        g2 = sst.GridSearchCV(LR(max_iter=50), {"C": [0.1, 1.0]}, cv=3,
+                              backend="tpu", config=cfg, refit=False)
+        g2.fit(X, y)
+        assert g2.search_report_["n_chunks_resumed"] >= 1
+        assert g2.search_report_["n_launches"] == 0
+        np.testing.assert_allclose(
+            g1.cv_results_["mean_test_score"],
+            g2.cv_results_["mean_test_score"])
+
+    def test_checkpoint_distinguishes_grids(self, digits, tmp_path):
+        from sklearn.linear_model import LogisticRegression as LR
+        X, y = digits
+        cfg = sst.TpuConfig(checkpoint_dir=str(tmp_path))
+        g1 = sst.GridSearchCV(LR(max_iter=50), {"C": [0.1]}, cv=3,
+                              backend="tpu", config=cfg, refit=False)
+        g1.fit(X, y)
+        g2 = sst.GridSearchCV(LR(max_iter=50), {"C": [9.0]}, cv=3,
+                              backend="tpu", config=cfg, refit=False)
+        g2.fit(X, y)
+        assert g2.search_report_["n_chunks_resumed"] == 0
+
+    def test_pytree_save_load(self, tmp_path):
+        import jax.numpy as jnp
+        from spark_sklearn_tpu.utils.checkpoint import (load_pytree,
+                                                        save_pytree)
+        tree = {"coef": jnp.arange(6.0).reshape(2, 3),
+                "intercept": jnp.ones(2)}
+        p = str(tmp_path / "m.npz")
+        save_pytree(p, tree)
+        back = load_pytree(p, like=tree)
+        np.testing.assert_allclose(back["coef"], tree["coef"])
+
+    def test_session_and_testing_utils(self):
+        from spark_sklearn_tpu.utils.session import createLocalTpuSession
+        from spark_sklearn_tpu.utils.testing import (TpuTestCase,
+                                                     fixtureReuseTpuSession)
+        s = createLocalTpuSession(appName="t")
+        assert s.n_devices >= 1
+        assert "TpuSession" in repr(s)
+
+        @fixtureReuseTpuSession
+        def job(session, x):
+            return session.n_devices + x
+
+        assert job(1) >= 2
+        assert TpuTestCase.session is None  # not set up outside unittest
+
+    def test_search_report_present(self, digits):
+        from sklearn.linear_model import LogisticRegression as LR
+        X, y = digits
+        gs = sst.GridSearchCV(LR(max_iter=50), {"C": [1.0]}, cv=3,
+                              backend="tpu", refit=False).fit(X, y)
+        rep = gs.search_report_
+        assert rep["backend"] == "tpu"
+        assert rep["n_compile_groups"] == 1
+        assert rep["fit_wall_s"] > 0

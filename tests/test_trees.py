@@ -94,3 +94,42 @@ class TestRandomForest:
             RandomForestRegressor(n_estimators=25, random_state=0),
             {"max_depth": [5]}, cv=3, backend="tpu").fit(X, y)
         assert gs.best_score_ > 0.3
+
+
+class TestTreeReviewRegressions:
+    def test_gbc_binary_roc_auc(self, digits):
+        """Regression: binary GBC decision must be 1-D for roc_auc."""
+        from sklearn.ensemble import GradientBoostingClassifier
+        X, y = digits
+        m = y < 2
+        gs = sst.GridSearchCV(
+            GradientBoostingClassifier(n_estimators=10, max_depth=2,
+                                       random_state=0),
+            {"learning_rate": [0.3]}, cv=3, scoring="roc_auc",
+            backend="tpu").fit(X[m][:200], y[m][:200])
+        assert 0.5 < gs.best_score_ <= 1.0
+
+    def test_rfr_max_features_int_one(self):
+        """Regression: int max_features=1 must mean ONE feature, not all."""
+        from spark_sklearn_tpu.models.trees import (
+            RandomForestRegressorFamily as F)
+        assert F._max_features({"max_features": 1}, 10) == 1
+        assert F._max_features({"max_features": 1.0}, 10) == 10
+        assert F._max_features({}, 10) == 10
+
+
+class TestCheckpointTrainScores:
+    def test_resume_with_different_return_train_score(self, diabetes,
+                                                      tmp_path):
+        """Regression: a checkpoint written without train scores must not
+        be resumed by a run that needs them."""
+        from sklearn.linear_model import Ridge
+        X, y = diabetes
+        cfg = sst.TpuConfig(checkpoint_dir=str(tmp_path))
+        sst.GridSearchCV(Ridge(), {"alpha": [1.0]}, cv=3, backend="tpu",
+                         config=cfg, refit=False).fit(X, y)
+        g2 = sst.GridSearchCV(Ridge(), {"alpha": [1.0]}, cv=3,
+                              backend="tpu", config=cfg, refit=False,
+                              return_train_score=True)
+        g2.fit(X, y)  # different fingerprint -> fresh run, no crash
+        assert "mean_train_score" in g2.cv_results_
