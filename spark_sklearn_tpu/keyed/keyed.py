@@ -123,7 +123,8 @@ class KeyedEstimator(BaseEstimator):
         from spark_sklearn_tpu.models.base import resolve_family
 
         family = resolve_family(self.sklearnEstimator)
-        if family is None or not family.has_per_task_fit():
+        if family is None or not family.has_per_task_fit() or \
+                not getattr(family, "keyed_compatible", True):
             return None
         import jax
         import jax.numpy as jnp
@@ -180,8 +181,12 @@ class KeyedEstimator(BaseEstimator):
         try:
             models = jax.jit(jax.vmap(fit_one))(
                 jnp.asarray(Xs), ys_dev, jnp.asarray(ws))
-        except Exception:
-            return None  # uncompilable static combo -> host loop
+        except Exception as exc:
+            import warnings
+            warnings.warn(
+                f"compiled keyed fleet failed ({exc!r}); falling back to "
+                "per-key host fits", UserWarning)
+            return None
         return KeyedModel(
             keyCols=self.keyCols, xCol=self.xCol, yCol=self.yCol,
             outputCol=self.outputCol, estimatorType=self.estimatorType,
@@ -211,16 +216,21 @@ class KeyedModel:
 
     @property
     def keyedModels(self) -> pd.DataFrame:
+        """One row per key with an `estimator` cell that supports
+        `.predict` on BOTH backends (fitted sklearn estimator on the host
+        path, a TpuModel view of the stacked pytree on the fleet path)."""
         rows = []
         if self.fleet is not None:
             import jax
+            from spark_sklearn_tpu.convert.converter import TpuModel
             fam = self.fleet["family"]
             for key, i in self.fleet["key_index"].items():
                 leaf = jax.tree_util.tree_map(
                     lambda a: a[i], self.fleet["models"])
-                attrs = fam.sklearn_attrs(
-                    leaf, self.fleet["static"], self.fleet["meta"])
-                rows.append(dict(zip(self.keyCols, key), estimator=attrs))
+                rows.append(dict(
+                    zip(self.keyCols, key),
+                    estimator=TpuModel(fam, leaf, self.fleet["static"],
+                                       self.fleet["meta"])))
             return pd.DataFrame(rows)
         for key, est in self.models.items():
             rows.append(dict(zip(self.keyCols, key), estimator=est))

@@ -9,3 +9,8 @@ from spark_sklearn_tpu.models.estimators import (  # noqa: F401
     LogisticRegression,
     Ridge,
 )
+from spark_sklearn_tpu.models.standalone import (  # noqa: F401
+    MLPClassifier,
+    MLPRegressor,
+    SVC,
+)

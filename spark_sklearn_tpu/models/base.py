@@ -76,6 +76,11 @@ class Family:
     #: True for classifiers (label-encode y, default scorer = accuracy)
     is_classifier: bool = False
 
+    #: families whose fit consumes the standard {"X", "y"[, "y1h"]} data
+    #: dict; tree families (binned "codes" + grid-dependent meta) opt out
+    #: of dispatchers that synthesise that dict (the keyed fleet)
+    keyed_compatible: bool = True
+
     @classmethod
     def has_per_task_fit(cls) -> bool:
         """True when the family implements the per-task `fit` (some, like

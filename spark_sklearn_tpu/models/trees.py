@@ -55,6 +55,7 @@ def _depth(static, default):
 class GradientBoostingRegressorFamily(Family):
     name = "gradient_boosting_regressor"
     is_classifier = False
+    keyed_compatible = False   # consumes binned "codes", not raw "X"
     dynamic_params = {"learning_rate": np.float32,
                       "n_estimators": np.int32,
                       "subsample": np.float32}
@@ -219,6 +220,7 @@ class GradientBoostingClassifierFamily(GradientBoostingRegressorFamily):
 class RandomForestClassifierFamily(Family):
     name = "random_forest_classifier"
     is_classifier = True
+    keyed_compatible = False   # consumes binned "codes", not raw "X"
     dynamic_params = {"n_estimators": np.int32}
     _default_depth = 10
 
@@ -313,6 +315,9 @@ class RandomForestClassifierFamily(Family):
 
     @classmethod
     def decision(cls, model, static, X, meta):
+        if meta.get("n_classes") == 2:
+            # scorer contract: binary decision is a 1-D margin
+            return model["proba"][:, 1] - model["proba"][:, 0]
         return model["proba"]
 
     @classmethod

@@ -52,6 +52,10 @@ class TpuConfig:
     # profiling (SURVEY §5.1): wrap the sweep in a jax.profiler trace whose
     # artifacts land here (open with tensorboard / perfetto).
     profile_dir: Optional[str] = None
+    # NaN debugging (SURVEY §5.2): raise at the first non-finite value
+    # inside compiled fits instead of masking it into error_score — the
+    # checkify-style sanitizer for our purely-functional programs.
+    debug_nans: bool = False
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

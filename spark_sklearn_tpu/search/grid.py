@@ -49,6 +49,13 @@ from spark_sklearn_tpu.search.scorers import resolve_scoring
 from spark_sklearn_tpu.utils.native import fold_masks
 
 
+import contextlib as _contextlib
+import logging
+
+logger = logging.getLogger("spark_sklearn_tpu.search")
+_nullcontext = _contextlib.nullcontext
+
+
 def _looks_like_estimator(obj) -> bool:
     return hasattr(obj, "get_params") and (
         hasattr(obj, "fit") or hasattr(obj, "predict"))
@@ -101,6 +108,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         estimator = self.estimator
         candidates = list(self._get_candidates())
         cv = check_cv(self.cv, y, classifier=is_classifier(estimator))
+        from spark_sklearn_tpu.sparse.csr import CSRMatrix
+        if isinstance(X, CSRMatrix):
+            X = X.to_scipy()  # splitters/refit understand scipy CSR
         X_arr = X if hasattr(X, "shape") else np.asarray(X)
         splits = list(cv.split(X_arr, y, groups))
         self.n_splits_ = len(splits)
@@ -190,6 +200,24 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         return self
 
     @staticmethod
+    def _densify(X, dtype):
+        """Sparse inputs reach the compiled path as dense device arrays
+        (XLA has no first-class CSR; the native runtime does the threaded
+        decompression — the CSRVectorUDT analog's job).  The host path
+        receives sparse X unchanged, like sklearn."""
+        import scipy.sparse as sp
+
+        from spark_sklearn_tpu.utils.native import csr_to_dense
+
+        # CSRMatrix was already converted to scipy CSR at the top of fit()
+        if sp.issparse(X):
+            m = X.tocsr()
+            return csr_to_dense(
+                m.data, m.indices, m.indptr, m.shape).astype(
+                dtype, copy=False)
+        return np.asarray(X)
+
+    @staticmethod
     def _select_best_index(refit, refit_metric, results):
         if callable(refit):
             best_index = refit(results)
@@ -210,7 +238,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
 
-        X = np.asarray(X)
+        X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
         n_samples = X.shape[0]
         train_masks, test_masks = fold_masks(splits, n_samples, dtype=dtype)
@@ -229,6 +257,10 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
         mesh = build_mesh(config)
         n_task_shards = mesh.shape[mesh_lib.TASK_AXIS]
+        logger.info(
+            "compiled search: family=%s, %d candidates x %d folds, "
+            "%d compile group(s), mesh=%s", family.name, n_cand, n_folds,
+            len(groups), dict(mesh.shape))
         repl = mesh_lib.replicated_sharding(mesh)
         task_shard = mesh_lib.task_sharding(mesh)
 
@@ -276,7 +308,13 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             key = fingerprint(
                 type(self.estimator).__name__, base_params, candidates,
                 scorer_names, n_folds, return_train,
-                X[: min(64, n_samples)], np.asarray(train_masks))
+                X[: min(64, n_samples)],
+                # whole-dataset moments so ANY changed X row or label set
+                # breaks the fingerprint (head rows alone can collide)
+                (X.shape, float(np.sum(X, dtype=np.float64)),
+                 float(np.sum(np.square(X, dtype=np.float64)))),
+                np.asarray(y) if y is not None else "none",
+                np.asarray(train_masks))
             ckpt = SearchCheckpoint(config.checkpoint_dir, key)
 
         profiler_cm = None
@@ -284,6 +322,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             import jax.profiler as _prof
             profiler_cm = _prof.trace(config.profile_dir)
             profiler_cm.__enter__()
+        debug_ctx = (jax.debug_nans(True) if config.debug_nans
+                     else _nullcontext())
         self.search_report_ = {
             "backend": "tpu", "n_compile_groups": len(groups),
             "n_launches": 0, "n_chunks_resumed": 0,
@@ -308,16 +348,18 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 n_task_shards))
 
         try:
-            self._run_groups(
-                groups=groups, base_params=base_params, family=family,
-                meta=meta, scorers=scorers, scorer_names=scorer_names,
-                data_dev=data_dev, train_dev=train_dev, test_dev=test_dev,
-                train_masks=train_masks, mesh=mesh, config=config,
-                n_task_shards=n_task_shards, task_shard=task_shard,
-                max_cand_per_batch=max_cand_per_batch, n_folds=n_folds,
-                dtype=dtype, return_train=return_train,
-                test_scores=test_scores, train_scores=train_scores,
-                fit_times=fit_times, score_times=score_times, ckpt=ckpt)
+            with debug_ctx:
+                self._run_groups(
+                    groups=groups, base_params=base_params, family=family,
+                    meta=meta, scorers=scorers, scorer_names=scorer_names,
+                    data_dev=data_dev, train_dev=train_dev,
+                    test_dev=test_dev, train_masks=train_masks, mesh=mesh,
+                    config=config, n_task_shards=n_task_shards,
+                    task_shard=task_shard,
+                    max_cand_per_batch=max_cand_per_batch, n_folds=n_folds,
+                    dtype=dtype, return_train=return_train,
+                    test_scores=test_scores, train_scores=train_scores,
+                    fit_times=fit_times, score_times=score_times, ckpt=ckpt)
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)

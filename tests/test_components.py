@@ -428,3 +428,72 @@ class TestCheckpointAndSession:
         assert rep["backend"] == "tpu"
         assert rep["n_compile_groups"] == 1
         assert rep["fit_wall_s"] > 0
+
+
+class TestStandaloneEstimators:
+    def test_standalone_svc(self, digits):
+        from spark_sklearn_tpu.models.standalone import SVC
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        svc = SVC(C=1.0, gamma=0.05).fit(Xs, ys)
+        acc = np.mean(svc.predict(Xs) == ys)
+        assert acc > 0.95
+        # new-data predictions (representer path)
+        acc2 = np.mean(svc.predict(X[300:400]) == y[300:400])
+        assert acc2 > 0.8
+
+    def test_standalone_mlp_classifier(self, digits):
+        from spark_sklearn_tpu.models.standalone import MLPClassifier
+        X, y = digits
+        clf = MLPClassifier(hidden_layer_sizes=(64,), max_iter=40,
+                            random_state=0).fit(X, y)
+        assert np.mean(clf.predict(X) == y) > 0.9
+        proba = clf.predict_proba(X[:5])
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_standalone_mlp_regressor(self, diabetes):
+        from spark_sklearn_tpu.models.standalone import MLPRegressor
+        X, y = diabetes
+        yn = ((y - y.mean()) / y.std()).astype(np.float32)
+        reg = MLPRegressor(hidden_layer_sizes=(32,), max_iter=150,
+                           random_state=0).fit(X, yn)
+        pred = reg.predict(X)
+        ss = 1 - np.sum((yn - pred) ** 2) / np.sum((yn - yn.mean()) ** 2)
+        assert ss > 0.4
+
+    def test_standalone_clone(self):
+        from sklearn.base import clone
+        from spark_sklearn_tpu.models.standalone import SVC, MLPClassifier
+        assert clone(SVC(C=2.0)).C == 2.0
+        assert clone(MLPClassifier(alpha=0.5)).alpha == 0.5
+
+
+class TestKeyedContract:
+    def test_keyed_models_estimators_predict_on_both_backends(self,
+                                                              keyed_df):
+        """keyedModels estimator cells must expose .predict regardless of
+        backend (review: fleet path returned plain dicts)."""
+        from sklearn.tree import DecisionTreeRegressor
+        fleet = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(keyed_df)
+        host = sst.KeyedEstimator(
+            sklearnEstimator=DecisionTreeRegressor(max_depth=3),
+            keyCols=["k"], xCol="x", yCol="y").fit(keyed_df)
+        for km in (fleet, host):
+            est = km.keyedModels["estimator"].iloc[0]
+            pred = est.predict(np.zeros((2, 4)))
+            assert np.asarray(pred).shape == (2,)
+
+    def test_tree_estimator_skips_fleet_quietly(self, keyed_df):
+        """Tree families are keyed-incompatible: host loop, no warning,
+        no wasted binning (review #4)."""
+        import warnings as w
+        from sklearn.ensemble import RandomForestRegressor
+        with w.catch_warnings():
+            w.simplefilter("error", UserWarning)
+            km = sst.KeyedEstimator(
+                sklearnEstimator=RandomForestRegressor(
+                    n_estimators=5, max_depth=3, random_state=0),
+                keyCols=["k"], xCol="x", yCol="y").fit(keyed_df)
+        assert km.backend == "host"

@@ -1,0 +1,129 @@
+"""Scorer oracle tests: every compiled scorer vs the sklearn metric of the
+same name, on the same masked subset (the weighted-mask convention is the
+whole point — SURVEY §7.3 #2)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from spark_sklearn_tpu.search import scorers as S
+
+
+class _MockFamily:
+    """Family stub whose predictions are injected directly."""
+
+    is_classifier = True
+
+    def __init__(self, pred=None, dec=None, proba=None):
+        self._pred = pred
+        self._dec = dec
+        self._proba = proba
+
+    def predict(self, model, static, X, meta):
+        return self._pred
+
+    def decision(self, model, static, X, meta):
+        return self._dec
+
+    def predict_proba(self, model, static, X, meta):
+        return self._proba
+
+
+def _setup_binary(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    dec = rng.normal(size=n) + 1.5 * (y - 0.5)
+    pred = (dec > 0).astype(np.int32)
+    p1 = 1.0 / (1.0 + np.exp(-dec))
+    proba = np.stack([1 - p1, p1], axis=1)
+    mask = (rng.random(n) > 0.4).astype(np.float32)
+    return y, pred, dec, proba, mask
+
+
+@pytest.mark.parametrize("name,skfn", [
+    ("accuracy", skm.accuracy_score),
+    ("f1", skm.f1_score),
+    ("precision", skm.precision_score),
+    ("recall", skm.recall_score),
+])
+def test_binary_label_scorers_match_sklearn(name, skfn):
+    import jax.numpy as jnp
+    y, pred, dec, proba, mask = _setup_binary()
+    fam = _MockFamily(pred=jnp.asarray(pred))
+    data = {"X": jnp.zeros((len(y), 1)), "y": jnp.asarray(y)}
+    ours = float(S.SCORERS[name](
+        fam, {}, {}, data, {"n_classes": 2}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = skfn(y[sel], pred[sel])
+    assert abs(ours - theirs) < 1e-5, (name, ours, theirs)
+
+
+def test_roc_auc_matches_sklearn():
+    import jax.numpy as jnp
+    y, pred, dec, proba, mask = _setup_binary()
+    fam = _MockFamily(dec=jnp.asarray(dec))
+    data = {"X": jnp.zeros((len(y), 1)), "y": jnp.asarray(y)}
+    ours = float(S.SCORERS["roc_auc"](
+        fam, {}, {}, data, {"n_classes": 2}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = skm.roc_auc_score(y[sel], dec[sel])
+    assert abs(ours - theirs) < 1e-4
+
+
+def test_neg_log_loss_matches_sklearn():
+    import jax.numpy as jnp
+    y, pred, dec, proba, mask = _setup_binary()
+    fam = _MockFamily(proba=jnp.asarray(proba))
+    data = {"X": jnp.zeros((len(y), 1)), "y": jnp.asarray(y)}
+    ours = float(S.SCORERS["neg_log_loss"](
+        fam, {}, {}, data, {"n_classes": 2}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = -skm.log_loss(y[sel], proba[sel], labels=[0, 1])
+    assert abs(ours - theirs) < 1e-4
+
+
+def test_f1_macro_matches_sklearn():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 4, 300)
+    pred = np.where(rng.random(300) < 0.7, y, rng.integers(0, 4, 300))
+    mask = (rng.random(300) > 0.3).astype(np.float32)
+    fam = _MockFamily(pred=jnp.asarray(pred.astype(np.int32)))
+    data = {"X": jnp.zeros((300, 1)), "y": jnp.asarray(y)}
+    ours = float(S.SCORERS["f1_macro"](
+        fam, {}, {}, data, {"n_classes": 4}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = skm.f1_score(y[sel], pred[sel], average="macro",
+                          labels=[0, 1, 2, 3])
+    assert abs(ours - theirs) < 1e-5
+
+
+@pytest.mark.parametrize("name,skfn", [
+    ("r2", skm.r2_score),
+    ("neg_mean_squared_error", lambda a, b: -skm.mean_squared_error(a, b)),
+    ("neg_root_mean_squared_error",
+     lambda a, b: -skm.root_mean_squared_error(a, b)),
+    ("neg_mean_absolute_error",
+     lambda a, b: -skm.mean_absolute_error(a, b)),
+    ("neg_median_absolute_error",
+     lambda a, b: -skm.median_absolute_error(a, b)),
+    ("max_error", lambda a, b: -skm.max_error(a, b)),
+])
+def test_regression_scorers_match_sklearn(name, skfn):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    n = 257  # odd so the weighted median path is non-trivial
+    y = rng.normal(size=n).astype(np.float64)
+    pred = y + 0.3 * rng.normal(size=n)
+    mask = (rng.random(n) > 0.35).astype(np.float32)
+    fam = _MockFamily(pred=jnp.asarray(pred, jnp.float32))
+    fam.is_classifier = False
+    data = {"X": jnp.zeros((n, 1)), "y": jnp.asarray(y, jnp.float32)}
+    ours = float(S.SCORERS[name](fam, {}, {}, data, {}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = skfn(y[sel], pred[sel])
+    tol = 2e-2 if name == "neg_median_absolute_error" else 1e-3
+    # sklearn max_error is positive; ours returns the negated utility form
+    if name == "max_error":
+        theirs = skfn(y[sel], pred[sel])
+    assert abs(ours - theirs) < tol, (name, ours, theirs)

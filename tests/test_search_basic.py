@@ -205,3 +205,37 @@ class TestSklearnEstimatorContract:
         rs = sst.RandomizedSearchCV(SkLogReg(), {"C": [1.0]}, n_iter=1)
         assert clone(rs).n_iter == 1
         assert "RandomizedSearchCV" in repr(rs)
+
+
+class TestSparseInput:
+    def test_scipy_sparse_compiled_matches_dense(self, digits):
+        import scipy.sparse as sp
+        X, y = digits
+        Xs = sp.csr_matrix(X)
+        dense = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [1.0]}, cv=3,
+            backend="tpu", refit=False).fit(X, y)
+        sparse = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [1.0]}, cv=3,
+            backend="tpu", refit=False).fit(Xs, y)
+        np.testing.assert_allclose(
+            dense.cv_results_["mean_test_score"],
+            sparse.cv_results_["mean_test_score"], atol=1e-6)
+
+    def test_csrmatrix_container_input(self, digits):
+        import scipy.sparse as sp
+        X, y = digits
+        c = sst.CSRMatrix.from_scipy(sp.csr_matrix(X))
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=100), {"C": [1.0]}, cv=3).fit(c, y)
+        assert gs.best_score_ > 0.9  # refit on scipy-converted X works
+
+    def test_sparse_host_path_untouched(self, digits):
+        import scipy.sparse as sp
+        from sklearn.tree import DecisionTreeClassifier
+        X, y = digits
+        Xs = sp.csr_matrix(X)
+        gs = sst.GridSearchCV(
+            DecisionTreeClassifier(random_state=0), {"max_depth": [3]},
+            cv=3).fit(Xs, y)
+        assert gs.best_score_ > 0.4

@@ -133,3 +133,16 @@ class TestCheckpointTrainScores:
                               return_train_score=True)
         g2.fit(X, y)  # different fingerprint -> fresh run, no crash
         assert "mean_train_score" in g2.cv_results_
+
+    def test_rfc_binary_roc_auc(self, digits):
+        """Regression: binary RF decision must be 1-D for roc_auc (same
+        contract fix as GBC)."""
+        from sklearn.ensemble import RandomForestClassifier
+        X, y = digits
+        m = y < 2
+        gs = sst.GridSearchCV(
+            RandomForestClassifier(n_estimators=10, max_depth=4,
+                                   random_state=0),
+            {"min_samples_leaf": [1]}, cv=3, scoring="roc_auc",
+            backend="tpu").fit(X[m][:200], y[m][:200])
+        assert 0.5 < gs.best_score_ <= 1.0
