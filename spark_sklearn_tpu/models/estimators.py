@@ -27,17 +27,27 @@ from spark_sklearn_tpu.models.linear import (
 
 
 class _TpuEstimatorBase(BaseEstimator):
+    """Single-fit plumbing shared by every native estimator (linear here,
+    MLP in standalone.py): prepare -> params from the sklearn contract ->
+    one jitted family fit with all-ones weights -> fitted attrs."""
+
     _family = None
 
     def _fit_family(self, X, y, sample_weight=None):
+        import jax
+
         family = self._family
         X = np.asarray(X)
         data, meta = family.prepare_data(X, y)
         n = X.shape[0]
-        w = (np.ones(n, dtype=data["X"].dtype) if sample_weight is None
-             else np.asarray(sample_weight, dtype=data["X"].dtype))
+        w = (np.ones(n, dtype=np.float32) if sample_weight is None
+             else np.asarray(sample_weight, dtype=np.float32))
         params = family.extract_params(self)
-        model = family.fit({}, params, data, jnp.asarray(w), meta)
+        if hasattr(family, "observe_candidates"):
+            family.observe_candidates([], params, meta)
+        model = jax.jit(
+            lambda d, wv: family.fit({}, params, d, wv, meta))(
+            {k: jnp.asarray(v) for k, v in data.items()}, jnp.asarray(w))
         self._model = model
         self._meta = meta
         self._static = params
@@ -46,7 +56,7 @@ class _TpuEstimatorBase(BaseEstimator):
         return self
 
     def _predict_family(self, X):
-        X = jnp.asarray(np.asarray(X), self._model["coef"].dtype)
+        X = jnp.asarray(np.asarray(X), jnp.float32)
         return self._family.predict(self._model, self._static, X, self._meta)
 
 

@@ -145,12 +145,18 @@ class LogisticRegressionFamily(Family):
                 "class_weight is not compiled; use the host backend")
         inv_C = (1.0 / C) if penalty == "l2" else jnp.zeros_like(C)
         wT = train_w.T                                        # (n, B)
+        # MXU-native precision: cast matmul OPERANDS to bf16, accumulate
+        # fp32; everything else (losses, solver state) stays fp32
+        bf16 = bool(static.get("__bf16__", False))
+        mm_dtype = jnp.bfloat16 if bf16 else X.dtype
+        Xm = X.astype(mm_dtype)
 
         if k == 2:
             yb = data["y"].astype(X.dtype)                    # (n,)
 
             def Ax(x):                                        # -> Z (n, B)
-                Z = X @ x[:, :d].T                            # ONE matmul
+                Z = jnp.matmul(Xm, x[:, :d].astype(mm_dtype).T,
+                               preferred_element_type=X.dtype)
                 return Z + x[None, :, d] if fit_intercept else Z
 
             def data_loss(Z):
@@ -161,7 +167,8 @@ class LogisticRegressionFamily(Family):
                 return wT * (jax.nn.sigmoid(Z) - yb[:, None])
 
             def AT(G):                                        # -> (B, d+1)
-                gW = G.T @ X                                  # ONE matmul
+                gW = jnp.matmul(G.astype(mm_dtype).T, Xm,
+                                preferred_element_type=X.dtype)
                 gb = jnp.sum(G, axis=0) if fit_intercept else \
                     jnp.zeros((B,), X.dtype)
                 return jnp.concatenate([gW, gb[:, None]], axis=1)
@@ -188,8 +195,9 @@ class LogisticRegressionFamily(Family):
         kd = k * d
 
         def Ax(x):                                            # -> Z (n,B,k)
-            W = x[:, :kd].reshape(B, k, d)
-            Z = jnp.einsum("nd,bkd->nbk", X, W)               # ONE matmul
+            W = x[:, :kd].reshape(B, k, d).astype(mm_dtype)
+            Z = jnp.einsum("nd,bkd->nbk", Xm, W,              # ONE matmul
+                           preferred_element_type=X.dtype)
             return Z + x[None, :, kd:] if fit_intercept else Z
 
         def data_loss(Z):
@@ -202,7 +210,8 @@ class LogisticRegressionFamily(Family):
             return wT[:, :, None] * (P - y1h[:, None, :])
 
         def AT(G):                                            # -> (B, D)
-            gW = jnp.einsum("nbk,nd->bkd", G, X)              # ONE matmul
+            gW = jnp.einsum("nbk,nd->bkd", G.astype(mm_dtype), Xm,
+                            preferred_element_type=X.dtype)   # ONE matmul
             gW = gW.reshape(B, kd)
             gb = jnp.sum(G, axis=0) if fit_intercept else \
                 jnp.zeros((B, k), X.dtype)

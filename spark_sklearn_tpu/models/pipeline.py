@@ -134,4 +134,8 @@ def make_pipeline_family(pipeline) -> Optional[PipelineFamily]:
         # families exposing only fit_task_batched (SVC) can't compose with
         # per-task fold-transformed inputs yet -> whole pipeline to Tier B
         return None
+    if not getattr(final_family, "keyed_compatible", True):
+        # tree families consume pre-binned "codes", not the raw "X" the
+        # transformer chain produces -> whole pipeline to Tier B
+        return None
     return PipelineFamily(resolved, final_name, final_family)

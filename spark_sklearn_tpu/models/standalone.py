@@ -59,14 +59,15 @@ class SVC(ClassifierMixin, BaseEstimator):
         return np.asarray(K @ self._alphas.T)        # (n_new, P)
 
     def _solve_alphas(self):
-        from spark_sklearn_tpu.models.svm import _kernel, _pairs
-        import jax
+        """One dual solve via the family's shared FISTA kernel
+        (models/svm.py::fista_dual_ascent — numerics live in one place)."""
+        from spark_sklearn_tpu.models.svm import (
+            _kernel, _power_step, fista_dual_ascent)
         X = jnp.asarray(self._X_train)
         y = jnp.asarray(self._y)
         n = X.shape[0]
         k = self._meta["n_classes"]
         pairs = jnp.asarray(self._meta["pairs"])
-        P = pairs.shape[0]
         K = _kernel(X, X, self._static.get("kernel", "rbf"),
                     self._gamma_val, float(self._static.get("degree", 3)),
                     float(self._static.get("coef0", 0.0))) + 1.0
@@ -76,27 +77,12 @@ class SVC(ClassifierMixin, BaseEstimator):
         if k == 2:
             yb = -yb
         box = (ypos | yneg).astype(jnp.float32)
-        v = jnp.ones((n,), jnp.float32) / jnp.sqrt(n)
-        for _ in range(20):
-            v = K @ v
-            v = v / (jnp.linalg.norm(v) + 1e-12)
-        step = 1.0 / (jnp.dot(v, K @ v) + 1e-6)
         C = float(self._static.get("C", 1.0))
         max_iter = int(self._static.get("max_iter", -1))
         if max_iter in (-1, 0):
             max_iter = 300
-
-        def ascent(i, carry):
-            A, Z, t = carry
-            grad = 1.0 - yb * ((Z * yb) @ K)
-            A_new = jnp.clip(Z + step * grad, 0.0, C) * box
-            t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-            Z_new = A_new + ((t - 1.0) / t_new) * (A_new - A)
-            return A_new, Z_new, t_new
-
-        A0 = jnp.zeros((P, n), jnp.float32)
-        A, _, _ = jax.lax.fori_loop(
-            0, max_iter, ascent, (A0, A0, jnp.asarray(1.0, jnp.float32)))
+        A = fista_dual_ascent(K, yb, box, C,
+                              _power_step(K, n, jnp.float32), max_iter)
         return np.asarray(A * yb)                     # signed alphas
 
     def decision_function(self, X):
@@ -114,38 +100,10 @@ class SVC(ClassifierMixin, BaseEstimator):
         return self.classes_[idx]
 
 
-class _FamilySingleFit:
-    """Shared single-fit plumbing for families with a per-task fit."""
-
-    _family = None
-
-    def _fit(self, X, y):
-        fam = self._family
-        X = np.asarray(X, np.float32)
-        data, meta = fam.prepare_data(X, y)
-        static = dict(self.get_params(deep=False))
-        if hasattr(fam, "observe_candidates"):
-            fam.observe_candidates([], static, meta)
-        w = jnp.ones((X.shape[0],), jnp.float32)
-        import jax
-        model = jax.jit(
-            lambda d, wv: fam.fit({}, static, d, wv, meta))(
-            {k: jnp.asarray(v) for k, v in data.items()}, w)
-        self._model = model
-        self._meta = meta
-        self._static = static
-        if "classes" in meta:
-            self.classes_ = meta["classes"]
-        self.n_features_in_ = meta["n_features"]
-        return self
-
-    def _raw_predict(self, X):
-        return self._family.predict(
-            self._model, self._static,
-            jnp.asarray(np.asarray(X, np.float32)), self._meta)
+from spark_sklearn_tpu.models.estimators import _TpuEstimatorBase
 
 
-class MLPClassifier(ClassifierMixin, _FamilySingleFit, BaseEstimator):
+class MLPClassifier(ClassifierMixin, _TpuEstimatorBase):
     from spark_sklearn_tpu.models.mlp import MLPClassifierFamily as _family
 
     def __init__(self, hidden_layer_sizes=(100,), activation="relu",
@@ -166,10 +124,10 @@ class MLPClassifier(ClassifierMixin, _FamilySingleFit, BaseEstimator):
         self.epsilon = epsilon
 
     def fit(self, X, y):
-        return self._fit(X, y)
+        return self._fit_family(X, y)
 
     def predict(self, X):
-        return self.classes_[np.asarray(self._raw_predict(X))]
+        return self.classes_[np.asarray(self._predict_family(X))]
 
     def predict_proba(self, X):
         return np.asarray(self._family.predict_proba(
@@ -177,7 +135,7 @@ class MLPClassifier(ClassifierMixin, _FamilySingleFit, BaseEstimator):
             jnp.asarray(np.asarray(X, np.float32)), self._meta))
 
 
-class MLPRegressor(RegressorMixin, _FamilySingleFit, BaseEstimator):
+class MLPRegressor(RegressorMixin, _TpuEstimatorBase):
     from spark_sklearn_tpu.models.mlp import MLPRegressorFamily as _family
 
     def __init__(self, hidden_layer_sizes=(100,), activation="relu",
@@ -193,7 +151,7 @@ class MLPRegressor(RegressorMixin, _FamilySingleFit, BaseEstimator):
         self.random_state = random_state
 
     def fit(self, X, y):
-        return self._fit(X, y)
+        return self._fit_family(X, y)
 
     def predict(self, X):
-        return np.asarray(self._raw_predict(X))
+        return np.asarray(self._predict_family(X))

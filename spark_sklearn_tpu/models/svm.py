@@ -54,6 +54,44 @@ def _kernel(X1, X2, kind, gamma, degree, coef0):
     return jnp.exp(-gamma * jnp.maximum(d2, 0.0))
 
 
+def _power_step(K, n, dtype):
+    """1/lambda_max(K) via power iteration — a safe ascent step for every
+    masked/sign-flipped subproblem (principal submatrices of a PSD matrix
+    cannot have a larger top eigenvalue)."""
+    v = jnp.ones((n,), dtype) / jnp.sqrt(n)
+
+    def power(i, v):
+        v = K @ v
+        return v / (jnp.linalg.norm(v) + 1e-12)
+
+    v = jax.lax.fori_loop(0, 20, power, v)
+    return 1.0 / (jnp.dot(v, K @ v) + 1e-6)
+
+
+def fista_dual_ascent(K, yb, box, C, step, max_iter):
+    """Nesterov-accelerated box-projected gradient ascent on the SVM dual.
+
+    K: (n, n) kernel (+1 bias absorption already applied); yb/box: (M, n)
+    signed labels and box masks for M subproblems advanced together —
+    every iteration is ONE (M, n) @ (n, n) matmul.  Shared by the search's
+    task-batched fit and the standalone SVC so the numerics live once.
+    """
+
+    def ascent(i, carry):
+        A, Z, t = carry
+        V = (Z * yb) @ K
+        grad = 1.0 - yb * V
+        A_new = jnp.clip(Z + step * grad, 0.0, C) * box
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        Z_new = A_new + ((t - 1.0) / t_new) * (A_new - A)
+        return A_new, Z_new, t_new
+
+    A0 = jnp.zeros_like(box)
+    A, _, _ = jax.lax.fori_loop(
+        0, max_iter, ascent, (A0, A0, jnp.asarray(1.0, K.dtype)))
+    return A
+
+
 def _resolve_gamma(gamma, meta):
     if isinstance(gamma, str):
         if gamma == "scale":
@@ -152,37 +190,11 @@ class SVCFamily(Family):
         def one_candidate(carry, inp):
             C_c, g_c, w_f = inp                               # w_f (F, n)
             K = _kernel(X, X, kind, g_c, degree, coef0) + 1.0  # (n, n)
-            # step size: 1/lambda_max via power iteration
-            v = jnp.ones((n,), X.dtype) / jnp.sqrt(n)
-
-            def power(i, v):
-                v = K @ v
-                return v / (jnp.linalg.norm(v) + 1e-12)
-
-            v = jax.lax.fori_loop(0, 20, power, v)
-            lam = jnp.dot(v, K @ v)
-            step = 1.0 / (lam + 1e-6)
-
+            step = _power_step(K, n, X.dtype)
             # subproblem masks: (F, P, n) -> flatten (F*P, n)
             box = (w_f[:, None, :] * in_pair[None, :, :]).reshape(-1, n)
             yb = jnp.broadcast_to(ybin[None], (n_folds, P, n)).reshape(-1, n)
-            A0 = jnp.zeros_like(box)
-
-            def ascent(i, carry):
-                # Nesterov-accelerated projected gradient (FISTA) on the
-                # box-constrained dual — O(1/t^2) vs plain PG's O(1/t),
-                # still exactly ONE kernel matmul per iteration
-                A, Z, t = carry
-                V = (Z * yb) @ K
-                grad = 1.0 - yb * V
-                A_new = jnp.clip(Z + step * grad, 0.0, C_c) * box
-                t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-                Z_new = A_new + ((t - 1.0) / t_new) * (A_new - A)
-                return A_new, Z_new, t_new
-
-            A, _, _ = jax.lax.fori_loop(
-                0, max_iter, ascent,
-                (A0, A0, jnp.asarray(1.0, X.dtype)))
+            A = fista_dual_ascent(K, yb, box, C_c, step, max_iter)
             dec = ((A * yb) @ K).reshape(n_folds, P, n)       # (F, P, n)
             return carry, jnp.transpose(dec, (0, 2, 1))       # (F, n, P)
 

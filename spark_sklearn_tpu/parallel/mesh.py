@@ -56,6 +56,10 @@ class TpuConfig:
     # inside compiled fits instead of masking it into error_score — the
     # checkify-style sanitizer for our purely-functional programs.
     debug_nans: bool = False
+    # bf16 data matmuls with fp32 accumulation (solver state stays fp32):
+    # the MXU's native precision — typically ~2x on v5e for the GLM hot
+    # path at a small, oracle-tested score tolerance cost.
+    bf16_matmul: bool = False
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

@@ -200,6 +200,17 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         return self
 
     @staticmethod
+    def _hashable_labels(y):
+        """Deterministic bytes for the checkpoint fingerprint: object-dtype
+        labels would hash pointer addresses."""
+        if y is None:
+            return "none"
+        y_arr = np.asarray(y)
+        if y_arr.dtype == object:
+            y_arr = y_arr.astype(str)
+        return y_arr
+
+    @staticmethod
     def _densify(X, dtype):
         """Sparse inputs reach the compiled path as dense device arrays
         (XLA has no first-class CSR; the native runtime does the threaded
@@ -313,7 +324,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 # breaks the fingerprint (head rows alone can collide)
                 (X.shape, float(np.sum(X, dtype=np.float64)),
                  float(np.sum(np.square(X, dtype=np.float64)))),
-                np.asarray(y) if y is not None else "none",
+                self._hashable_labels(y),
                 np.asarray(train_masks))
             ckpt = SearchCheckpoint(config.checkpoint_dir, key)
 
@@ -364,6 +375,22 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
 
+        # a NaN hyperparameter is a failed fit (sklearn raises at
+        # validation; our solvers are too robust to blow up, so the chance-
+        # level score they produce must not masquerade as a result).  inf
+        # stays legal — sklearn itself uses C=np.inf for "no penalty".
+        bad_cand = np.zeros(n_cand, bool)
+        for group in groups:
+            for arr in group.dynamic_params.values():
+                if np.issubdtype(arr.dtype, np.floating):
+                    bad_cand[group.candidate_indices[
+                        np.isnan(arr)]] = True
+        if bad_cand.any():
+            for s in scorer_names:
+                test_scores[s][bad_cand, :] = np.nan
+                if return_train:
+                    train_scores[s][bad_cand, :] = np.nan
+
         self._handle_error_score(test_scores, train_scores, scorer_names)
         # scorer_ keeps the sklearn-facing objects so .score() works the
         # sklearn way even though CV scoring ran compiled
@@ -403,7 +430,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 w_task_dev = jax.device_put(w_task, tb_mask_shard)
 
                 def fit_batch_tb(dyn_t, data_d, w_t,
-                                 static={**static, "__n_folds__": n_folds}):
+                                 static={**static, "__n_folds__": n_folds,
+                                         "__bf16__": config.bf16_matmul}):
                     model = family.fit_task_batched(
                         dyn_t, static, data_d, w_t, meta)
                     return jax.tree_util.tree_map(

@@ -239,3 +239,68 @@ class TestSparseInput:
             DecisionTreeClassifier(random_state=0), {"max_depth": [3]},
             cv=3).fit(Xs, y)
         assert gs.best_score_ > 0.4
+
+
+class TestMoreOracles:
+    def test_elasticnet_lasso_oracle(self, diabetes):
+        from sklearn.linear_model import ElasticNet, Lasso
+        from sklearn.model_selection import GridSearchCV as SkGS
+        X, y = diabetes
+        yn = ((y - y.mean()) / y.std()).astype(np.float32)
+        grid = {"alpha": [0.001, 0.01, 0.1]}
+        ours = sst.GridSearchCV(
+            ElasticNet(max_iter=2000), grid, cv=3, backend="tpu").fit(X, yn)
+        theirs = SkGS(ElasticNet(max_iter=2000), grid, cv=3).fit(X, yn)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.02)
+        lou = sst.GridSearchCV(
+            Lasso(max_iter=2000), grid, cv=3, backend="tpu").fit(X, yn)
+        lth = SkGS(Lasso(max_iter=2000), grid, cv=3).fit(X, yn)
+        np.testing.assert_allclose(
+            lou.cv_results_["mean_test_score"],
+            lth.cv_results_["mean_test_score"], atol=0.02)
+
+    def test_compiled_error_score_masks_nonfinite(self, digits):
+        """error_score on the COMPILED path: a candidate engineered to
+        produce non-finite scores is masked, not fatal."""
+        X, y = digits
+        with pytest.warns(UserWarning, match="non-finite"):
+            gs = sst.GridSearchCV(
+                SkLogReg(max_iter=50),
+                {"C": [1.0, float("nan")]}, cv=3, backend="tpu",
+                error_score=-1.0, refit=False).fit(X, y)
+        assert gs.cv_results_["mean_test_score"][1] == -1.0
+        assert gs.cv_results_["mean_test_score"][0] > 0.8
+
+    def test_compiled_error_score_raise(self, digits):
+        X, y = digits
+        with pytest.raises(ValueError, match="non-finite"):
+            sst.GridSearchCV(
+                SkLogReg(max_iter=50), {"C": [float("nan")]}, cv=3,
+                backend="tpu", error_score="raise", refit=False).fit(X, y)
+
+    def test_pipeline_with_tree_final_goes_host(self, digits):
+        """Pipeline ending in a tree family must skip the compiled path
+        up front (data-contract mismatch)."""
+        from sklearn.ensemble import GradientBoostingClassifier
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+        from spark_sklearn_tpu.models.base import resolve_family
+        pipe = Pipeline([("s", StandardScaler()),
+                         ("g", GradientBoostingClassifier())])
+        assert resolve_family(pipe) is None
+
+    def test_bf16_matmul_score_parity(self, digits):
+        """bf16 MXU matmuls must stay within a small tolerance of fp32."""
+        X, y = digits
+        grid = {"C": [0.1, 1.0, 10.0]}
+        fp32 = sst.GridSearchCV(
+            SkLogReg(max_iter=100), grid, cv=3, backend="tpu",
+            refit=False).fit(X, y)
+        bf16 = sst.GridSearchCV(
+            SkLogReg(max_iter=100), grid, cv=3, backend="tpu",
+            refit=False, config=sst.TpuConfig(bf16_matmul=True)).fit(X, y)
+        np.testing.assert_allclose(
+            fp32.cv_results_["mean_test_score"],
+            bf16.cv_results_["mean_test_score"], atol=0.015)
