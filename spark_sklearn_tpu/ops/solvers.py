@@ -183,12 +183,14 @@ def glm_lbfgs_batched(
     The TPU-shaped trick: logits are linear in the parameters, so along a
     search direction p the logits move as Z(x + a*p) = Zx + a*Zp.  Carrying
     Zx in the solver state means one iteration costs exactly TWO wide
-    matmuls — Ax(p) forward and AT(dL/dZ) backward — and the entire
-    backtracking line search (all `ls_trials` step sizes, every lane) is
-    *elementwise*, evaluated in one shot instead of a sequential
-    `while_loop` of full loss evaluations.  Measured on the 1000-candidate
-    digits grid this is ~6x over the generic `lbfgs_batched` and ~30x over
-    vmapping the scalar solver.
+    matmuls — Ax(p) forward and AT(dL/dZ) backward — and every
+    backtracking trial is *elementwise* on Zx + a*Zp (no matmul).  Trials
+    run in a while_loop that exits as soon as every live lane has an
+    accepted step — almost always after the first trial — instead of
+    paying all `ls_trials` evaluations.  Measured on the 1000-candidate
+    digits grid this layout is ~6x over a generic batched L-BFGS (whose
+    line search re-evaluates full losses) and ~30x over vmapping the
+    scalar solver.
     """
     m = history
     B, D = x0.shape
@@ -265,26 +267,41 @@ def glm_lbfgs_batched(
             jnp.minimum(jnp.ones((B,), dtype), 1.0 / (gnorm(g) + eps)),
             jnp.ones((B,), dtype))
 
-        # --- matmul-free exhaustive line search ---------------------------
+        # --- matmul-free backtracking line search -------------------------
+        # Z moves linearly along p, so each trial is elementwise on
+        # Zx + a*Zp; lanes halve independently and the loop exits as soon
+        # as EVERY live lane has an accepted step (almost always the very
+        # first trial), instead of paying all ls_trials evaluations
         Zp = Ax(p)                                   # the ONE forward matmul
-        factors = (0.5 ** jnp.arange(ls_trials, dtype=dtype))    # (T,)
-        alphas = a0[None, :] * factors[:, None]                   # (T, B)
 
-        def trial(i, carry):
-            best_alpha, best_f, found = carry
-            a = alphas[i]
+        def eval_trial(a):
             Zt = Z + _bcast(a, Z) * Zp
-            ft = data_loss(Zt) + reg_loss(x + a[:, None] * p)
-            ok = ft <= f + c1 * a * dginit
-            take = jnp.logical_and(ok, jnp.logical_not(found))
-            best_alpha = jnp.where(take, a, best_alpha)
-            best_f = jnp.where(take, ft, best_f)
-            return best_alpha, best_f, jnp.logical_or(found, ok)
+            return data_loss(Zt) + reg_loss(x + a[:, None] * p)
 
-        init = (jnp.zeros((B,), dtype), f, jnp.zeros((B,), bool))
-        alpha, f_ls, found = lax.fori_loop(0, ls_trials, trial, init)
-        # no trial passed: take the smallest step rather than stalling
-        alpha = jnp.where(found, alpha, alphas[-1])
+        f0_try = eval_trial(a0)
+        found0 = jnp.logical_or(f0_try <= f + c1 * a0 * dginit, st["done"])
+
+        def ls_cond(carry):
+            a, best_a, found, t = carry
+            return jnp.logical_and(t < ls_trials,
+                                   jnp.logical_not(jnp.all(found)))
+
+        def ls_body(carry):
+            a, best_a, found, t = carry
+            a = jnp.where(found, a, a * 0.5)
+            ft = eval_trial(a)
+            ok = ft <= f + c1 * a * dginit
+            newly = jnp.logical_and(ok, jnp.logical_not(found))
+            best_a = jnp.where(newly, a, best_a)
+            return a, best_a, jnp.logical_or(found, newly), t + 1
+
+        _, alpha, found, _ = lax.while_loop(
+            ls_cond, ls_body,
+            (a0, jnp.where(found0, a0, 0.0), found0,
+             jnp.asarray(1, jnp.int32)))
+        # no trial passed: take the last (smallest) step rather than stall
+        alpha = jnp.where(found, alpha,
+                          a0 * (0.5 ** (ls_trials - 1)))
 
         x_new = x + alpha[:, None] * p
         Z_new = Z + _bcast(alpha, Z) * Zp

@@ -60,6 +60,10 @@ class TpuConfig:
     # the MXU's native precision — typically ~2x on v5e for the GLM hot
     # path at a small, oracle-tested score tolerance cost.
     bf16_matmul: bool = False
+    # persistent XLA compilation cache: compiled search programs survive
+    # process restarts (jax_compilation_cache_dir), so repeated searches
+    # over the same shapes skip the cold compile entirely.
+    compile_cache_dir: Optional[str] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

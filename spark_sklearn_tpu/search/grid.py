@@ -245,6 +245,15 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     def _fit_compiled(self, family, X, y, candidates, splits):
         from sklearn.metrics import check_scoring
         config = self.config or TpuConfig()
+        if config.compile_cache_dir and (
+                jax.config.jax_compilation_cache_dir
+                != config.compile_cache_dir):
+            # only-if-different: never clobber a user's own cache settings
+            # from a search that didn't ask for one
+            jax.config.update("jax_compilation_cache_dir",
+                              config.compile_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
         dtype = config.dtype or np.float32
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
