@@ -1,0 +1,140 @@
+"""The five BASELINE.json benchmark configs as runnable scripts
+(SURVEY §7.1 layer 7: "the five BASELINE configs as runnable scripts").
+
+    python examples/baseline_configs.py            # run all five (small)
+    python examples/baseline_configs.py 2 --full   # one config, full size
+
+Each config prints the searched grid, best params/score, and wall time.
+`--full` uses the BASELINE-scale datasets (slow on CPU; meant for TPU).
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _data_digits():
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)
+    return (X / 16.0).astype(np.float32), y
+
+
+def config1(full):
+    """LogisticRegression GridSearchCV on digits — 10 C values x 5-fold."""
+    from sklearn.linear_model import LogisticRegression
+    import spark_sklearn_tpu as sst
+
+    X, y = _data_digits()
+    n_c = 1000 if full else 10
+    gs = sst.GridSearchCV(
+        LogisticRegression(max_iter=100),
+        {"C": list(np.logspace(-4, 3, n_c))}, cv=5)
+    return gs, X, y
+
+
+def config2(full):
+    """SVC(rbf) grid C x gamma (MNIST-10k at full scale, digits small)."""
+    from sklearn.svm import SVC
+    import spark_sklearn_tpu as sst
+
+    if full:
+        from sklearn.datasets import fetch_openml
+        mn = fetch_openml("mnist_784", version=1, as_frame=False)
+        X = (mn.data[:10000] / 255.0).astype(np.float32)
+        y = mn.target[:10000]
+    else:
+        X, y = _data_digits()
+        X, y = X[:500], y[:500]
+    gs = sst.GridSearchCV(
+        SVC(kernel="rbf"),
+        {"C": [0.5, 5.0], "gamma": [0.01, 0.05]}, cv=3)
+    return gs, X, y
+
+
+def config3(full):
+    """RandomizedSearchCV over RandomForestClassifier on covtype."""
+    from scipy.stats import randint
+    from sklearn.ensemble import RandomForestClassifier
+    import spark_sklearn_tpu as sst
+
+    if full:
+        from sklearn.datasets import fetch_covtype
+        cov = fetch_covtype()
+        X = cov.data[:50000].astype(np.float32)
+        y = cov.target[:50000]
+        n_iter, trees, depth = 10, (50, 150), (6, 11)
+    else:
+        X, y = _data_digits()
+        X, y = X[:400], y[:400]
+        n_iter, trees, depth = 4, (10, 30), (3, 6)
+    rs = sst.RandomizedSearchCV(
+        RandomForestClassifier(random_state=0),
+        {"n_estimators": randint(*trees), "max_depth": randint(*depth)},
+        n_iter=n_iter, cv=3, random_state=0)
+    return rs, X, y
+
+
+def config4(full):
+    """GradientBoostingRegressor grid on California Housing."""
+    from sklearn.ensemble import GradientBoostingRegressor
+    import spark_sklearn_tpu as sst
+
+    try:
+        from sklearn.datasets import fetch_california_housing
+        d = fetch_california_housing()
+        X, y = d.data.astype(np.float32), d.target.astype(np.float32)
+        if not full:
+            X, y = X[:2000], y[:2000]
+    except Exception:  # offline images: diabetes stands in
+        from sklearn.datasets import load_diabetes
+        X, y = load_diabetes(return_X_y=True)
+        X = X.astype(np.float32)
+        y = y.astype(np.float32)
+    gs = sst.GridSearchCV(
+        GradientBoostingRegressor(max_depth=3, random_state=0),
+        {"learning_rate": [0.05, 0.1], "n_estimators": [50, 100]}, cv=3)
+    return gs, X, y
+
+
+def config5(full):
+    """Pipeline(StandardScaler + MLPClassifier) grid — clone()/set_params
+    routing on TPU."""
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+    import spark_sklearn_tpu as sst
+
+    X, y = _data_digits()
+    gs = sst.GridSearchCV(
+        Pipeline([("scale", StandardScaler()),
+                  ("mlp", MLPClassifier(hidden_layer_sizes=(64,),
+                                        max_iter=60 if full else 30,
+                                        random_state=0))]),
+        {"mlp__alpha": [1e-4, 1e-2]}, cv=3)
+    return gs, X, y
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def run(idx, full):
+    gs, X, y = CONFIGS[idx](full)
+    t0 = time.perf_counter()
+    gs.fit(X, y)
+    wall = time.perf_counter() - t0
+    print(f"config {idx}: {type(gs.estimator).__name__} "
+          f"n={len(gs.cv_results_['params'])} candidates, "
+          f"best={gs.best_params_}, score={gs.best_score_:.4f}, "
+          f"wall={wall:.1f}s, backend={gs.search_report_['backend']}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", nargs="?", type=int, default=None)
+    ap.add_argument("--full", action="store_true")
+    args = ap.parse_args()
+    which = [args.config] if args.config else sorted(CONFIGS)
+    for i in which:
+        run(i, args.full)

@@ -36,6 +36,17 @@ class PipelineFamily:
             f"{final_name}__{k}": v
             for k, v in final_family.dynamic_params.items()
         }
+        # forward the final step's default scorer (e.g. KMeans -> -inertia)
+        # through the transformer chain
+        final_default = getattr(final_family, "default_scorer", None)
+        if final_default is not None:
+            def default_scorer(family, model, static, data, meta, w,
+                               _fd=final_default):
+                Xt = family._transform(model, static, data["X"])
+                return _fd(family.final, model["final"],
+                           family._final_static(static),
+                           {**data, "X": Xt}, meta, w)
+            self.default_scorer = default_scorer
 
     def has_per_task_fit(self) -> bool:
         return True

@@ -260,6 +260,11 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
         X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
+        if "y" not in data and self.scoring is not None:
+            raise ValueError(
+                f"scoring={self.scoring!r} needs labels, but y=None "
+                f"(unsupervised {family.name} only supports its default "
+                "scorer)")
         n_samples = X.shape[0]
         train_masks, test_masks = fold_masks(splits, n_samples, dtype=dtype)
         n_folds = len(splits)

@@ -157,6 +157,9 @@ def resolve_scoring(scoring, family):
     """scoring arg -> ordered {name: jax scorer}.  None uses the estimator
     default (accuracy / r2) like sklearn's check_scoring."""
     if scoring is None:
+        default = getattr(family, "default_scorer", None)
+        if default is not None:   # e.g. KMeans: -inertia
+            return {"score": default}, "score"
         name = "accuracy" if family.is_classifier else "r2"
         return {"score": SCORERS[name]}, "score"
     if isinstance(scoring, str):

@@ -497,3 +497,77 @@ class TestKeyedContract:
                     n_estimators=5, max_depth=3, random_state=0),
                 keyCols=["k"], xCol="x", yCol="y").fit(keyed_df)
         assert km.backend == "host"
+
+
+class TestKMeansFamily:
+    def test_kmeans_grid_close_to_sklearn(self, digits):
+        """KMeans search scores (-inertia) track sklearn's on the same
+        splits."""
+        from sklearn.cluster import KMeans
+        X, y = digits
+        Xs = X[:500]
+        ours = sst.GridSearchCV(
+            KMeans(n_init=1, random_state=0, max_iter=50),
+            {"n_clusters": [5, 10]}, cv=3, backend="tpu").fit(Xs)
+        theirs = sst.GridSearchCV(
+            KMeans(n_init=1, random_state=0, max_iter=50),
+            {"n_clusters": [5, 10]}, cv=3, backend="host").fit(Xs)
+        # inertia scale: compare within 10%
+        a = ours.cv_results_["mean_test_score"]
+        b = theirs.cv_results_["mean_test_score"]
+        assert np.all(np.abs(a - b) / np.abs(b) < 0.12)
+        # more clusters => lower inertia => higher (less negative) score
+        assert a[1] > a[0]
+
+    def test_kmeans_refit_attrs(self, digits):
+        from sklearn.cluster import KMeans
+        X, y = digits
+        gs = sst.GridSearchCV(
+            KMeans(n_init=1, random_state=0, max_iter=50),
+            {"n_clusters": [8]}, cv=3).fit(X[:400])
+        assert gs.best_estimator_.cluster_centers_.shape == (8, 64)
+
+    def test_kmeans_string_labels_ok(self, digits):
+        """Regression: object-dtype y must not reach the device."""
+        from sklearn.cluster import KMeans
+        X, y = digits
+        ys = np.array([f"c{v}" for v in y])
+        gs = sst.GridSearchCV(
+            KMeans(n_init=1, random_state=0, max_iter=30),
+            {"n_clusters": [6]}, cv=3, backend="tpu").fit(X[:300], ys[:300])
+        assert np.isfinite(gs.best_score_)
+
+    def test_kmeans_array_init_falls_back(self, digits):
+        from sklearn.cluster import KMeans
+        X, y = digits
+        init = X[:4]
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                KMeans(init=init, n_init=1, max_iter=30),
+                {"n_clusters": [4]}, cv=3).fit(X[:300])
+        assert np.isfinite(gs.best_score_)
+
+    def test_pipeline_kmeans_default_scorer(self, digits):
+        """Regression: Pipeline ending in KMeans must inherit -inertia."""
+        from sklearn.cluster import KMeans
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+        X, y = digits
+        gs = sst.GridSearchCV(
+            Pipeline([("sc", StandardScaler()),
+                      ("km", KMeans(n_init=1, random_state=0,
+                                    max_iter=30))]),
+            {"km__n_clusters": [5, 8]}, cv=3, backend="tpu").fit(X[:300])
+        assert gs.cv_results_["mean_test_score"][1] > \
+            gs.cv_results_["mean_test_score"][0]
+
+    def test_kmeans_n_init_improves(self, digits):
+        from sklearn.cluster import KMeans
+        X, y = digits
+        a = sst.GridSearchCV(
+            KMeans(init="random", n_init=1, random_state=0, max_iter=30),
+            {"n_clusters": [10]}, cv=3, backend="tpu").fit(X[:300])
+        b = sst.GridSearchCV(
+            KMeans(init="random", n_init=8, random_state=0, max_iter=30),
+            {"n_clusters": [10]}, cv=3, backend="tpu").fit(X[:300])
+        assert b.best_score_ >= a.best_score_ - 1e-6
