@@ -9,7 +9,6 @@ Each config prints the searched grid, best params/score, and wall time.
 """
 
 import argparse
-import sys
 import time
 
 import numpy as np

@@ -63,6 +63,18 @@ class Converter:
 
     API mirrors the reference (converter.py): the ctor takes an optional
     legacy context argument (ignored — kept so `Converter(sc)` still works).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from sklearn.linear_model import LinearRegression
+    >>> from spark_sklearn_tpu import Converter
+    >>> X = np.array([[0.0], [1.0], [2.0]]); y = np.array([0.0, 2.0, 4.0])
+    >>> tm = Converter().toTPU(LinearRegression().fit(X, y))
+    >>> np.round(tm.predict(np.array([[3.0]])), 3)
+    array([6.], dtype=float32)
+    >>> type(Converter().toSKLearn(tm)).__name__
+    'LinearRegression'
     """
 
     def __init__(self, sc=None):

@@ -57,6 +57,18 @@ def gapply(
       *cols : the columns handed to func; default = all non-key columns.
       retainGroupColumns : prepend key columns to the output (the
         `spark.sql.retainGroupColumns` conf the reference reads).
+
+    Examples
+    --------
+    >>> import pandas as pd
+    >>> from spark_sklearn_tpu import gapply
+    >>> df = pd.DataFrame({"g": [1, 1, 2], "v": [1.0, 2.0, 3.0]})
+    >>> gapply(df.groupby("g"),
+    ...        lambda key, pdf: pd.DataFrame({"s": [pdf.v.sum()]}),
+    ...        [("s", "float64")])
+       g    s
+    0  1  3.0
+    1  2  3.0
     """
     if isinstance(grouped_data, tuple):
         df, keys = grouped_data

@@ -54,10 +54,7 @@ class KMeansFamily(Family):
             y_arr = np.asarray(y)
             if np.issubdtype(y_arr.dtype, np.number):
                 data["y"] = y_arr   # object labels never reach the device
-        meta = {"n_features": int(X.shape[1]),
-                # sklearn scales tol by the mean feature variance
-                # (_kmeans.py _tolerance); precompute host-side
-                "tol_scale": float(np.mean(np.var(np.asarray(X), axis=0)))}
+        meta = {"n_features": int(X.shape[1])}
         return data, meta
 
     @classmethod
@@ -66,8 +63,11 @@ class KMeansFamily(Family):
         n, d = X.shape
         k = int(static.get("n_clusters", 8))
         max_iter = int(static.get("max_iter", 300))
+        # sklearn scales tol by the mean feature variance of the FIT-TIME
+        # X (_kmeans.py _tolerance) — computed here so pipeline-transformed
+        # inputs scale by their own variance, not the raw data's
         tol = jnp.asarray(dynamic.get("tol", static.get("tol", 1e-4)),
-                          X.dtype) * meta.get("tol_scale", 1.0)
+                          X.dtype) * jnp.mean(jnp.var(X, axis=0))
         seed = static.get("random_state")
         base_key = jax.random.PRNGKey(0 if seed is None else int(seed))
         init = static.get("init", "k-means++")

@@ -103,12 +103,54 @@ class NormalizerStep:
         return X / jnp.maximum(d, EPS)
 
 
+class PCAStep:
+    """Weighted PCA via eigendecomposition of the fold-weighted covariance
+    (n_components is static — it changes the transformed width).
+
+    Matches sklearn's PCA(svd_solver='full') up to component sign on the
+    training fold; whitening supported.  Randomized/arpack solvers and
+    n_components='mle' are not compiled (fit raises -> host fallback).
+    """
+
+    name = "pca"
+    dynamic_params: dict = {}
+
+    @staticmethod
+    def fit(static, X, w):
+        nc = static.get("n_components")
+        if nc is None or isinstance(nc, bool) or \
+                not isinstance(nc, (int, np.integer)):
+            raise ValueError(
+                "PCA needs an integer n_components on the compiled path")
+        nc = int(nc)
+        if static.get("svd_solver", "auto") not in ("auto", "full",
+                                                    "covariance_eigh"):
+            raise ValueError("only full-SVD PCA is compiled")
+        wsum = jnp.sum(w) + EPS
+        mean = (w @ X) / wsum
+        Xc = X - mean
+        cov = (Xc * w[:, None]).T @ Xc / wsum          # (d, d)
+        evals, evecs = jnp.linalg.eigh(cov)            # ascending
+        # top-nc components, descending eigenvalue order
+        comps = evecs[:, ::-1][:, :nc].T               # (nc, d)
+        var = jnp.maximum(evals[::-1][:nc], 0.0)
+        return {"mean": mean, "components": comps, "var": var}
+
+    @staticmethod
+    def apply(static, state, X):
+        Z = (X - state["mean"]) @ state["components"].T
+        if static.get("whiten", False):
+            Z = Z / jnp.sqrt(state["var"] + EPS)[None, :]
+        return Z
+
+
 #: sklearn transformer class name -> step implementation
 STEP_REGISTRY = {
     "StandardScaler": StandardScalerStep,
     "MinMaxScaler": MinMaxScalerStep,
     "MaxAbsScaler": MaxAbsScalerStep,
     "Normalizer": NormalizerStep,
+    "PCA": PCAStep,
 }
 
 

@@ -260,11 +260,25 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
         X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
-        if "y" not in data and self.scoring is not None:
-            raise ValueError(
-                f"scoring={self.scoring!r} needs labels, but y=None "
-                f"(unsupervised {family.name} only supports its default "
-                "scorer)")
+        if self.scoring is not None:
+            if "y" not in data:
+                raise ValueError(
+                    f"scoring={self.scoring!r} needs labels, but none "
+                    f"reached the device ({family.name} is unsupervised: "
+                    "y was absent or not numerically encodable; only its "
+                    "default scorer applies)")
+            _CLF_SCORERS = {"accuracy", "neg_log_loss", "f1", "f1_macro",
+                            "precision", "recall", "roc_auc"}
+            wanted = ([self.scoring] if isinstance(self.scoring, str)
+                      else [s for s in self.scoring
+                            if isinstance(s, str)]
+                      if isinstance(self.scoring, (list, tuple, set, dict))
+                      else [])
+            if any(s in _CLF_SCORERS for s in wanted) and \
+                    "n_classes" not in meta:
+                raise ValueError(
+                    f"scoring={self.scoring!r} requires a classifier "
+                    f"family; {family.name} has no class structure")
         n_samples = X.shape[0]
         train_masks, test_masks = fold_masks(splits, n_samples, dtype=dtype)
         n_folds = len(splits)

@@ -1,0 +1,17 @@
+"""Run the API-example doctests — the reference kept its README/API
+examples honest by running docstring examples in CI (SURVEY §4 row
+'Doctests')."""
+
+import doctest
+
+import pytest
+
+import spark_sklearn_tpu.convert.converter as converter_mod
+import spark_sklearn_tpu.keyed.gapply as gapply_mod
+
+
+@pytest.mark.parametrize("mod", [gapply_mod, converter_mod])
+def test_doctests(mod):
+    result = doctest.testmod(
+        mod, optionflags=doctest.NORMALIZE_WHITESPACE)
+    assert result.failed == 0, result

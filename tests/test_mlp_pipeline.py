@@ -63,8 +63,8 @@ class TestPipeline:
                                       "clf__tol": np.float32}
 
     def test_unsupported_step_returns_none(self):
-        from sklearn.decomposition import PCA
-        pipe = Pipeline([("pca", PCA(2)), ("clf", SkLogReg())])
+        from sklearn.feature_selection import SelectKBest
+        pipe = Pipeline([("sel", SelectKBest(k=2)), ("clf", SkLogReg())])
         assert resolve_family(pipe) is None
 
     def test_pipeline_grid_oracle(self, digits):
@@ -91,3 +91,41 @@ class TestPipeline:
         gs = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
         assert gs.cv_results_["mean_test_score"].max() > 0.9
         assert set(gs.best_params_) == {"mlpclassifier__alpha"}
+
+
+class TestPCAPipeline:
+    def test_pca_logreg_oracle(self, digits):
+        """Pipeline(PCA + LogReg) compiled vs sklearn on the same splits."""
+        from sklearn.decomposition import PCA
+        from sklearn.model_selection import GridSearchCV as SkGS
+        X, y = digits
+        pipe = Pipeline([("pca", PCA(n_components=20)),
+                         ("clf", SkLogReg(max_iter=200))])
+        grid = {"clf__C": [0.1, 1.0]}
+        ours = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
+        theirs = SkGS(pipe, grid, cv=3).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.015)
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_pca_whiten(self, digits):
+        from sklearn.decomposition import PCA
+        X, y = digits
+        pipe = Pipeline([("pca", PCA(n_components=16, whiten=True)),
+                         ("clf", SkLogReg(max_iter=200))])
+        gs = sst.GridSearchCV(pipe, {"clf__C": [1.0]}, cv=3,
+                              backend="tpu").fit(X, y)
+        assert gs.best_score_ > 0.85
+
+    def test_pca_randomized_solver_falls_back(self, digits):
+        from sklearn.decomposition import PCA
+        X, y = digits
+        pipe = Pipeline([("pca", PCA(n_components=8,
+                                     svd_solver="randomized",
+                                     random_state=0)),
+                         ("clf", SkLogReg(max_iter=100))])
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(pipe, {"clf__C": [1.0]},
+                                  cv=3).fit(X[:300], y[:300])
+        assert gs.best_score_ > 0.5
