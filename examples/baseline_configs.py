@@ -126,7 +126,7 @@ def run(idx, full):
     print(f"config {idx}: {type(gs.estimator).__name__} "
           f"n={len(gs.cv_results_['params'])} candidates, "
           f"best={gs.best_params_}, score={gs.best_score_:.4f}, "
-          f"wall={wall:.1f}s, backend={gs.search_report_['backend']}")
+          f"wall={wall:.1f}s, backend={gs.search_report['backend']}")
 
 
 if __name__ == "__main__":

@@ -40,6 +40,7 @@ import jax
 
 from sklearn.base import BaseEstimator, MetaEstimatorMixin, clone, is_classifier
 from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
+from sklearn.utils.metaestimators import available_if
 
 from spark_sklearn_tpu.models.base import resolve_family
 from spark_sklearn_tpu.parallel import mesh as mesh_lib
@@ -65,6 +66,31 @@ def _is_multimetric(scorer_names) -> bool:
     return not (len(scorer_names) == 1 and scorer_names[0] == "score")
 
 
+
+def _check_refit(search_cv, attr):
+    if not search_cv.refit:
+        raise AttributeError(
+            f"This {type(search_cv).__name__} instance was initialized with "
+            f"`refit=False`. {attr} is available only after refitting on "
+            "the best parameters. You can refit an estimator manually "
+            "using the `best_params_` attribute")
+
+
+def _search_estimator_has(attr):
+    """sklearn's delegation check (_search.py:368): method availability
+    mirrors the (best_)estimator and the refit flag."""
+
+    def check(self):
+        _check_refit(self, attr)
+        if hasattr(self, "best_estimator_"):
+            getattr(self.best_estimator_, attr)
+            return True
+        getattr(self.estimator, attr)
+        return True
+
+    return check
+
+
 class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     """Shared engine: candidate generation is the only subclass hook
     (`_get_candidates`), mirroring sklearn's `_run_search` split
@@ -85,47 +111,74 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         self.backend = backend          # None=auto, "tpu"=compiled, "host"
         self.config = config
 
+
+    @property
+    def search_report(self):
+        """Per-search execution report (backend, compile groups, launches,
+        fit/score wall).  Stored privately so fit() only adds underscore-
+        prefixed/suffixed attributes, per sklearn's estimator checks."""
+        if not hasattr(self, "_search_report"):
+            raise AttributeError("search_report is set by fit()")
+        return self._search_report
+
     # -- candidate generation -------------------------------------------
     def _get_candidates(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
+
+    def _run_search(self, evaluate_candidates):
+        """sklearn's extension point (_search.py:1040-1134): subclasses may
+        call `evaluate_candidates` any number of times with any candidate
+        batches (e.g. successive-halving-style searches); each call returns
+        `cv_results_`-shaped results for everything evaluated so far."""
+        evaluate_candidates(self._get_candidates())
 
     # -- sklearn plumbing -----------------------------------------------
     def _check_refit_for_multimetric(self, scorer_names):
         if self.refit is not False and (
             not isinstance(self.refit, str) or self.refit not in scorer_names
         ) and not callable(self.refit):
+            # sklearn's exact phrasing (_search.py _check_refit_for_...)
             raise ValueError(
-                "For multi-metric scoring, refit must be set to a scorer "
-                f"name or a callable; got {self.refit!r}")
-
-    @property
-    def _refit_metric(self):
-        if isinstance(self.refit, str):
-            return self.refit
-        return "score"
+                "For multi-metric scoring, the parameter refit must be set "
+                "to a scorer key or a callable to refit an estimator with "
+                f"the best parameter setting on the whole data and make the "
+                f"best_* attributes available for that metric. If this is "
+                f"not needed, refit should be set to False explicitly. "
+                f"{self.refit!r} was passed.")
 
     def fit(self, X, y=None, *, groups=None, **fit_params):
         estimator = self.estimator
-        candidates = list(self._get_candidates())
-        cv = check_cv(self.cv, y, classifier=is_classifier(estimator))
-        from spark_sklearn_tpu.sparse.csr import CSRMatrix
-        if isinstance(X, CSRMatrix):
-            X = X.to_scipy()  # splitters/refit understand scipy CSR
-        X_arr = X if hasattr(X, "shape") else np.asarray(X)
-        splits = list(cv.split(X_arr, y, groups))
-        self.n_splits_ = len(splits)
-
-        if self.verbose > 0:
-            print(f"Fitting {self.n_splits_} folds for each of "
-                  f"{len(candidates)} candidates, totalling "
-                  f"{self.n_splits_ * len(candidates)} fits")
-
-        # multimetric refit misconfiguration must fail BEFORE the sweep,
-        # not after hours of fits (sklearn validates up front too)
+        if self.scoring is None and not hasattr(estimator, "score"):
+            # sklearn validates this before any work (BaseSearchCV.fit)
+            raise TypeError(
+                "If no scoring is specified, the estimator passed should "
+                f"have a 'score' method. The estimator {estimator!r} "
+                "does not.")
+        # multimetric refit misconfiguration must fail BEFORE any other
+        # work — even cv validation (sklearn's ordering)
         if isinstance(self.scoring, (list, tuple, set, dict)):
             self._check_refit_for_multimetric(
                 list(self.scoring.keys())
                 if isinstance(self.scoring, dict) else list(self.scoring))
+
+        cv = check_cv(self.cv, y, classifier=is_classifier(estimator))
+        from spark_sklearn_tpu.sparse.csr import CSRMatrix
+        if isinstance(X, CSRMatrix):
+            X = X.to_scipy()  # splitters/refit understand scipy CSR
+        else:
+            import scipy.sparse as _sp
+            if _sp.issparse(X) and X.format not in ("csr", "csc"):
+                X = X.tocsr()  # COO/DOK are not sliceable by fold indices
+        X_arr = X if hasattr(X, "shape") else np.asarray(X)
+        splits = list(cv.split(X_arr, y, groups))
+        self.n_splits_ = len(splits)
+        if hasattr(cv, "get_n_splits"):
+            expected_n_splits = cv.get_n_splits(X_arr, y, groups)
+            if expected_n_splits != self.n_splits_:
+                raise ValueError(
+                    "cv.split and cv.get_n_splits return "
+                    f"inconsistent results. Expected {expected_n_splits} "
+                    f"splits, got {self.n_splits_}")
 
         family = None if self.backend == "host" else resolve_family(estimator)
         use_compiled = family is not None
@@ -146,32 +199,104 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     raise
                 use_compiled = False
 
-        if use_compiled:
-            try:
-                out = self._fit_compiled(family, X_arr, y, candidates, splits)
-            except Exception as exc:  # unsupported static combo and the like
-                if self.backend == "tpu":
-                    raise
-                warnings.warn(
-                    f"compiled search path failed ({exc!r}); falling back "
-                    "to the host backend", UserWarning)
-                out = self._fit_host(X_arr, y, candidates, splits,
-                                     fit_params)
-        else:
-            out = self._fit_host(X_arr, y, candidates, splits, fit_params)
-        (test_scores, train_scores, fit_times, score_times, scorer_names,
-         self.scorer_) = out
+        # sklearn's extension point (_search.py evaluate_candidates):
+        # _run_search may call evaluate_candidates several times; batches
+        # accumulate and each call returns the results-so-far
+        acc: Dict[str, Any] = {
+            "params": [], "test": None, "train": None,
+            "fit_t": [], "score_t": [], "names": None}
 
+        def _dispatch(cands):
+            if self.n_splits_ == 0:
+                raise ValueError(
+                    "No fits were performed. "
+                    "Was the CV iterator empty? "
+                    "Were there no candidates?")
+            if use_compiled:
+                try:
+                    return self._fit_compiled(
+                        family, X_arr, y, cands, splits)
+                except Exception as exc:  # unsupported static combo etc.
+                    if self.backend == "tpu":
+                        raise
+                    warnings.warn(
+                        f"compiled search path failed ({exc!r}); falling "
+                        "back to the host backend", UserWarning)
+            # the host path receives the CALLER's X (list, sparse, frame —
+            # sklearn estimators may validate its exact type); only the
+            # compiled path needs the dense array form
+            return self._fit_host(X, y, cands, splits, fit_params)
+
+        def evaluate_candidates(candidate_params):
+            cands = list(candidate_params)
+            if self.verbose > 0:
+                print(f"Fitting {self.n_splits_} folds for each of "
+                      f"{len(cands)} candidates, totalling "
+                      f"{self.n_splits_ * len(cands)} fits")
+            if not cands:
+                if not acc["params"]:
+                    return {}
+                return self._format_results(
+                    acc["params"],
+                    {s: np.concatenate(v) for s, v in acc["test"].items()},
+                    ({s: np.concatenate(v)
+                      for s, v in acc["train"].items()}
+                     if self.return_train_score else None),
+                    np.concatenate(acc["fit_t"]),
+                    np.concatenate(acc["score_t"]), acc["names"],
+                    warn=False)
+            (test_scores, train_scores, fit_times, score_times,
+             scorer_names, scorer_attr) = _dispatch(cands)
+            if acc["names"] is None:
+                acc["names"] = scorer_names
+                acc["test"] = {s: [] for s in scorer_names}
+                acc["train"] = ({s: [] for s in scorer_names}
+                                if self.return_train_score else None)
+                self.scorer_ = scorer_attr
+            elif scorer_names != acc["names"]:
+                raise ValueError(
+                    f"inconsistent scorer names across evaluate_candidates "
+                    f"calls: {scorer_names} vs {acc['names']}")
+            acc["params"].extend(cands)
+            for s in scorer_names:
+                acc["test"][s].append(test_scores[s])
+                if self.return_train_score:
+                    acc["train"][s].append(train_scores[s])
+            acc["fit_t"].append(fit_times)
+            acc["score_t"].append(score_times)
+            return self._format_results(
+                acc["params"],
+                {s: np.concatenate(v) for s, v in acc["test"].items()},
+                ({s: np.concatenate(v) for s, v in acc["train"].items()}
+                 if self.return_train_score else None),
+                np.concatenate(acc["fit_t"]),
+                np.concatenate(acc["score_t"]), acc["names"], warn=False)
+
+        self._run_search(evaluate_candidates)
+
+        if not acc["params"]:
+            raise ValueError(
+                "No fits were performed. "
+                "Was the CV iterator empty? "
+                "Were there no candidates?")
+        scorer_names = acc["names"]
         self.multimetric_ = _is_multimetric(scorer_names)
         if self.multimetric_:
             self._check_refit_for_multimetric(scorer_names)
+        # a string refit only names a metric when scoring is multimetric;
+        # single-metric results are keyed "score" regardless (sklearn)
 
         results = self._format_results(
-            candidates, test_scores, train_scores, fit_times, score_times,
+            acc["params"],
+            {s: np.concatenate(v) for s, v in acc["test"].items()},
+            ({s: np.concatenate(v) for s, v in acc["train"].items()}
+             if self.return_train_score else None),
+            np.concatenate(acc["fit_t"]), np.concatenate(acc["score_t"]),
             scorer_names)
         self.cv_results_ = results
 
-        refit_metric = self._refit_metric
+        refit_metric = (self.refit if self.multimetric_
+                        and isinstance(self.refit, str) else "score")
         if self.refit or not self.multimetric_:
             self.best_index_ = self._select_best_index(
                 self.refit, refit_metric, results)
@@ -185,8 +310,11 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             # (grid_search.py: best_estimator_ = clone(base).set_params(
             #  **best_params).fit(X, y)); our native estimators run their own
             # compiled fit here.
+            # param VALUES are cloned too, so estimator-valued grid
+            # entries (e.g. {"regressor": [LinearRegression()]}) are never
+            # fitted in place (sklearn _search.py:1166)
             self.best_estimator_ = clone(estimator).set_params(
-                **self.best_params_)
+                **clone(self.best_params_, safe=False))
             t0 = time.perf_counter()
             if y is not None:
                 self.best_estimator_.fit(X, y, **fit_params)
@@ -279,6 +407,14 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 raise ValueError(
                     f"scoring={self.scoring!r} requires a classifier "
                     f"family; {family.name} has no class structure")
+            _BINARY_ONLY = {"f1", "precision", "recall", "roc_auc"}
+            if any(s in _BINARY_ONLY for s in wanted) and \
+                    meta.get("n_classes", 2) > 2:
+                # sklearn's semantics for these on multiclass (averaging
+                # options, undefined-metric warnings) live on the host path
+                raise ValueError(
+                    f"scoring={self.scoring!r} on multiclass targets is "
+                    "not compiled; use backend='host'")
         n_samples = X.shape[0]
         train_masks, test_masks = fold_masks(splits, n_samples, dtype=dtype)
         n_folds = len(splits)
@@ -363,7 +499,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             profiler_cm.__enter__()
         debug_ctx = (jax.debug_nans(True) if config.debug_nans
                      else _nullcontext())
-        self.search_report_ = {
+        self._search_report = {
             "backend": "tpu", "n_compile_groups": len(groups),
             "n_launches": 0, "n_chunks_resumed": 0,
             "fit_wall_s": 0.0, "score_wall_s": 0.0,
@@ -407,6 +543,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         # validation; our solvers are too robust to blow up, so the chance-
         # level score they produce must not masquerade as a result).  inf
         # stays legal — sklearn itself uses C=np.inf for "no penalty".
+        # Genuinely non-finite SCORES pass through untouched, like
+        # sklearn's (error_score only covers fit failures; _format_results
+        # warns about non-finite score columns).
         bad_cand = np.zeros(n_cand, bool)
         for group in groups:
             for arr in group.dynamic_params.values():
@@ -414,12 +553,23 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     bad_cand[group.candidate_indices[
                         np.isnan(arr)]] = True
         if bad_cand.any():
+            n_bad = int(bad_cand.sum()) * n_folds
+            if isinstance(self.error_score, str) and \
+                    self.error_score == "raise":
+                raise ValueError(
+                    f"{n_bad} fits produced non-finite scores and "
+                    "error_score='raise'")
+            from sklearn.exceptions import FitFailedWarning
+            warnings.warn(
+                f"\n{n_bad} fits failed out of a total of "
+                f"{n_cand * n_folds}.\nThe score on these train-test "
+                "partitions for these parameters will be set to "
+                f"{self.error_score}. (cause: non-finite "
+                "hyperparameters)", FitFailedWarning)
             for s in scorer_names:
-                test_scores[s][bad_cand, :] = np.nan
+                test_scores[s][bad_cand, :] = self.error_score
                 if return_train:
-                    train_scores[s][bad_cand, :] = np.nan
-
-        self._handle_error_score(test_scores, train_scores, scorer_names)
+                    train_scores[s][bad_cand, :] = self.error_score
         # scorer_ keeps the sklearn-facing objects so .score() works the
         # sklearn way even though CV scoring ran compiled
         if self.scoring is None or isinstance(self.scoring, str):
@@ -443,7 +593,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 mesh, P(mesh_lib.TASK_AXIS, mesh_lib.DATA_AXIS))
         else:
             tb_mask_shard = task_shard
-        report = self.search_report_
+        report = self._search_report
         for gi, group in enumerate(groups):
             static = {**base_params, **group.static_params}
             nc = group.n_candidates
@@ -566,31 +716,6 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                         "fit_t": t_fit / (nc_batch * n_folds),
                         "score_t": t_score / (nc_batch * n_folds)})
 
-    def _handle_error_score(self, test_scores, train_scores, scorer_names):
-        """Reproduce sklearn's error_score semantics (_validation.py:666,
-        _search.py:1107 _warn_or_raise_about_fit_failures): a failed fit
-        contributes `error_score` instead of aborting — on TPU "failure" is a
-        non-finite score (XLA cannot raise)."""
-        any_bad = np.zeros(next(iter(test_scores.values())).shape, bool)
-        for s in scorer_names:
-            any_bad |= ~np.isfinite(test_scores[s])
-        n_bad = int(any_bad.sum())
-        if n_bad == 0:
-            return
-        if isinstance(self.error_score, str) and self.error_score == "raise":
-            raise ValueError(
-                f"{n_bad} fits produced non-finite scores and "
-                "error_score='raise'")
-        warnings.warn(
-            f"{n_bad} fits failed (non-finite scores); replacing with "
-            f"error_score={self.error_score!r}.", UserWarning)
-        for s in scorer_names:
-            bad = ~np.isfinite(test_scores[s])
-            test_scores[s][bad] = self.error_score
-            if train_scores is not None:
-                badt = ~np.isfinite(train_scores[s])
-                train_scores[s][badt] = self.error_score
-
     # ------------------------------------------------------------------
     # Tier B: host fallback (full sklearn generality)
     # ------------------------------------------------------------------
@@ -601,17 +726,25 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         from sklearn.model_selection._validation import _fit_and_score
 
         estimator = self.estimator
-        if callable(self.scoring) or self.scoring is None or isinstance(
-                self.scoring, str):
+        if callable(self.scoring):
+            # a callable may return a scalar (single metric) or a dict
+            # (multimetric, sklearn contract) — discovered from results
+            scorer_attr: Any = self.scoring
+            scorer_for_fs: Any = self.scoring
+            scorer_names = None
+        elif self.scoring is None or isinstance(self.scoring, str):
             scorer_obj = check_scoring(estimator, self.scoring)
-            scorers: Any = {"score": scorer_obj}
-            scorer_attr: Any = scorer_obj
-            scorer_for_fs: Any = scorer_obj
+            scorer_attr = scorer_obj
+            scorer_for_fs = scorer_obj
+            scorer_names = ["score"]
         else:
+            from sklearn.metrics._scorer import _MultimetricScorer
             scorers = _check_multimetric_scoring(estimator, self.scoring)
             scorer_attr = dict(scorers)
-            scorer_for_fs = scorers
-        scorer_names = list(scorers)
+            scorer_for_fs = _MultimetricScorer(
+                scorers=scorers,
+                raise_exc=(self.error_score == "raise"))
+            scorer_names = list(scorers)
 
         n_folds = len(splits)
         tasks = [
@@ -619,7 +752,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             for ci, params in enumerate(candidates)
             for fi, (train, test) in enumerate(splits)
         ]
-        self.search_report_ = {
+        self._search_report = {
             "backend": "host", "n_tasks": len(tasks),
             "n_jobs": self.n_jobs if self.n_jobs is not None else 1}
 
@@ -637,6 +770,21 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             delayed(run)(params, train, test)
             for _, _, params, train, test in tasks)
 
+        # sklearn's own failure accounting: FitFailedWarning with the
+        # "n fits failed out of a total of m" format, ValueError when all
+        # fits failed (_search.py:1107 _warn_or_raise_about_fit_failures)
+        from sklearn.model_selection._validation import (
+            _warn_or_raise_about_fit_failures)
+        _warn_or_raise_about_fit_failures(results, self.error_score)
+
+        if scorer_names is None:
+            # callable scoring: multimetric iff it returned a dict
+            scorer_names = ["score"]
+            for res in results:
+                if isinstance(res["test_scores"], dict):
+                    scorer_names = list(res["test_scores"])
+                    break
+
         n_cand = len(candidates)
         test_scores = {s: np.empty((n_cand, n_folds)) for s in scorer_names}
         train_scores = ({s: np.empty((n_cand, n_folds))
@@ -644,27 +792,22 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                         if self.return_train_score else None)
         fit_times = np.empty((n_cand, n_folds))
         score_times = np.empty((n_cand, n_folds))
-        n_failed = 0
         for (ci, fi, _, _, _), res in zip(tasks, results):
-            if res.get("fit_error") is not None:
-                n_failed += 1
             ts = res["test_scores"]
             if not isinstance(ts, dict):
-                ts = {"score": ts}
+                # scalar: single metric, or error_score from a failed
+                # multimetric fit — applies to every metric
+                ts = {s: ts for s in scorer_names}
             for s in scorer_names:
                 test_scores[s][ci, fi] = ts.get(s, np.nan)
             if self.return_train_score:
                 trs = res.get("train_scores", {})
                 if not isinstance(trs, dict):
-                    trs = {"score": trs}
+                    trs = {s: trs for s in scorer_names}
                 for s in scorer_names:
                     train_scores[s][ci, fi] = trs.get(s, np.nan)
             fit_times[ci, fi] = res["fit_time"]
             score_times[ci, fi] = res["score_time"]
-        if n_failed:
-            warnings.warn(
-                f"{n_failed} fits failed; their score was set to "
-                f"error_score={self.error_score!r}.", UserWarning)
         return (test_scores, train_scores, fit_times, score_times,
                 scorer_names, scorer_attr)
 
@@ -673,7 +816,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     # (_search.py:1208-1290)
     # ------------------------------------------------------------------
     def _format_results(self, candidates, test_scores, train_scores,
-                        fit_times, score_times, scorer_names):
+                        fit_times, score_times, scorer_names, warn=True):
         from scipy.stats import rankdata
 
         n_candidates = len(candidates)
@@ -687,6 +830,13 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     results[f"split{i}_{key_name}"] = array[:, i]
             array_means = np.average(array, axis=1, weights=weights)
             results[f"mean_{key_name}"] = array_means
+            if warn and key_name.startswith(("train_", "test_")) and \
+                    np.any(~np.isfinite(array_means)):
+                # sklearn's exact wording (_search.py:1237)
+                warnings.warn(
+                    f"One or more of the {key_name.split('_')[0]} scores "
+                    f"are non-finite: {array_means}",
+                    category=UserWarning)
             array_stds = np.sqrt(np.average(
                 (array - array_means[:, None]) ** 2, axis=1,
                 weights=weights))
@@ -703,13 +853,27 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         _store("fit_time", fit_times)
         _store("score_time", score_times)
 
-        param_results: Dict[str, Any] = defaultdict(
-            lambda: np.ma.MaskedArray(np.empty(n_candidates), mask=True,
-                                      dtype=object))
+        # masked param arrays, sklearn's exact dtype rule
+        # (_search.py _yield_masked_array_for_each_param): dtype inferred
+        # from the PRESENT values; strings and nested sequences stay object
+        param_results: Dict[str, Dict[int, Any]] = defaultdict(dict)
         for cand_idx, params in enumerate(candidates):
             for name, value in params.items():
                 param_results[f"param_{name}"][cand_idx] = value
-        results.update(param_results)
+        for key, param_result in param_results.items():
+            param_list = list(param_result.values())
+            try:
+                arr = np.array(param_list)
+            except ValueError:
+                arr_dtype = np.dtype(object)
+            else:
+                arr_dtype = (arr.dtype if arr.dtype.kind != "U"
+                             and arr.ndim == 1 else object)
+            ma = np.ma.MaskedArray(np.empty(n_candidates, dtype=arr_dtype),
+                                   mask=True)
+            for index, value in param_result.items():
+                ma[index] = value
+            results[key] = ma
         results["params"] = list(candidates)
 
         for s in scorer_names:
@@ -718,43 +882,56 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 _store(f"train_{s}", train_scores[s], splits=True)
         return results
 
-    # -- prediction delegation (sklearn parity) -------------------------
-    def _check_is_fitted(self, method):
-        if not self.refit:
-            raise AttributeError(
-                f"This {type(self).__name__} instance was initialized with "
-                f"refit=False; {method} is unavailable.")
+    # -- prediction delegation (sklearn parity: available_if makes these
+    # methods conditional, so hasattr() reflects the wrapped estimator and
+    # refit state exactly like sklearn's BaseSearchCV) ------------------
+
+    @available_if(_search_estimator_has("score_samples"))
+    def score_samples(self, X):
+        return self.best_estimator_.score_samples(X)
+
+    @available_if(_search_estimator_has("predict"))
+    def predict(self, X):
+        return self.best_estimator_.predict(X)
+
+    @available_if(_search_estimator_has("predict_proba"))
+    def predict_proba(self, X):
+        return self.best_estimator_.predict_proba(X)
+
+    @available_if(_search_estimator_has("predict_log_proba"))
+    def predict_log_proba(self, X):
+        return self.best_estimator_.predict_log_proba(X)
+
+    @available_if(_search_estimator_has("decision_function"))
+    def decision_function(self, X):
+        return self.best_estimator_.decision_function(X)
+
+    @available_if(_search_estimator_has("transform"))
+    def transform(self, X):
+        return self.best_estimator_.transform(X)
+
+    @available_if(_search_estimator_has("inverse_transform"))
+    def inverse_transform(self, X):
+        return self.best_estimator_.inverse_transform(X)
+
+    def __sklearn_tags__(self):
+        # pairwise (precomputed-kernel) inputs delegate to the wrapped
+        # estimator, like sklearn's BaseSearchCV
+        tags = super().__sklearn_tags__()
+        try:
+            from sklearn.utils import get_tags
+            tags.input_tags.pairwise = get_tags(
+                self.estimator).input_tags.pairwise
+        except Exception:
+            pass
+        return tags
+
+    def score(self, X, y=None):
+        _check_refit(self, "score")
         if not hasattr(self, "best_estimator_"):
             raise AttributeError(
                 f"This {type(self).__name__} instance is not fitted yet; "
-                "call fit() first")
-
-    def predict(self, X):
-        self._check_is_fitted("predict")
-        return self.best_estimator_.predict(X)
-
-    def predict_proba(self, X):
-        self._check_is_fitted("predict_proba")
-        return self.best_estimator_.predict_proba(X)
-
-    def predict_log_proba(self, X):
-        self._check_is_fitted("predict_log_proba")
-        return self.best_estimator_.predict_log_proba(X)
-
-    def decision_function(self, X):
-        self._check_is_fitted("decision_function")
-        return self.best_estimator_.decision_function(X)
-
-    def transform(self, X):
-        self._check_is_fitted("transform")
-        return self.best_estimator_.transform(X)
-
-    def inverse_transform(self, X):
-        self._check_is_fitted("inverse_transform")
-        return self.best_estimator_.inverse_transform(X)
-
-    def score(self, X, y=None):
-        self._check_is_fitted("score")
+                "call fit() first.")
         if callable(self.scoring):
             return self.scoring(self.best_estimator_, X, y)
         if self.scorer_ is not None and not isinstance(self.scorer_, dict):

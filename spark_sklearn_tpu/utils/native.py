@@ -74,6 +74,14 @@ def fold_masks(
     Native path when libtpusk.so is built and dtype is float32; numpy
     fallback otherwise (identical output, tested in test_native.py).
     """
+    # splitters may yield boolean masks instead of index arrays (sklearn's
+    # check_cv passes them through); normalise to indices up front
+    cv_splits = [
+        (np.flatnonzero(tr) if np.asarray(tr).dtype == bool
+         else np.asarray(tr),
+         np.flatnonzero(te) if np.asarray(te).dtype == bool
+         else np.asarray(te))
+        for tr, te in cv_splits]
     lib = _load()
     n_folds = len(cv_splits)
     if lib is None or dtype != np.float32:

@@ -370,13 +370,13 @@ class TestCheckpointAndSession:
         g1 = sst.GridSearchCV(LR(max_iter=50), {"C": [0.1, 1.0]}, cv=3,
                               backend="tpu", config=cfg, refit=False)
         g1.fit(X, y)
-        assert g1.search_report_["n_chunks_resumed"] == 0
-        assert g1.search_report_["n_launches"] >= 1
+        assert g1.search_report["n_chunks_resumed"] == 0
+        assert g1.search_report["n_launches"] >= 1
         g2 = sst.GridSearchCV(LR(max_iter=50), {"C": [0.1, 1.0]}, cv=3,
                               backend="tpu", config=cfg, refit=False)
         g2.fit(X, y)
-        assert g2.search_report_["n_chunks_resumed"] >= 1
-        assert g2.search_report_["n_launches"] == 0
+        assert g2.search_report["n_chunks_resumed"] >= 1
+        assert g2.search_report["n_launches"] == 0
         np.testing.assert_allclose(
             g1.cv_results_["mean_test_score"],
             g2.cv_results_["mean_test_score"])
@@ -391,7 +391,7 @@ class TestCheckpointAndSession:
         g2 = sst.GridSearchCV(LR(max_iter=50), {"C": [9.0]}, cv=3,
                               backend="tpu", config=cfg, refit=False)
         g2.fit(X, y)
-        assert g2.search_report_["n_chunks_resumed"] == 0
+        assert g2.search_report["n_chunks_resumed"] == 0
 
     def test_pytree_save_load(self, tmp_path):
         import jax.numpy as jnp
@@ -424,7 +424,7 @@ class TestCheckpointAndSession:
         X, y = digits
         gs = sst.GridSearchCV(LR(max_iter=50), {"C": [1.0]}, cv=3,
                               backend="tpu", refit=False).fit(X, y)
-        rep = gs.search_report_
+        rep = gs.search_report
         assert rep["backend"] == "tpu"
         assert rep["n_compile_groups"] == 1
         assert rep["fit_wall_s"] > 0

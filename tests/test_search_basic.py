@@ -172,7 +172,8 @@ class TestErrorScore:
             def predict(self, X):
                 return np.zeros(len(X), dtype=int)
 
-        with pytest.warns(UserWarning):
+        from sklearn.exceptions import FitFailedWarning
+        with pytest.warns(FitFailedWarning, match="fits failed out of"):
             gs = sst.GridSearchCV(
                 Broken(), {"fail": [True, False]}, cv=3,
                 error_score=0.0).fit(X, y)
@@ -265,7 +266,8 @@ class TestMoreOracles:
         """error_score on the COMPILED path: a candidate engineered to
         produce non-finite scores is masked, not fatal."""
         X, y = digits
-        with pytest.warns(UserWarning, match="non-finite"):
+        from sklearn.exceptions import FitFailedWarning
+        with pytest.warns(FitFailedWarning, match="non-finite"):
             gs = sst.GridSearchCV(
                 SkLogReg(max_iter=50),
                 {"C": [1.0, float("nan")]}, cv=3, backend="tpu",

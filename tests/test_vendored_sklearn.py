@@ -1,0 +1,33 @@
+"""Run scikit-learn's own test_search.py against our search classes.
+
+See vendored_tests/README.md.  The suite runs in a subprocess (its
+conftest monkeypatches sklearn module attributes, which must not leak into
+this process's tests).
+"""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+VENDOR = os.path.join(os.path.dirname(HERE), "vendored_tests")
+
+
+def test_upstream_search_suite_passes():
+    with open(os.path.join(VENDOR, "known_failures.txt")) as f:
+        known = [line.strip() for line in f if line.strip()]
+    deselect = []
+    for k in known:
+        deselect += ["--deselect", f"_upstream_test_search.py::{k}"]
+    env = {**os.environ,
+           "PYTHONPATH": os.pathsep.join(
+               [os.path.dirname(HERE)]
+               + os.environ.get("PYTHONPATH", "").split(os.pathsep))}
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "_upstream_test_search.py",
+         "-q", "--no-header", "-p", "no:cacheprovider", *deselect],
+        cwd=VENDOR, env=env, capture_output=True, text=True, timeout=580)
+    tail = "\n".join(proc.stdout.strip().splitlines()[-15:])
+    assert proc.returncode == 0, (
+        f"upstream sklearn search suite regressed:\n{tail}")
+    assert " passed" in proc.stdout

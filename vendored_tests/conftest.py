@@ -1,0 +1,16 @@
+"""Re-point scikit-learn's own search test-suite at our implementations
+(the reference's vendored-test strategy, SURVEY §4)."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sklearn.model_selection as ms  # noqa: E402
+import sklearn.model_selection._search as mss  # noqa: E402
+
+import spark_sklearn_tpu as sst  # noqa: E402
+
+ms.GridSearchCV = sst.GridSearchCV
+mss.GridSearchCV = sst.GridSearchCV
+ms.RandomizedSearchCV = sst.RandomizedSearchCV
+mss.RandomizedSearchCV = sst.RandomizedSearchCV
