@@ -91,6 +91,7 @@ def _search_estimator_has(attr):
     return check
 
 
+
 class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     """Shared engine: candidate generation is the only subclass hook
     (`_get_candidates`), mirroring sklearn's `_run_search` split
@@ -204,7 +205,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         # accumulate and each call returns the results-so-far
         acc: Dict[str, Any] = {
             "params": [], "test": None, "train": None,
-            "fit_t": [], "score_t": [], "names": None}
+            "fit_t": [], "score_t": [], "names": None, "results": None}
+
+        state = {"use_compiled": use_compiled}
 
         def _dispatch(cands):
             if self.n_splits_ == 0:
@@ -212,13 +215,14 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     "No fits were performed. "
                     "Was the CV iterator empty? "
                     "Were there no candidates?")
-            if use_compiled:
+            if state["use_compiled"]:
                 try:
                     return self._fit_compiled(
                         family, X_arr, y, cands, splits)
                 except Exception as exc:  # unsupported static combo etc.
                     if self.backend == "tpu":
                         raise
+                    state["use_compiled"] = False  # fall back ONCE
                     warnings.warn(
                         f"compiled search path failed ({exc!r}); falling "
                         "back to the host backend", UserWarning)
@@ -235,16 +239,11 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                       f"{self.n_splits_ * len(cands)} fits")
             if not cands:
                 if not acc["params"]:
-                    return {}
-                return self._format_results(
-                    acc["params"],
-                    {s: np.concatenate(v) for s, v in acc["test"].items()},
-                    ({s: np.concatenate(v)
-                      for s, v in acc["train"].items()}
-                     if self.return_train_score else None),
-                    np.concatenate(acc["fit_t"]),
-                    np.concatenate(acc["score_t"]), acc["names"],
-                    warn=False)
+                    raise ValueError(
+                        "No fits were performed. "
+                        "Was the CV iterator empty? "
+                        "Were there no candidates?")
+                return acc["results"]
             (test_scores, train_scores, fit_times, score_times,
              scorer_names, scorer_attr) = _dispatch(cands)
             if acc["names"] is None:
@@ -264,13 +263,14 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     acc["train"][s].append(train_scores[s])
             acc["fit_t"].append(fit_times)
             acc["score_t"].append(score_times)
-            return self._format_results(
+            acc["results"] = self._format_results(
                 acc["params"],
                 {s: np.concatenate(v) for s, v in acc["test"].items()},
                 ({s: np.concatenate(v) for s, v in acc["train"].items()}
                  if self.return_train_score else None),
                 np.concatenate(acc["fit_t"]),
-                np.concatenate(acc["score_t"]), acc["names"], warn=False)
+                np.concatenate(acc["score_t"]), acc["names"])
+            return acc["results"]
 
         self._run_search(evaluate_candidates)
 
@@ -286,13 +286,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         # a string refit only names a metric when scoring is multimetric;
         # single-metric results are keyed "score" regardless (sklearn)
 
-        results = self._format_results(
-            acc["params"],
-            {s: np.concatenate(v) for s, v in acc["test"].items()},
-            ({s: np.concatenate(v) for s, v in acc["train"].items()}
-             if self.return_train_score else None),
-            np.concatenate(acc["fit_t"]), np.concatenate(acc["score_t"]),
-            scorer_names)
+        results = acc["results"]
         self.cv_results_ = results
 
         refit_metric = (self.refit if self.multimetric_
@@ -816,7 +810,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     # (_search.py:1208-1290)
     # ------------------------------------------------------------------
     def _format_results(self, candidates, test_scores, train_scores,
-                        fit_times, score_times, scorer_names, warn=True):
+                        fit_times, score_times, scorer_names):
         from scipy.stats import rankdata
 
         n_candidates = len(candidates)
@@ -830,8 +824,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     results[f"split{i}_{key_name}"] = array[:, i]
             array_means = np.average(array, axis=1, weights=weights)
             results[f"mean_{key_name}"] = array_means
-            if warn and key_name.startswith(("train_", "test_")) and \
-                    np.any(~np.isfinite(array_means)):
+            if key_name.startswith(("train_", "test_")) and np.any(
+                    ~np.isfinite(array_means)):
                 # sklearn's exact wording (_search.py:1237)
                 warnings.warn(
                     f"One or more of the {key_name.split('_')[0]} scores "
@@ -888,40 +882,63 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
     @available_if(_search_estimator_has("score_samples"))
     def score_samples(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.score_samples(X)
 
     @available_if(_search_estimator_has("predict"))
     def predict(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.predict(X)
 
     @available_if(_search_estimator_has("predict_proba"))
     def predict_proba(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.predict_proba(X)
 
     @available_if(_search_estimator_has("predict_log_proba"))
     def predict_log_proba(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.predict_log_proba(X)
 
     @available_if(_search_estimator_has("decision_function"))
     def decision_function(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.decision_function(X)
 
     @available_if(_search_estimator_has("transform"))
     def transform(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.transform(X)
 
     @available_if(_search_estimator_has("inverse_transform"))
     def inverse_transform(self, X):
+        from sklearn.utils.validation import check_is_fitted
+        check_is_fitted(self)
         return self.best_estimator_.inverse_transform(X)
 
     def __sklearn_tags__(self):
-        # pairwise (precomputed-kernel) inputs delegate to the wrapped
-        # estimator, like sklearn's BaseSearchCV
+        # full tag delegation to the wrapped estimator, like sklearn's
+        # BaseSearchCV (_search.py:490): estimator_type makes
+        # is_classifier(search) follow the inner estimator, pairwise lets
+        # cv see precomputed metrics
         tags = super().__sklearn_tags__()
         try:
+            from copy import deepcopy
+
             from sklearn.utils import get_tags
-            tags.input_tags.pairwise = get_tags(
-                self.estimator).input_tags.pairwise
+            sub = get_tags(self.estimator)
+            tags.estimator_type = sub.estimator_type
+            tags.classifier_tags = deepcopy(sub.classifier_tags)
+            tags.regressor_tags = deepcopy(sub.regressor_tags)
+            tags.input_tags.pairwise = sub.input_tags.pairwise
+            tags.input_tags.sparse = sub.input_tags.sparse
+            tags.array_api_support = sub.array_api_support
         except Exception:
             pass
         return tags
