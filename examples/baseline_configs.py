@@ -9,9 +9,14 @@ Each config prints the searched grid, best params/score, and wall time.
 """
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+# runnable from anywhere: the repo root holds the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _data_digits():

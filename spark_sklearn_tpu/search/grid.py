@@ -41,6 +41,7 @@ import jax
 from sklearn.base import BaseEstimator, MetaEstimatorMixin, clone, is_classifier
 from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
 from sklearn.utils.metaestimators import available_if
+from sklearn.utils.validation import check_is_fitted
 
 from spark_sklearn_tpu.models.base import resolve_family
 from spark_sklearn_tpu.parallel import mesh as mesh_lib
@@ -89,7 +90,6 @@ def _search_estimator_has(attr):
         return True
 
     return check
-
 
 
 class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
@@ -882,43 +882,36 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
     @available_if(_search_estimator_has("score_samples"))
     def score_samples(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.score_samples(X)
 
     @available_if(_search_estimator_has("predict"))
     def predict(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.predict(X)
 
     @available_if(_search_estimator_has("predict_proba"))
     def predict_proba(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.predict_proba(X)
 
     @available_if(_search_estimator_has("predict_log_proba"))
     def predict_log_proba(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.predict_log_proba(X)
 
     @available_if(_search_estimator_has("decision_function"))
     def decision_function(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.decision_function(X)
 
     @available_if(_search_estimator_has("transform"))
     def transform(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.transform(X)
 
     @available_if(_search_estimator_has("inverse_transform"))
     def inverse_transform(self, X):
-        from sklearn.utils.validation import check_is_fitted
         check_is_fitted(self)
         return self.best_estimator_.inverse_transform(X)
 
