@@ -389,20 +389,24 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     f"reached the device ({family.name} is unsupervised: "
                     "y was absent or not numerically encodable; only its "
                     "default scorer applies)")
-            _CLF_SCORERS = {"accuracy", "neg_log_loss", "f1", "f1_macro",
-                            "precision", "recall", "roc_auc"}
-            wanted = ([self.scoring] if isinstance(self.scoring, str)
-                      else [s for s in self.scoring
-                            if isinstance(s, str)]
-                      if isinstance(self.scoring, (list, tuple, set, dict))
-                      else [])
-            if any(s in _CLF_SCORERS for s in wanted) and \
+            from spark_sklearn_tpu.search.scorers import (
+                BINARY_ONLY_SCORERS, CLASSIFICATION_SCORERS)
+            if isinstance(self.scoring, str):
+                wanted = [self.scoring]
+            elif isinstance(self.scoring, dict):
+                # dict values name the metrics; keys are display labels
+                wanted = [s for s in self.scoring.values()
+                          if isinstance(s, str)]
+            elif isinstance(self.scoring, (list, tuple, set)):
+                wanted = [s for s in self.scoring if isinstance(s, str)]
+            else:
+                wanted = []
+            if any(s in CLASSIFICATION_SCORERS for s in wanted) and \
                     "n_classes" not in meta:
                 raise ValueError(
                     f"scoring={self.scoring!r} requires a classifier "
                     f"family; {family.name} has no class structure")
-            _BINARY_ONLY = {"f1", "precision", "recall", "roc_auc"}
-            if any(s in _BINARY_ONLY for s in wanted) and \
+            if any(s in BINARY_ONLY_SCORERS for s in wanted) and \
                     meta.get("n_classes", 2) > 2:
                 # sklearn's semantics for these on multiclass (averaging
                 # options, undefined-metric warnings) live on the host path

@@ -81,6 +81,47 @@ def _f1_macro(family, model, static, data, meta, w):
     return jnp.mean(jax.vmap(per_class)(jnp.arange(k)))
 
 
+def _balanced_accuracy(family, model, static, data, meta, w):
+    """Macro-average recall over classes present in the fold (sklearn
+    semantics: classes absent from y_true drop out of the mean)."""
+    pred = family.predict(model, static, _feats(data), meta)
+    y = data["y"]
+    k = meta["n_classes"]
+
+    def per_class(c):
+        support = jnp.sum(w * (y == c))
+        tp = jnp.sum(w * ((pred == c) & (y == c)))
+        rec = tp / jnp.maximum(support, EPS)
+        return rec, (support > 0).astype(rec.dtype)
+
+    recalls, present = jax.vmap(per_class)(jnp.arange(k))
+    return jnp.sum(recalls * present) / jnp.maximum(jnp.sum(present), 1.0)
+
+
+def _explained_variance(family, model, static, data, meta, w):
+    pred = family.predict(model, static, _feats(data), meta)
+    y = data["y"]
+    err = y - pred
+    ebar = jnp.sum(w * err) / _wsum(w)
+    var_err = jnp.sum(w * (err - ebar) ** 2) / _wsum(w)
+    ybar = jnp.sum(w * y) / _wsum(w)
+    var_y = jnp.sum(w * (y - ybar) ** 2) / _wsum(w)
+    return 1.0 - var_err / jnp.maximum(var_y, EPS)
+
+
+def _neg_msle(family, model, static, data, meta, w):
+    # sklearn RAISES on negative targets/predictions; inside a compiled
+    # program we return NaN instead, which surfaces through the
+    # non-finite-score warning rather than silently scoring a clamp
+    pred = family.predict(model, static, _feats(data), meta)
+    y = data["y"]
+    invalid = jnp.sum(w * ((y < 0) | (pred < 0)).astype(w.dtype)) > 0
+    ly = jnp.log1p(jnp.maximum(y, 0.0))
+    lp = jnp.log1p(jnp.maximum(pred, 0.0))
+    val = -(jnp.sum(w * (ly - lp) ** 2) / _wsum(w))
+    return jnp.where(invalid, jnp.nan, val)
+
+
 def _roc_auc(family, model, static, data, meta, w):
     """Weighted binary AUC via the rank/Mann-Whitney statistic."""
     s = family.decision(model, static, _feats(data), meta)
@@ -138,6 +179,9 @@ def _max_error(family, model, static, data, meta, w):
 
 SCORERS: Dict[str, Callable] = {
     "accuracy": _accuracy,
+    "balanced_accuracy": _balanced_accuracy,
+    "explained_variance": _explained_variance,
+    "neg_mean_squared_log_error": _neg_msle,
     "neg_log_loss": _neg_log_loss,
     "f1": _f1,
     "f1_macro": _f1_macro,
@@ -151,6 +195,17 @@ SCORERS: Dict[str, Callable] = {
     "neg_median_absolute_error": _neg_median_ae,
     "max_error": _max_error,
 }
+
+
+#: scorers that need label/class structure (meta["n_classes"]) — consulted
+#: by the engine's pre-sweep validation so mismatches fail clearly
+CLASSIFICATION_SCORERS = {
+    "accuracy", "balanced_accuracy", "neg_log_loss", "f1", "f1_macro",
+    "precision", "recall", "roc_auc",
+}
+#: binary-only compiled implementations (multiclass variants live on the
+#: host path with sklearn's averaging semantics)
+BINARY_ONLY_SCORERS = {"f1", "precision", "recall", "roc_auc"}
 
 
 def resolve_scoring(scoring, family):

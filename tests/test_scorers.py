@@ -127,3 +127,51 @@ def test_regression_scorers_match_sklearn(name, skfn):
     if name == "max_error":
         theirs = skfn(y[sel], pred[sel])
     assert abs(ours - theirs) < tol, (name, ours, theirs)
+
+
+def test_balanced_accuracy_matches_sklearn():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    y = rng.integers(0, 4, 300)
+    pred = np.where(rng.random(300) < 0.6, y, rng.integers(0, 4, 300))
+    mask = (rng.random(300) > 0.3).astype(np.float32)
+    fam = _MockFamily(pred=jnp.asarray(pred.astype(np.int32)))
+    data = {"X": jnp.zeros((300, 1)), "y": jnp.asarray(y)}
+    ours = float(S.SCORERS["balanced_accuracy"](
+        fam, {}, {}, data, {"n_classes": 4}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = skm.balanced_accuracy_score(y[sel], pred[sel])
+    assert abs(ours - theirs) < 1e-5
+
+
+@pytest.mark.parametrize("name,skfn", [
+    ("explained_variance", skm.explained_variance_score),
+    ("neg_mean_squared_log_error",
+     lambda a, b: -skm.mean_squared_log_error(a, b)),
+])
+def test_more_regression_scorers(name, skfn):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(6)
+    y = np.abs(rng.normal(size=200)) + 0.1
+    pred = y * (1 + 0.2 * rng.normal(size=200))
+    pred = np.abs(pred) + 1e-3
+    mask = (rng.random(200) > 0.3).astype(np.float32)
+    fam = _MockFamily(pred=jnp.asarray(pred, jnp.float32))
+    fam.is_classifier = False
+    data = {"X": jnp.zeros((200, 1)), "y": jnp.asarray(y, jnp.float32)}
+    ours = float(S.SCORERS[name](fam, {}, {}, data, {}, jnp.asarray(mask)))
+    sel = mask > 0
+    theirs = skfn(y[sel], pred[sel])
+    assert abs(ours - theirs) < 1e-3, (name, ours, theirs)
+
+
+def test_neg_msle_negative_values_give_nan():
+    """sklearn raises on negatives; the compiled scorer surfaces NaN (which
+    the engine reports via the non-finite warning) instead of clamping."""
+    import jax.numpy as jnp
+    fam = _MockFamily(pred=jnp.asarray([-0.5, 1.0], jnp.float32))
+    fam.is_classifier = False
+    data = {"X": jnp.zeros((2, 1)), "y": jnp.asarray([1.0, 2.0])}
+    out = float(S.SCORERS["neg_mean_squared_log_error"](
+        fam, {}, {}, data, {}, jnp.ones(2)))
+    assert np.isnan(out)
