@@ -77,6 +77,17 @@ class KeyedEstimator(BaseEstimator):
         if yCol is not None and estimatorType != "predictor":
             raise ValueError(
                 "estimatorType must be 'predictor' when yCol is given")
+        # transform-time requirements checked up front (the reference's
+        # Param validation equivalent): predictor/clusterer apply predict,
+        # transformer applies transform — transductive estimators like
+        # DBSCAN (no predict) cannot serve as keyed clusterers
+        needed = ("transform" if estimatorType == "transformer"
+                  else "predict")
+        if not hasattr(sklearnEstimator, needed):
+            raise ValueError(
+                f"estimatorType={estimatorType!r} requires an estimator "
+                f"with a {needed}() method; "
+                f"{type(sklearnEstimator).__name__} has none")
         self.estimatorType = estimatorType
 
     def fit(self, df: pd.DataFrame) -> "KeyedModel":
@@ -87,7 +98,7 @@ class KeyedEstimator(BaseEstimator):
             raise KeyError(f"DataFrame is missing columns: {missing}")
 
         fleet = None
-        if self.estimatorType == "predictor":
+        if self.estimatorType in ("predictor", "clusterer"):
             fleet = self._try_fit_compiled(df)
         if fleet is not None:
             return fleet
@@ -140,15 +151,26 @@ class KeyedEstimator(BaseEstimator):
         L = max(len(p) for p in slices)
 
         X_all = _stack_x(work[self.xCol]).astype(np.float32)
+        static_probe = family.extract_params(self.sklearnEstimator)
+        min_needed = (family.min_group_size(static_probe)
+                      if hasattr(family, "min_group_size") else 1)
+        if min(len(p) for p in slices) < min_needed:
+            # some key has too few rows for this estimator (e.g. fewer
+            # samples than n_clusters) — host loop raises per key the way
+            # sklearn would
+            return None
         d = X_all.shape[1]
-        y_all = np.asarray(work[self.yCol])
+        unsupervised = self.yCol is None
+        y_all = None if unsupervised else np.asarray(work[self.yCol])
         try:
             _, meta = family.prepare_data(X_all, y_all)
         except Exception:
             return None
         static = family.extract_params(self.sklearnEstimator)
 
-        if family.is_classifier:
+        if unsupervised:
+            enc = np.zeros(len(work), np.float64)
+        elif family.is_classifier:
             lookup = {v: i for i, v in enumerate(meta["classes"])}
             enc = np.array([lookup[v] for v in y_all], np.float64)
         else:
@@ -164,7 +186,9 @@ class KeyedEstimator(BaseEstimator):
             ws[i, :m] = 1.0
 
         def fit_one(Xg, yg, wg):
-            if family.is_classifier:
+            if unsupervised:
+                data_g = {"X": Xg}
+            elif family.is_classifier:
                 k = meta["n_classes"]
                 data_g = {"X": Xg, "y": yg.astype(jnp.int32),
                           "y1h": jax.nn.one_hot(
@@ -298,4 +322,6 @@ class KeyedModel:
             model, self.fleet["static"], X, self.fleet["meta"]))
         if fam.is_classifier:
             return list(self.fleet["meta"]["classes"][pred])
+        if self.estimatorType == "clusterer":
+            return list(pred.astype(np.int64))
         return list(pred.astype(np.float64))

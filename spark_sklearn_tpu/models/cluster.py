@@ -48,6 +48,13 @@ class KMeansFamily(Family):
     default_scorer = staticmethod(_neg_inertia)
 
     @classmethod
+    def min_group_size(cls, static) -> int:
+        # a fit needs at least n_clusters real samples (sklearn raises on
+        # fewer; padded fleet groups must fall back instead of silently
+        # seeding centers from zero-padding)
+        return int(static.get("n_clusters", 8))
+
+    @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
         data = {"X": np.ascontiguousarray(X, dtype=dtype)}
         if y is not None:
@@ -64,10 +71,14 @@ class KMeansFamily(Family):
         k = int(static.get("n_clusters", 8))
         max_iter = int(static.get("max_iter", 300))
         # sklearn scales tol by the mean feature variance of the FIT-TIME
-        # X (_kmeans.py _tolerance) — computed here so pipeline-transformed
-        # inputs scale by their own variance, not the raw data's
+        # X (_kmeans.py _tolerance) — weighted, so zero-weight padding rows
+        # (keyed fleets) don't deflate a key's own variance scale
+        w0 = train_w
+        wsum0 = jnp.sum(w0) + 1e-12
+        xbar = (w0 @ X) / wsum0
+        wvar = (w0 @ ((X - xbar) ** 2)) / wsum0
         tol = jnp.asarray(dynamic.get("tol", static.get("tol", 1e-4)),
-                          X.dtype) * jnp.mean(jnp.var(X, axis=0))
+                          X.dtype) * jnp.mean(wvar)
         seed = static.get("random_state")
         base_key = jax.random.PRNGKey(0 if seed is None else int(seed))
         init = static.get("init", "k-means++")

@@ -47,7 +47,11 @@ from spark_sklearn_tpu.models.base import resolve_family
 from spark_sklearn_tpu.parallel import mesh as mesh_lib
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
 from spark_sklearn_tpu.parallel.taskgrid import build_compile_groups
-from spark_sklearn_tpu.search.scorers import resolve_scoring
+from spark_sklearn_tpu.search.scorers import (
+    BINARY_ONLY_SCORERS,
+    CLASSIFICATION_SCORERS,
+    resolve_scoring,
+)
 from spark_sklearn_tpu.utils.native import fold_masks
 
 
@@ -389,8 +393,6 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     f"reached the device ({family.name} is unsupervised: "
                     "y was absent or not numerically encodable; only its "
                     "default scorer applies)")
-            from spark_sklearn_tpu.search.scorers import (
-                BINARY_ONLY_SCORERS, CLASSIFICATION_SCORERS)
             if isinstance(self.scoring, str):
                 wanted = [self.scoring]
             elif isinstance(self.scoring, dict):

@@ -571,3 +571,41 @@ class TestKMeansFamily:
             KMeans(init="random", n_init=8, random_state=0, max_iter=30),
             {"n_clusters": [10]}, cv=3, backend="tpu").fit(X[:300])
         assert b.best_score_ >= a.best_score_ - 1e-6
+
+
+class TestKeyedClustererFleet:
+    def test_kmeans_clusterer_compiled_fleet(self, keyed_df):
+        from sklearn.cluster import KMeans
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=KMeans(n_clusters=2, n_init=1, random_state=0,
+                                    max_iter=50),
+            keyCols=["k"], xCol="x", estimatorType="clusterer")
+        km = ke.fit(keyed_df)
+        assert km.backend == "tpu"
+        out = km.transform(keyed_df)
+        assert out["output"].dtype == np.int64
+        assert set(np.unique(out["output"])) <= {0, 1}
+        # per-key models differ: each key clusters its own 30 rows
+        assert len(km.keyedModels) == 3
+
+    def test_transductive_clusterer_rejected_up_front(self):
+        from sklearn.cluster import DBSCAN
+        with pytest.raises(ValueError, match="requires an estimator"):
+            sst.KeyedEstimator(sklearnEstimator=DBSCAN(), keyCols=["k"],
+                               xCol="x", estimatorType="clusterer")
+
+    def test_small_key_group_falls_back_to_host(self):
+        """A key with fewer rows than n_clusters must not be silently fit
+        from zero-padding — the host loop raises like sklearn."""
+        from sklearn.cluster import KMeans
+        rng = np.random.default_rng(1)
+        df = pd.DataFrame({
+            "k": ["a"] * 30 + ["b"] * 3,
+            "x": [rng.normal(size=3) for _ in range(33)],
+        })
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=KMeans(n_clusters=8, n_init=1,
+                                    random_state=0),
+            keyCols=["k"], xCol="x", estimatorType="clusterer")
+        with pytest.raises(ValueError):
+            ke.fit(df)  # host path -> sklearn's n_samples < n_clusters
