@@ -13,37 +13,162 @@ here:
     (zero scheduling/broadcast overhead — strictly favourable to the
     baseline, unlike real Spark).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": fits/sec on TPU, "unit": "fits/sec",
-   "vs_baseline": speedup vs the ideal 8-executor proxy}
+Always prints ONE JSON line:
+  {"metric": ..., "value": fits/sec, "unit": "fits/sec",
+   "vs_baseline": speedup vs the ideal 8-exec proxy, "platform": ...}
+
+Robustness: the top-level process is an orchestrator that never imports
+jax, so it cannot hang on a wedged TPU backend (the axon tunnel can block
+forever inside backend init when a dead client still holds the chip
+claim — this produced an unparseable BENCH_r01).  It probes the TPU in a
+subprocess with a timeout; on success the full benchmark runs on the
+chip, otherwise a scaled-down CPU-mesh measurement runs instead and the
+JSON line carries "platform": "cpu-fallback".  A JSON line is emitted on
+every path.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+_PROBE_CODE = """
+import json
+import jax
+ds = jax.devices()
+print(json.dumps({"platform": ds[0].platform, "n_devices": len(ds)}))
+"""
+
+# Generous: first TPU compile of the 1000-candidate program can take
+# minutes, and killing a process mid-TPU-compile can wedge the chip claim
+# for every later process.  The probe (backend init only) is the cheap,
+# safe-to-kill step; the full run gets an hour.
+PROBE_TIMEOUT_S = 240
+TPU_RUN_TIMEOUT_S = 3600
+CPU_RUN_TIMEOUT_S = 1800
 
 
-def main():
+def _probe_tpu():
+    """Check in a throwaway subprocess whether a non-CPU backend comes up."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], capture_output=True,
+            text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    try:
+        info = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+    return info if info.get("platform") not in (None, "cpu") else None
+
+
+def _emit(payload):
+    print(json.dumps(payload))
+
+
+def _parse_last_json_line(stdout):
+    """Last stdout line that parses as a JSON object (a stray trailing
+    print from a library must not masquerade as the benchmark result)."""
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(out, dict):
+            return out
+    return None
+
+
+def orchestrate():
+    probe = _probe_tpu()
+    attempts = []
+    if probe is not None:
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--child", "tpu"],
+                capture_output=True, text=True, timeout=TPU_RUN_TIMEOUT_S)
+            sys.stderr.write(r.stderr[-4000:])
+            out = _parse_last_json_line(r.stdout)
+            if r.returncode == 0 and out is not None:
+                _emit(out)
+                return 0
+            attempts.append(
+                {"platform": "tpu", "rc": r.returncode,
+                 "stderr_tail": r.stderr[-500:]})
+        except subprocess.TimeoutExpired:
+            attempts.append({"platform": "tpu", "rc": "timeout"})
+    else:
+        attempts.append({"platform": "tpu", "rc": "probe-failed-or-hung"})
+
+    # CPU fallback: forced-cpu jax in a child, scaled-down grid so the
+    # 1-core host finishes in minutes.
+    env = dict(os.environ)
+    # belt-and-braces: the child also sets jax.config (the env var alone is
+    # not honored once the axon sitecustomize has imported jax)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, __file__, "--child", "cpu"],
+            capture_output=True, text=True, timeout=CPU_RUN_TIMEOUT_S,
+            env=env)
+        sys.stderr.write(r.stderr[-4000:])
+        out = _parse_last_json_line(r.stdout)
+        if r.returncode == 0 and out is not None:
+            out["tpu_attempt"] = attempts
+            _emit(out)
+            return 0
+        attempts.append({"platform": "cpu", "rc": r.returncode,
+                         "stderr_tail": r.stderr[-500:]})
+    except subprocess.TimeoutExpired:
+        attempts.append({"platform": "cpu", "rc": "timeout"})
+
+    # Last resort: still one parseable JSON line, value = 0 fits/sec.
+    _emit({
+        "metric": "GridSearchCV LogReg digits — fits/sec "
+                  "(speedup vs ideal 8-exec Spark-CPU proxy)",
+        "value": 0.0,
+        "unit": "fits/sec",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "error": "all benchmark attempts failed",
+        "attempts": attempts,
+    })
+    return 0
+
+
+def run_child(platform):
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from sklearn.base import clone
     from sklearn.datasets import load_digits
     from sklearn.linear_model import LogisticRegression
     from sklearn.model_selection import StratifiedKFold
-    from sklearn.base import clone
 
     import spark_sklearn_tpu as sst
+
+    real_platform = jax.devices()[0].platform
+    on_tpu = real_platform != "cpu"
 
     X, y = load_digits(return_X_y=True)
     X = (X / 16.0).astype(np.float32)
 
-    n_candidates = 1000
+    # Full-size grid on the chip; 1-core CPU gets a scaled-down grid
+    # (the batched solver is ~100x slower there — minutes, not hours).
+    n_candidates = 1000 if on_tpu else 40
     n_folds = 5
     grid = {"C": list(np.logspace(-4, 3, n_candidates))}
     est = LogisticRegression(max_iter=100)
     cv = StratifiedKFold(n_splits=n_folds)
     n_fits = n_candidates * n_folds
 
-    # --- TPU side (includes compile; report both) -----------------------
+    # --- device side (includes compile; report both) --------------------
     # fresh cache dir per run so the cold number really includes compile;
     # the warm rerun then measures steady state WITH the persistent cache
     import tempfile
@@ -53,28 +178,44 @@ def main():
                           config=cache_cfg)
     t0 = time.perf_counter()
     gs.fit(X, y)
-    tpu_total = time.perf_counter() - t0
+    dev_cold = time.perf_counter() - t0
 
     # steady-state re-run: same program shapes -> compile cache hit
     gs2 = sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
                            config=cache_cfg)
     t0 = time.perf_counter()
     gs2.fit(X, y)
-    tpu_warm = time.perf_counter() - t0
+    dev_warm = time.perf_counter() - t0
 
-    # bf16 MXU variant (solver state fp32; oracle-tested parity ~1e-2)
-    cfg16 = sst.TpuConfig(bf16_matmul=True,
-                          compile_cache_dir=cache_cfg.compile_cache_dir)
-    sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
-                     config=cfg16).fit(X, y)  # compile
-    gs3 = sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
-                           config=cfg16)
-    t0 = time.perf_counter()
-    gs3.fit(X, y)
-    tpu_bf16 = time.perf_counter() - t0
+    detail = {
+        "wall_s_cold": round(dev_cold, 2),
+        "wall_s_warm": round(dev_warm, 2),
+        "n_fits": n_fits,
+        "n_candidates": n_candidates,
+        "best_mean_test_score": round(
+            float(gs.cv_results_["mean_test_score"].max()), 4),
+    }
+
+    if on_tpu:
+        # bf16 MXU variant (solver state fp32; oracle-tested parity ~1e-2)
+        cfg16 = sst.TpuConfig(bf16_matmul=True,
+                              compile_cache_dir=cache_cfg.compile_cache_dir)
+        sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
+                         config=cfg16).fit(X, y)  # compile
+        gs3 = sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
+                               config=cfg16)
+        t0 = time.perf_counter()
+        gs3.fit(X, y)
+        tpu_bf16 = time.perf_counter() - t0
+        detail.update({
+            "wall_s_bf16": round(tpu_bf16, 2),
+            "bf16_fits_per_sec": round(n_fits / tpu_bf16, 2),
+            "bf16_best_score": round(float(
+                gs3.cv_results_["mean_test_score"].max()), 4),
+        })
 
     # --- baseline side: serial sklearn per-task fits --------------------
-    sub = 20
+    sub = min(20, n_candidates)
     splits = list(cv.split(X, y))
     t0 = time.perf_counter()
     for C in np.logspace(-4, 3, sub):
@@ -85,33 +226,35 @@ def main():
     serial_sub = time.perf_counter() - t0
     serial_est = serial_sub * (n_candidates / sub)
     spark8_proxy = serial_est / 8.0
+    detail["serial_sklearn_est_s"] = round(serial_est, 1)
+    detail["spark8_ideal_proxy_s"] = round(spark8_proxy, 1)
+    if on_tpu:
+        detail["bf16_vs_baseline"] = round(
+            spark8_proxy / tpu_bf16, 2)
 
     # headline stays fp32 so numbers are comparable across configs and
     # against the fp64 sklearn baseline; bf16 reported separately
-    fits_per_sec = n_fits / tpu_warm
-    vs_baseline = spark8_proxy / tpu_warm
+    fits_per_sec = n_fits / dev_warm
+    vs_baseline = spark8_proxy / dev_warm
 
-    best_tpu = float(gs.cv_results_["mean_test_score"].max())
-    print(json.dumps({
-        "metric": "GridSearchCV 1000x5 LogReg digits — fits/sec on TPU "
+    label = "TPU" if on_tpu else "CPU-fallback"
+    _emit({
+        "metric": f"GridSearchCV {n_candidates}x{n_folds} LogReg digits — "
+                  f"fits/sec on {label} "
                   "(speedup vs ideal 8-exec Spark-CPU proxy)",
         "value": round(fits_per_sec, 2),
         "unit": "fits/sec",
         "vs_baseline": round(vs_baseline, 2),
-        "detail": {
-            "tpu_wall_s_cold": round(tpu_total, 2),
-            "tpu_wall_s_warm": round(tpu_warm, 2),
-            "tpu_wall_s_bf16": round(tpu_bf16, 2),
-            "bf16_fits_per_sec": round(n_fits / tpu_bf16, 2),
-            "bf16_vs_baseline": round(spark8_proxy / tpu_bf16, 2),
-            "bf16_best_score": round(float(
-                gs3.cv_results_["mean_test_score"].max()), 4),
-            "serial_sklearn_est_s": round(serial_est, 1),
-            "spark8_ideal_proxy_s": round(spark8_proxy, 1),
-            "n_fits": n_fits,
-            "best_mean_test_score": round(best_tpu, 4),
-        },
-    }))
+        "platform": real_platform if on_tpu else "cpu-fallback",
+        "detail": detail,
+    })
+    return 0
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        return run_child(sys.argv[2])
+    return orchestrate()
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cassert>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -82,6 +83,7 @@ void quantile_bin_f32(const float* X, int64_t n_rows, int64_t n_features,
                       int32_t n_bins, float* edges_out, uint8_t* codes_out,
                       int32_t n_threads) {
   if (n_threads < 1) n_threads = 1;
+  assert(n_bins >= 2 && n_bins <= 256 && "codes are uint8");
   int64_t n_edges = n_bins - 1;
   auto worker = [&](int64_t f0, int64_t f1) {
     std::vector<float> col(n_rows);

@@ -173,6 +173,13 @@ class KeyedEstimator(BaseEstimator):
         elif family.is_classifier:
             lookup = {v: i for i, v in enumerate(meta["classes"])}
             enc = np.array([lookup[v] for v in y_all], np.float64)
+            # per-key classes_ semantics: a key whose group lacks some of
+            # the global classes must be fitted over its OWN label set (the
+            # host loop does that); the stacked fleet label-encodes
+            # globally, so it only applies when every key saw every class
+            for pdf in slices:
+                if len(set(enc[pdf.index.to_numpy()])) < meta["n_classes"]:
+                    return None
         else:
             enc = np.asarray(y_all, np.float64)
         Xs = np.zeros((G, L, d), np.float32)

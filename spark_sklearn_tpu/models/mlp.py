@@ -210,18 +210,26 @@ class MLPClassifierFamily(Family):
         return {"layers": params}
 
     @classmethod
-    def decision(cls, model, static, X, meta):
+    def _logits(cls, model, static, X, meta):
         act = _activation(static.get("activation", "relu"))
         return _forward(model["layers"], X, act)
 
     @classmethod
+    def decision(cls, model, static, X, meta):
+        Z = cls._logits(model, static, X, meta)
+        if meta.get("n_classes") == 2:
+            # scorer contract: binary decision is a 1-D margin
+            return Z[:, 1] - Z[:, 0]
+        return Z
+
+    @classmethod
     def predict(cls, model, static, X, meta):
-        return jnp.argmax(cls.decision(model, static, X, meta),
+        return jnp.argmax(cls._logits(model, static, X, meta),
                           axis=1).astype(jnp.int32)
 
     @classmethod
     def predict_proba(cls, model, static, X, meta):
-        return jax.nn.softmax(cls.decision(model, static, X, meta), axis=1)
+        return jax.nn.softmax(cls._logits(model, static, X, meta), axis=1)
 
     @classmethod
     def sklearn_attrs(cls, model, static, meta):
@@ -262,7 +270,7 @@ class MLPRegressorFamily(MLPClassifierFamily):
 
     @classmethod
     def predict(cls, model, static, X, meta):
-        out = cls.decision(model, static, X, meta)
+        out = cls._logits(model, static, X, meta)
         return out[:, 0] if meta["n_targets"] == 1 else out
 
     @classmethod

@@ -483,6 +483,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             key = fingerprint(
                 type(self.estimator).__name__, base_params, candidates,
                 scorer_names, n_folds, return_train,
+                # result-affecting config: resuming under a different matmul
+                # precision or dtype must not reuse the other run's scores
+                (bool(config.bf16_matmul), str(config.dtype)),
                 X[: min(64, n_samples)],
                 # whole-dataset moments so ANY changed X row or label set
                 # breaks the fingerprint (head rows alone can collide)

@@ -161,15 +161,20 @@ def _neg_mae(family, model, static, data, meta, w):
 
 
 def _neg_median_ae(family, model, static, data, meta, w):
-    # weighted median via sorting on |err| with mask-weights
+    # weighted median via sorting on |err| with mask-weights; when the
+    # cumulative weight hits exactly half (even-sized unweighted folds),
+    # average the two middle errors the way np.median does
     pred = family.predict(model, static, _feats(data), meta)
     err = jnp.abs(data["y"] - pred)
     order = jnp.argsort(err)
     e_s, w_s = err[order], w[order]
     cw = jnp.cumsum(w_s)
     half = 0.5 * jnp.sum(w_s)
-    idx = jnp.searchsorted(cw, half)
-    return -e_s[jnp.clip(idx, 0, err.shape[0] - 1)]
+    n = err.shape[0]
+    idx_lo = jnp.clip(jnp.searchsorted(cw, half), 0, n - 1)
+    idx_hi = jnp.clip(jnp.searchsorted(cw, half, side="right"), 0, n - 1)
+    lo, hi = e_s[idx_lo], e_s[idx_hi]
+    return -jnp.where(cw[idx_lo] == half, 0.5 * (lo + hi), lo)
 
 
 def _max_error(family, model, static, data, meta, w):

@@ -131,6 +131,9 @@ def quantile_bin(X: np.ndarray, n_bins: int = 256, n_threads: int = 0
                  ) -> Tuple[np.ndarray, np.ndarray]:
     """Per-feature quantile binning -> (edges (d, n_bins-1), codes uint8
     (n, d)).  Prep stage for histogram-based tree learners."""
+    if not 2 <= n_bins <= 256:
+        raise ValueError(
+            f"n_bins must be in [2, 256] (codes are uint8), got {n_bins}")
     X = np.ascontiguousarray(X, np.float32)
     n, d = X.shape
     lib = _load()

@@ -343,6 +343,26 @@ class TestKeyedTierA:
         acc = np.mean(out["output"] == df["y"])
         assert acc > 0.9
 
+    def test_missing_class_key_falls_back_to_host(self):
+        # a key whose group lacks one of the global classes must get its
+        # own classes_ (host per-key semantics), not a globally-encoded fit
+        rng = np.random.default_rng(4)
+        df = pd.DataFrame({
+            "k": np.repeat(["a", "b"], 40),
+            "x": [rng.normal(size=3) for _ in range(80)],
+        })
+        y = np.where([v[0] > 0 for v in df.x], "pos", "neg")
+        y[:40][:5] = "mid"          # key "a" sees all 3 classes
+        y[40:] = np.where(y[40:] == "pos", "pos", "neg")  # "b" sees only 2
+        df["y"] = y
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLogReg(max_iter=100), keyCols=["k"],
+            xCol="x", yCol="y").fit(df)
+        assert km.backend == "host"
+        out = km.transform(df)
+        # key "b"'s model must only ever emit its own two classes
+        assert set(out["output"][40:]) <= {"pos", "neg"}
+
     def test_unseen_key_fleet_nan(self, keyed_df):
         km = sst.KeyedEstimator(
             sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",

@@ -43,6 +43,20 @@ class TestMLP:
             {"alpha": [1e-4]}, cv=3, backend="host").fit(X, y)
         assert abs(ours.best_score_ - theirs.best_score_) < 0.05
 
+    def test_mlp_binary_roc_auc_compiled(self, digits):
+        # binary decision must be a 1-D margin so roc_auc traces; the full
+        # (n, 2) logits used to crash the compiled scorer at trace time
+        X, y = digits
+        mask = y < 2
+        X2, y2 = X[mask], y[mask]
+        gs = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(32,), max_iter=30,
+                          random_state=0),
+            {"alpha": [1e-4, 1e-2]}, cv=3, backend="tpu",
+            scoring="roc_auc").fit(X2, y2)
+        assert gs.search_report["backend"] == "tpu"
+        assert gs.cv_results_["mean_test_score"].max() > 0.95
+
     def test_early_stopping_falls_back(self, digits):
         X, y = digits
         with pytest.warns(UserWarning, match="falling back"):

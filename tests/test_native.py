@@ -51,6 +51,14 @@ def test_quantile_bin_monotone_and_bounded():
         assert np.all(np.diff(codes[order, f].astype(int)) >= 0)
 
 
+def test_quantile_bin_rejects_out_of_range_n_bins():
+    X = np.zeros((4, 2), np.float32)
+    with pytest.raises(ValueError, match="n_bins"):
+        native.quantile_bin(X, 257)
+    with pytest.raises(ValueError, match="n_bins"):
+        native.quantile_bin(X, 1)
+
+
 def test_quantile_bin_roughly_balanced():
     rng = np.random.default_rng(1)
     X = rng.uniform(size=(4096, 1)).astype(np.float32)

@@ -122,11 +122,24 @@ def test_regression_scorers_match_sklearn(name, skfn):
     ours = float(S.SCORERS[name](fam, {}, {}, data, {}, jnp.asarray(mask)))
     sel = mask > 0
     theirs = skfn(y[sel], pred[sel])
-    tol = 2e-2 if name == "neg_median_absolute_error" else 1e-3
-    # sklearn max_error is positive; ours returns the negated utility form
-    if name == "max_error":
-        theirs = skfn(y[sel], pred[sel])
-    assert abs(ours - theirs) < tol, (name, ours, theirs)
+    assert abs(ours - theirs) < 1e-3, (name, ours, theirs)
+
+
+@pytest.mark.parametrize("n", [10, 11, 256, 257])
+def test_median_ae_both_parities_match_sklearn(n):
+    # even n is the common KFold case: np.median averages the two middle
+    # values and the compiled scorer must agree, not take one order statistic
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    y = rng.normal(size=n).astype(np.float64)
+    pred = y + 0.5 * rng.normal(size=n)
+    fam = _MockFamily(pred=jnp.asarray(pred, jnp.float32))
+    fam.is_classifier = False
+    data = {"X": jnp.zeros((n, 1)), "y": jnp.asarray(y, jnp.float32)}
+    ours = float(S.SCORERS["neg_median_absolute_error"](
+        fam, {}, {}, data, {}, jnp.ones((n,), jnp.float32)))
+    theirs = -skm.median_absolute_error(y, pred)
+    assert abs(ours - theirs) < 1e-6, (n, ours, theirs)
 
 
 def test_balanced_accuracy_matches_sklearn():
