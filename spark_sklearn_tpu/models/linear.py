@@ -340,6 +340,10 @@ class RidgeFamily(Family):
     name = "ridge"
     is_classifier = False
     dynamic_params = {"alpha": np.float32}
+    # closed-form normal equations: the Gram's conditioning amplifies f32
+    # rounding ~1e-4 past sklearn's f64 answers, so the search engine runs
+    # this family under x64 (tiny d x d solves — negligible cost)
+    wants_float64 = True
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):

@@ -40,8 +40,16 @@ import jax
 
 from sklearn.base import BaseEstimator, MetaEstimatorMixin, clone, is_classifier
 from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
+from sklearn.utils import Bunch
+from sklearn.utils.metadata_routing import (
+    MetadataRouter,
+    MethodMapping,
+    _raise_for_params,
+    _routing_enabled,
+    process_routing,
+)
 from sklearn.utils.metaestimators import available_if
-from sklearn.utils.validation import check_is_fitted
+from sklearn.utils.validation import _check_method_params, check_is_fitted
 
 from spark_sklearn_tpu.models.base import resolve_family
 from spark_sklearn_tpu.parallel import mesh as mesh_lib
@@ -151,7 +159,89 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 f"not needed, refit should be set to False explicitly. "
                 f"{self.refit!r} was passed.")
 
-    def fit(self, X, y=None, *, groups=None, **fit_params):
+    # -- metadata routing (sklearn 1.4+ contract; installed
+    # _search.py get_metadata_routing/_get_routed_params_for_fit) --------
+    def _get_scorers(self):
+        """sklearn-facing scorer objects, used for routing decisions and
+        as `scorer_` (the compiled tier resolves its own device scorers
+        separately)."""
+        from sklearn.metrics import check_scoring
+        from sklearn.metrics._scorer import (
+            _check_multimetric_scoring, _MultimetricScorer)
+
+        if callable(self.scoring):
+            return self.scoring
+        if self.scoring is None or isinstance(self.scoring, str):
+            return check_scoring(self.estimator, self.scoring)
+        scorers = _check_multimetric_scoring(self.estimator, self.scoring)
+        return _MultimetricScorer(
+            scorers=scorers, raise_exc=(self.error_score == "raise"))
+
+    def _check_scorers_accept_sample_weight(self):
+        """Warn per scorer that cannot consume sample_weight (sklearn's
+        pre-routing forwarding rule) and return whether any can."""
+        from inspect import signature
+
+        from sklearn.metrics._scorer import _MultimetricScorer
+
+        scorers = self._get_scorers()
+        if isinstance(scorers, _MultimetricScorer):
+            for name, scorer in scorers._scorers.items():
+                if not scorer._accept_sample_weight():
+                    warnings.warn(
+                        f"The scoring {name}={scorer} does not support "
+                        "sample_weight, which may lead to statistically "
+                        f"incorrect results when fitting {self} with "
+                        "sample_weight. ")
+            return scorers._accept_sample_weight()
+        if hasattr(scorers, "_accept_sample_weight"):
+            accept = scorers._accept_sample_weight()
+        else:
+            accept = "sample_weight" in signature(scorers).parameters
+        if not accept:
+            warnings.warn(
+                f"The scoring {scorers} does not support sample_weight, "
+                "which may lead to statistically incorrect results when "
+                f"fitting {self} with sample_weight. ")
+        return accept
+
+    def _get_routed_params_for_fit(self, params):
+        if _routing_enabled():
+            return process_routing(self, "fit", **params)
+        params = params.copy()
+        groups = params.pop("groups", None)
+        routed_params = Bunch(
+            estimator=Bunch(fit=params),
+            splitter=Bunch(split={"groups": groups}),
+            scorer=Bunch(score={}),
+        )
+        # pre-routing rule: sample_weight forwards to the scorer(s) when
+        # present and accepted (any scorer, for multimetric)
+        if (params.get("sample_weight") is not None
+                and self._check_scorers_accept_sample_weight()):
+            routed_params.scorer.score["sample_weight"] = \
+                params["sample_weight"]
+        return routed_params
+
+    def get_metadata_routing(self):
+        router = MetadataRouter(owner=self)
+        router.add(
+            estimator=self.estimator,
+            method_mapping=MethodMapping().add(caller="fit", callee="fit"),
+        )
+        router.add(
+            scorer=self._get_scorers(),
+            method_mapping=MethodMapping()
+            .add(caller="score", callee="score")
+            .add(caller="fit", callee="score"),
+        )
+        router.add(
+            splitter=self.cv,
+            method_mapping=MethodMapping().add(caller="fit", callee="split"),
+        )
+        return router
+
+    def fit(self, X, y=None, **params):
         estimator = self.estimator
         if self.scoring is None and not hasattr(estimator, "score"):
             # sklearn validates this before any work (BaseSearchCV.fit)
@@ -175,10 +265,15 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             if _sp.issparse(X) and X.format not in ("csr", "csc"):
                 X = X.tocsr()  # COO/DOK are not sliceable by fold indices
         X_arr = X if hasattr(X, "shape") else np.asarray(X)
-        splits = list(cv.split(X_arr, y, groups))
+
+        params = _check_method_params(X, params=params)
+        routed_params = self._get_routed_params_for_fit(params)
+
+        splits = list(cv.split(X_arr, y, **routed_params.splitter.split))
         self.n_splits_ = len(splits)
         if hasattr(cv, "get_n_splits"):
-            expected_n_splits = cv.get_n_splits(X_arr, y, groups)
+            expected_n_splits = cv.get_n_splits(
+                X_arr, y, **routed_params.splitter.split)
             if expected_n_splits != self.n_splits_:
                 raise ValueError(
                     "cv.split and cv.get_n_splits return "
@@ -188,13 +283,23 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         family = None if self.backend == "host" else resolve_family(estimator)
         use_compiled = family is not None
         # groups is fine on the compiled path: the splits above already
-        # encode it and only fold masks reach the device.  fit_params is
-        # not: arbitrary kwargs cannot enter a traced fit.
-        if use_compiled and fit_params:
+        # encode it and only fold masks reach the device.  sample_weight is
+        # too: it is one multiply into the fold masks.  Any OTHER fit/score
+        # param is an arbitrary kwarg that cannot enter a traced fit.
+        est_fit_params = dict(routed_params.estimator.fit)
+        score_params = dict(routed_params.scorer.score)
+        fit_weight = est_fit_params.get("sample_weight")
+        score_weight = score_params.get("sample_weight")
+        unsupported_compiled = (
+            {k for k, v in est_fit_params.items()
+             if k != "sample_weight" and v is not None}
+            | {k for k, v in score_params.items()
+               if k != "sample_weight" and v is not None})
+        if use_compiled and unsupported_compiled:
             if self.backend == "tpu":
                 raise ValueError(
-                    "fit_params are not supported on the compiled path; "
-                    "use backend='host'")
+                    f"fit/score params {sorted(unsupported_compiled)} are "
+                    "not supported on the compiled path; use backend='host'")
             use_compiled = False
         if use_compiled:
             try:
@@ -222,7 +327,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             if state["use_compiled"]:
                 try:
                     return self._fit_compiled(
-                        family, X_arr, y, cands, splits)
+                        family, X_arr, y, cands, splits,
+                        fit_weight=fit_weight, score_weight=score_weight)
                 except Exception as exc:  # unsupported static combo etc.
                     if self.backend == "tpu":
                         raise
@@ -233,7 +339,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             # the host path receives the CALLER's X (list, sparse, frame —
             # sklearn estimators may validate its exact type); only the
             # compiled path needs the dense array form
-            return self._fit_host(X, y, cands, splits, fit_params)
+            return self._fit_host(X, y, cands, splits, est_fit_params,
+                                  score_params)
 
         def evaluate_candidates(candidate_params):
             cands = list(candidate_params)
@@ -315,9 +422,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 **clone(self.best_params_, safe=False))
             t0 = time.perf_counter()
             if y is not None:
-                self.best_estimator_.fit(X, y, **fit_params)
+                self.best_estimator_.fit(X, y, **routed_params.estimator.fit)
             else:
-                self.best_estimator_.fit(X, **fit_params)
+                self.best_estimator_.fit(X, **routed_params.estimator.fit)
             self.refit_time_ = time.perf_counter() - t0
             if hasattr(self.best_estimator_, "classes_"):
                 self.classes_ = self.best_estimator_.classes_
@@ -368,9 +475,34 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     # ------------------------------------------------------------------
     # Tier A: compiled path
     # ------------------------------------------------------------------
-    def _fit_compiled(self, family, X, y, candidates, splits):
-        from sklearn.metrics import check_scoring
+    def _fit_compiled(self, family, X, y, candidates, splits,
+                      fit_weight=None, score_weight=None):
         config = self.config or TpuConfig()
+        # closed-form linear-algebra families (ridge-type normal equations)
+        # amplify f32 rounding through the Gram conditioning to ~1e-4 —
+        # far from sklearn's f64 answers.  They advertise wants_float64 and
+        # run under a temporarily-enabled x64 mode so sklearn parity and
+        # weighted-vs-repeated equivalence hold at sklearn's own 1e-7.
+        use_f64 = bool(getattr(family, "wants_float64", False)) and \
+            config.dtype is None
+        if not use_f64:
+            return self._fit_compiled_impl(
+                family, X, y, candidates, splits, config,
+                fit_weight=fit_weight, score_weight=score_weight)
+        prev_x64 = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", True)
+        try:
+            return self._fit_compiled_impl(
+                family, X, y, candidates, splits, config,
+                fit_weight=fit_weight, score_weight=score_weight,
+                dtype_override=np.float64)
+        finally:
+            jax.config.update("jax_enable_x64", prev_x64)
+
+    def _fit_compiled_impl(self, family, X, y, candidates, splits, config,
+                           fit_weight=None, score_weight=None,
+                           dtype_override=None):
+        from sklearn.metrics import check_scoring
         if config.compile_cache_dir and (
                 jax.config.jax_compilation_cache_dir
                 != config.compile_cache_dir):
@@ -380,7 +512,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                               config.compile_cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               0.5)
-        dtype = config.dtype or np.float32
+        dtype = dtype_override or config.dtype or np.float32
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
 
@@ -393,16 +525,22 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     f"reached the device ({family.name} is unsupervised: "
                     "y was absent or not numerically encodable; only its "
                     "default scorer applies)")
+            from spark_sklearn_tpu.search.scorers import (
+                compiled_name_for_scorer)
+
+            def _canon(s):
+                return s if isinstance(s, str) \
+                    else compiled_name_for_scorer(s)
             if isinstance(self.scoring, str):
                 wanted = [self.scoring]
             elif isinstance(self.scoring, dict):
                 # dict values name the metrics; keys are display labels
-                wanted = [s for s in self.scoring.values()
-                          if isinstance(s, str)]
+                wanted = [_canon(s) for s in self.scoring.values()]
             elif isinstance(self.scoring, (list, tuple, set)):
-                wanted = [s for s in self.scoring if isinstance(s, str)]
+                wanted = [_canon(s) for s in self.scoring]
             else:
-                wanted = []
+                wanted = [_canon(self.scoring)]
+            wanted = [s for s in wanted if s is not None]
             if any(s in CLASSIFICATION_SCORERS for s in wanted) and \
                     "n_classes" not in meta:
                 raise ValueError(
@@ -420,6 +558,38 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         n_folds = len(splits)
         n_cand = len(candidates)
         return_train = self.return_train_score
+
+        # sample_weight enters the compiled tier as mask multiplies: the
+        # estimator's weights scale the FIT masks, the scorer's weights
+        # scale the SCORING masks (sklearn routes the two independently —
+        # a scorer that rejects sample_weight scores unweighted even when
+        # the fit was weighted)
+        fit_masks = train_masks
+        if fit_weight is not None:
+            fw = np.asarray(fit_weight, dtype=dtype)
+            if fw.shape != (n_samples,):
+                raise ValueError(
+                    f"sample_weight has shape {fw.shape}, expected "
+                    f"({n_samples},)")
+            fit_masks = train_masks * fw[None, :]
+        if score_weight is not None:
+            sw = np.asarray(score_weight, dtype=dtype)
+            if sw.shape != (n_samples,):
+                raise ValueError(
+                    f"scorer sample_weight has shape {sw.shape}, expected "
+                    f"({n_samples},)")
+            test_sc_masks = test_masks * sw[None, :]
+            train_sc_masks = train_masks * sw[None, :]
+        else:
+            test_sc_masks = test_masks
+            train_sc_masks = train_masks
+        # scorers whose sklearn twin rejects sample_weight score unweighted
+        # even in a weighted search (_MultimetricScorer forwards per-scorer)
+        from spark_sklearn_tpu.search.scorers import SAMPLE_WEIGHT_BLIND_FNS
+        sw_blind = frozenset(
+            name for name, fn in scorers.items()
+            if fn in SAMPLE_WEIGHT_BLIND_FNS)
+        need_unweighted = score_weight is not None and bool(sw_blind)
 
         base_params = family.extract_params(self.estimator)
         if hasattr(family, "observe_candidates"):
@@ -453,22 +623,38 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 data = {k: np.concatenate(
                     [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
                     for k, v in data.items()}
-                train_masks = np.concatenate(
-                    [train_masks, np.zeros((n_folds, pad),
-                                           train_masks.dtype)], axis=1)
-                test_masks = np.concatenate(
-                    [test_masks, np.zeros((n_folds, pad),
-                                          test_masks.dtype)], axis=1)
+
+                def _padm(m, pad=pad):
+                    return np.concatenate(
+                        [m, np.zeros((n_folds, pad), m.dtype)], axis=1)
+                train_sc_aliases_fit = train_sc_masks is fit_masks
+                fit_masks = _padm(fit_masks)
+                test_sc_masks = _padm(test_sc_masks)
+                train_sc_masks = (fit_masks if train_sc_aliases_fit
+                                  else _padm(train_sc_masks))
+                if need_unweighted:
+                    test_masks = _padm(test_masks)
+                    train_masks = _padm(train_masks)
             sample_shard = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
             mask_shard = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
             data_dev = {k: jax.device_put(v, sample_shard)
                         for k, v in data.items()}
-            train_dev = jax.device_put(train_masks, mask_shard)
-            test_dev = jax.device_put(test_masks, mask_shard)
+            put_masks = mask_shard
         else:
             data_dev = {k: jax.device_put(v, repl) for k, v in data.items()}
-            train_dev = jax.device_put(train_masks, repl)
-            test_dev = jax.device_put(test_masks, repl)
+            put_masks = repl
+        # one device buffer per DISTINCT mask array: in the unweighted case
+        # fit/train-scoring masks are the same object, so they share one
+        # upload and one HBM allocation
+        fit_dev = jax.device_put(fit_masks, put_masks)
+        test_dev = jax.device_put(test_sc_masks, put_masks)
+        train_sc_dev = (fit_dev if train_sc_masks is fit_masks
+                        else jax.device_put(train_sc_masks, put_masks))
+        if need_unweighted:
+            test_unw_dev = jax.device_put(test_masks, put_masks)
+            train_unw_dev = jax.device_put(train_masks, put_masks)
+        else:
+            test_unw_dev, train_unw_dev = test_dev, train_sc_dev
 
         test_scores = {s: np.empty((n_cand, n_folds)) for s in scorer_names}
         train_scores = ({s: np.empty((n_cand, n_folds))
@@ -492,7 +678,17 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 (X.shape, float(np.sum(X, dtype=np.float64)),
                  float(np.sum(np.square(X, dtype=np.float64)))),
                 self._hashable_labels(y),
-                np.asarray(train_masks))
+                np.asarray(train_masks),
+                # weighted searches must not resume an unweighted run's
+                # chunks (and vice versa); arrays go in as bare top-level
+                # parts so fingerprint() hashes their bytes (tuples would
+                # be repr()'d, which numpy truncates past 1000 elements)
+                "fitw",
+                np.asarray(fit_weight, np.float64)
+                if fit_weight is not None else "none",
+                "scw",
+                np.asarray(score_weight, np.float64)
+                if score_weight is not None else "none")
             ckpt = SearchCheckpoint(config.checkpoint_dir, key)
 
         profiler_cm = None
@@ -530,8 +726,11 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 self._run_groups(
                     groups=groups, base_params=base_params, family=family,
                     meta=meta, scorers=scorers, scorer_names=scorer_names,
-                    data_dev=data_dev, train_dev=train_dev,
-                    test_dev=test_dev, train_masks=train_masks, mesh=mesh,
+                    data_dev=data_dev, fit_dev=fit_dev,
+                    test_dev=test_dev, train_sc_dev=train_sc_dev,
+                    test_unw_dev=test_unw_dev, train_unw_dev=train_unw_dev,
+                    sw_blind=sw_blind,
+                    fit_masks=fit_masks, mesh=mesh,
                     config=config, n_task_shards=n_task_shards,
                     task_shard=task_shard,
                     max_cand_per_batch=max_cand_per_batch, n_folds=n_folds,
@@ -585,8 +784,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 scorer_names, scorer_attr)
 
     def _run_groups(self, *, groups, base_params, family, meta, scorers,
-                    scorer_names, data_dev, train_dev, test_dev, train_masks,
-                    mesh, config, n_task_shards, task_shard,
+                    scorer_names, data_dev, fit_dev, test_dev, train_sc_dev,
+                    test_unw_dev, train_unw_dev, sw_blind,
+                    fit_masks, mesh, config, n_task_shards, task_shard,
                     max_cand_per_batch, n_folds, dtype, return_train,
                     test_scores, train_scores, fit_times, score_times, ckpt):
         task_batched = hasattr(family, "fit_task_batched")
@@ -607,7 +807,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 # flatten (candidate x fold) into one leading task axis and
                 # let the family turn it into wide-matmul width (candidate-
                 # major order: task t = (cand t//n_folds, fold t%n_folds))
-                w_task = np.tile(train_masks, (nc_batch, 1))
+                w_task = np.tile(fit_masks, (nc_batch, 1))
                 w_task_dev = jax.device_put(w_task, tb_mask_shard)
 
                 def fit_batch_tb(dyn_t, data_d, w_t,
@@ -629,17 +829,22 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     return jax.vmap(one_fold)(train_m)
                 return jax.vmap(one_cand)(dyn_arrs)
 
-            def score_batch(models, data_d, test_m, train_m, static=static):
+            def score_batch(models, data_d, test_m, train_m, test_u,
+                            train_u, static=static):
                 def one_cand(model_c):
-                    def one_fold(model, w_test, w_train):
+                    def one_fold(model, w_test, w_train, w_test_u,
+                                 w_train_u):
                         te = {s: fn(family, model, static, data_d, meta,
-                                    w_test) for s, fn in scorers.items()}
+                                    w_test_u if s in sw_blind else w_test)
+                              for s, fn in scorers.items()}
                         tr = ({s: fn(family, model, static, data_d, meta,
-                                     w_train) for s, fn in scorers.items()}
+                                     w_train_u if s in sw_blind
+                                     else w_train)
+                               for s, fn in scorers.items()}
                               if return_train else {})
                         return te, tr
                     return jax.vmap(one_fold)(
-                        model_c, test_m, train_m)
+                        model_c, test_m, train_m, test_u, train_u)
                 return jax.vmap(one_cand)(models)
 
             if not task_batched:
@@ -688,12 +893,13 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 if task_batched:
                     models = fit_jit(dyn, data_dev, w_task_dev)
                 else:
-                    models = fit_jit(dyn, data_dev, train_dev)
+                    models = fit_jit(dyn, data_dev, fit_dev)
                 jax.block_until_ready(models)
                 t_fit = time.perf_counter() - t0
 
                 t0 = time.perf_counter()
-                te, tr = score_jit(models, data_dev, test_dev, train_dev)
+                te, tr = score_jit(models, data_dev, test_dev, train_sc_dev,
+                                   test_unw_dev, train_unw_dev)
                 te = jax.device_get(te)
                 tr = jax.device_get(tr)
                 t_score = time.perf_counter() - t0
@@ -722,7 +928,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     # ------------------------------------------------------------------
     # Tier B: host fallback (full sklearn generality)
     # ------------------------------------------------------------------
-    def _fit_host(self, X, y, candidates, splits, fit_params):
+    def _fit_host(self, X, y, candidates, splits, fit_params,
+                  score_params=None):
         from joblib import Parallel, delayed
         from sklearn.metrics import check_scoring
         from sklearn.metrics._scorer import _check_multimetric_scoring
@@ -764,7 +971,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 clone(estimator), X, y, scorer=scorer_for_fs,
                 train=train, test=test, verbose=self.verbose,
                 parameters=params, fit_params=fit_params or None,
-                score_params=None,
+                score_params=score_params or None,
                 return_train_score=self.return_train_score,
                 return_times=True, error_score=self.error_score)
 
@@ -945,19 +1152,33 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             pass
         return tags
 
-    def score(self, X, y=None):
+    def score(self, X, y=None, **params):
         _check_refit(self, "score")
         if not hasattr(self, "best_estimator_"):
             raise AttributeError(
                 f"This {type(self).__name__} instance is not fitted yet; "
                 "call fit() first.")
+        # metadata routing contract: extra params are rejected unless
+        # enable_metadata_routing=True, then routed to the scorer
+        _raise_for_params(params, self, "score")
+        if _routing_enabled():
+            score_params = process_routing(
+                self, "score", **params).scorer["score"]
+        else:
+            score_params = {}
         if callable(self.scoring):
-            return self.scoring(self.best_estimator_, X, y)
+            score = self.scoring(self.best_estimator_, X, y, **score_params)
+            # a multimetric callable returns a dict; score() is the refit
+            # metric's scalar (sklearn _search.py BaseSearchCV.score)
+            if getattr(self, "multimetric_", False):
+                score = score[self.refit]
+            return score
         if self.scorer_ is not None and not isinstance(self.scorer_, dict):
-            return self.scorer_(self.best_estimator_, X, y)
+            return self.scorer_(self.best_estimator_, X, y, **score_params)
         if isinstance(self.scorer_, dict) and isinstance(self.refit, str):
-            return self.scorer_[self.refit](self.best_estimator_, X, y)
-        return self.best_estimator_.score(X, y)
+            return self.scorer_[self.refit](
+                self.best_estimator_, X, y, **score_params)
+        return self.best_estimator_.score(X, y, **score_params)
 
 
 class GridSearchCV(BaseSearchTPU):

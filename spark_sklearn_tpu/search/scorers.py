@@ -198,7 +198,8 @@ SCORERS: Dict[str, Callable] = {
     "neg_root_mean_squared_error": _neg_rmse,
     "neg_mean_absolute_error": _neg_mae,
     "neg_median_absolute_error": _neg_median_ae,
-    "max_error": _max_error,
+    "max_error": _max_error,        # legacy sklearn name
+    "neg_max_error": _max_error,    # sklearn >= 1.6 name
 }
 
 
@@ -211,6 +212,51 @@ CLASSIFICATION_SCORERS = {
 #: binary-only compiled implementations (multiclass variants live on the
 #: host path with sklearn's averaging semantics)
 BINARY_ONLY_SCORERS = {"f1", "precision", "recall", "roc_auc"}
+
+#: compiled impls whose sklearn twin does NOT accept sample_weight; the
+#: engine scores these with unweighted masks even in a weighted search,
+#: mirroring _MultimetricScorer's per-scorer forwarding
+SAMPLE_WEIGHT_BLIND_FNS = frozenset({_max_error})
+
+
+#: make_scorer(_score_func, sign) -> compiled scorer name; consulted so
+#: user-built `make_scorer(accuracy_score)`-style objects (with default
+#: kwargs) stay on the compiled path instead of de-optimizing to host
+_SCORE_FUNC_TABLE = {
+    ("accuracy_score", 1): "accuracy",
+    ("balanced_accuracy_score", 1): "balanced_accuracy",
+    ("recall_score", 1): "recall",
+    ("precision_score", 1): "precision",
+    ("f1_score", 1): "f1",
+    ("roc_auc_score", 1): "roc_auc",
+    ("log_loss", -1): "neg_log_loss",
+    ("r2_score", 1): "r2",
+    ("explained_variance_score", 1): "explained_variance",
+    ("mean_squared_error", -1): "neg_mean_squared_error",
+    ("root_mean_squared_error", -1): "neg_root_mean_squared_error",
+    ("mean_absolute_error", -1): "neg_mean_absolute_error",
+    ("median_absolute_error", -1): "neg_median_absolute_error",
+    ("mean_squared_log_error", -1): "neg_mean_squared_log_error",
+    ("max_error", -1): "max_error",
+}
+
+
+def compiled_name_for_scorer(obj):
+    """Map a sklearn make_scorer object with default kwargs to the
+    equivalent compiled scorer name, or None when it has no compiled
+    twin (custom kwargs, custom callables, pos_label overrides...)."""
+    try:
+        from sklearn.metrics._scorer import _Scorer
+    except ImportError:                                # pragma: no cover
+        return None
+    if not isinstance(obj, _Scorer):
+        return None
+    if getattr(obj, "_kwargs", None):
+        return None
+    fn_name = getattr(getattr(obj, "_score_func", None), "__name__", None)
+    sign = getattr(obj, "_sign", 1)
+    name = _SCORE_FUNC_TABLE.get((fn_name, sign))
+    return name if name in SCORERS else None
 
 
 def resolve_scoring(scoring, family):
@@ -228,15 +274,30 @@ def resolve_scoring(scoring, family):
                 f"scoring={scoring!r} has no compiled implementation; "
                 f"available: {sorted(SCORERS)} (or use backend='host')")
         return {"score": SCORERS[scoring]}, "score"
+    obj_name = compiled_name_for_scorer(scoring)
+    if obj_name is not None:
+        return {"score": SCORERS[obj_name]}, "score"
     if isinstance(scoring, (list, tuple, set)):
-        return {s: SCORERS[s] for s in scoring}, None
+        # sklearn's contract: list/tuple scoring must be unique metric-name
+        # STRINGS (_check_multimetric_scoring rejects objects in lists) —
+        # keep that behavior rather than canonicalizing objects here
+        out = {}
+        for s in scoring:
+            if not isinstance(s, str) or s not in SCORERS:
+                raise KeyError(
+                    f"scoring entry {s!r} not compiled (list scoring takes "
+                    "unique metric-name strings); use backend='host'")
+            out[s] = SCORERS[s]
+        return out, None
     if isinstance(scoring, dict):
         out = {}
         for name, s in scoring.items():
-            if not isinstance(s, str) or s not in SCORERS:
+            if not isinstance(s, str):
+                s = compiled_name_for_scorer(s)
+            if s is None or s not in SCORERS:
                 raise KeyError(
-                    f"multimetric entry {name}={s!r} not compiled; use "
-                    f"backend='host'")
+                    f"multimetric entry {name}={scoring[name]!r} not "
+                    "compiled; use backend='host'")
             out[name] = SCORERS[s]
         return out, None
     raise TypeError(f"Unsupported scoring spec for the compiled path: "

@@ -1,0 +1,196 @@
+"""Metadata routing + sample_weight on both tiers.
+
+Contract: installed sklearn/model_selection/_search.py BaseSearchCV.fit
+routing block (get_metadata_routing / _get_routed_params_for_fit) and
+sklearn's pre-routing sample_weight forwarding rule.  The compiled tier
+carries sample_weight as a multiply into the fold masks.
+"""
+
+import numpy as np
+import pytest
+import sklearn
+from sklearn.datasets import load_digits
+from sklearn.linear_model import LogisticRegression as SkLogReg
+from sklearn.linear_model import Ridge as SkRidge
+from sklearn.model_selection import GridSearchCV as SkGridSearchCV
+from sklearn.model_selection import StratifiedKFold
+
+import spark_sklearn_tpu as sst
+
+
+@pytest.fixture(scope="module")
+def small_digits():
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32)
+    return X[:600], y[:600]
+
+
+class TestCompiledSampleWeight:
+    def test_logreg_weighted_oracle(self, small_digits):
+        X, y = small_digits
+        rng = np.random.default_rng(0)
+        sw = rng.integers(0, 4, size=len(y)).astype(np.float64)
+        grid = {"C": [0.1, 1.0]}
+        cv = StratifiedKFold(n_splits=3)
+        ours = sst.GridSearchCV(
+            SkLogReg(max_iter=200), grid, cv=cv, backend="tpu")
+        ours.fit(X, y, sample_weight=sw)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGridSearchCV(SkLogReg(max_iter=200), grid, cv=cv)
+        theirs.fit(X, y, sample_weight=sw)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=1e-2)
+
+    def test_ridge_weighted_matches_repeated(self):
+        # the sklearn statistical-equivalence contract, on the compiled
+        # tier: integer weights == repeated rows (f64 closed form)
+        rng = np.random.default_rng(1)
+        n, d = 80, 12
+        X = rng.normal(size=(n, d))
+        y = X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+        sw = rng.integers(1, 4, size=n)
+        Xr = np.repeat(X, sw, axis=0)
+        yr = np.repeat(y, sw)
+        # identical fold structure on both sides: one deterministic split
+        idx = np.arange(n)
+        splits_w = [(idx[: n // 2], idx[n // 2:])]
+        ofs = np.cumsum(np.concatenate([[0], sw]))
+        rep_of = lambda ii: np.concatenate(
+            [np.arange(ofs[i], ofs[i + 1]) for i in ii])
+        splits_r = [(rep_of(idx[: n // 2]), rep_of(idx[n // 2:]))]
+        grid = {"alpha": [0.1, 1.0, 10.0]}
+        gw = sst.GridSearchCV(SkRidge(), grid, cv=splits_w, backend="tpu",
+                              refit=False)
+        gw.fit(X, y, sample_weight=sw.astype(float))
+        gr = sst.GridSearchCV(SkRidge(), grid, cv=splits_r, backend="tpu",
+                              refit=False)
+        gr.fit(Xr, yr)
+        np.testing.assert_allclose(
+            gw.cv_results_["mean_test_score"],
+            gr.cv_results_["mean_test_score"], rtol=1e-7)
+
+    def test_weighted_and_unweighted_differ(self, small_digits):
+        X, y = small_digits
+        sw = np.where(y < 5, 10.0, 0.1)
+        grid = {"C": [1.0]}
+        gw = sst.GridSearchCV(SkLogReg(max_iter=100), grid, cv=3,
+                              backend="tpu", refit=False)
+        gw.fit(X, y, sample_weight=sw)
+        gu = sst.GridSearchCV(SkLogReg(max_iter=100), grid, cv=3,
+                              backend="tpu", refit=False)
+        gu.fit(X, y)
+        assert not np.allclose(gw.cv_results_["mean_test_score"],
+                               gu.cv_results_["mean_test_score"])
+
+    def test_other_fit_params_fall_back_to_host(self, small_digits):
+        X, y = small_digits
+
+        class Est(SkLogReg):
+            def fit(self, X, y, sample_weight=None, extra=None):
+                assert extra == "flag"
+                return super().fit(X, y, sample_weight=sample_weight)
+
+        gs = sst.GridSearchCV(Est(max_iter=50), {"C": [1.0]}, cv=3)
+        gs.fit(X, y, extra="flag")
+        assert gs.search_report["backend"] == "host"
+
+    def test_tpu_backend_rejects_other_fit_params(self, small_digits):
+        X, y = small_digits
+        gs = sst.GridSearchCV(SkLogReg(max_iter=50), {"C": [1.0]}, cv=3,
+                              backend="tpu")
+        with pytest.raises(ValueError, match="not supported"):
+            gs.fit(X, y, bogus=np.ones(len(y)))
+
+
+class TestPerScorerWeightFiltering:
+    def test_max_error_scores_unweighted(self):
+        # sklearn forwards sample_weight per scorer: max_error rejects it,
+        # so in a weighted multimetric search it must score unweighted
+        rng = np.random.default_rng(3)
+        n, d = 60, 5
+        X = rng.normal(size=(n, d))
+        y = X @ rng.normal(size=d)
+        sw = rng.uniform(1.0, 5.0, size=n)
+        scoring = {"mse": "neg_mean_squared_error", "me": "neg_max_error"}
+        ours = sst.GridSearchCV(SkRidge(), {"alpha": [1.0]}, cv=3,
+                                scoring=scoring, refit=False, backend="tpu")
+        ours.fit(X, y, sample_weight=sw)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGridSearchCV(SkRidge(), {"alpha": [1.0]}, cv=3,
+                                scoring=scoring, refit=False)
+        theirs.fit(X, y, sample_weight=sw)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_me"],
+            theirs.cv_results_["mean_test_me"], rtol=1e-6)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_mse"],
+            theirs.cv_results_["mean_test_mse"], rtol=1e-6)
+
+
+class TestWeightFingerprint:
+    def test_large_weight_arrays_distinguish_checkpoints(self, tmp_path):
+        # arrays >1000 elements repr-truncate; the fingerprint must hash
+        # bytes, so two weightings differing mid-array get different keys
+        from spark_sklearn_tpu.utils.checkpoint import fingerprint
+        w1 = np.ones(5000)
+        w2 = w1.copy()
+        w2[2500] = 7.0
+        assert fingerprint("fitw", w1) != fingerprint("fitw", w2)
+
+
+class TestRoutingContract:
+    def test_score_rejects_params_without_routing(self, small_digits):
+        X, y = small_digits
+        gs = sst.GridSearchCV(SkLogReg(max_iter=50), {"C": [1.0]},
+                              cv=3).fit(X, y)
+        with pytest.raises(ValueError, match="is only supported if"):
+            gs.score(X, y, metadata=1)
+
+    def test_get_metadata_routing_structure(self):
+        gs = sst.GridSearchCV(SkLogReg(), {"C": [1.0]})
+        router = gs.get_metadata_routing()
+        rep = repr(router)
+        assert "estimator" in rep and "scorer" in rep and "splitter" in rep
+
+    def test_routed_sample_weight_to_scorer(self, small_digits):
+        # with routing enabled, a scorer that requests sample_weight under
+        # an alias receives it (host tier; custom scorer objects are not
+        # compiled families' scorers)
+        X, y = small_digits
+        from sklearn.metrics import accuracy_score, make_scorer
+        seen = {}
+
+        def acc(y_true, y_pred, sample_weight=None):
+            seen["sw"] = sample_weight
+            return accuracy_score(y_true, y_pred,
+                                  sample_weight=sample_weight)
+
+        with sklearn.config_context(enable_metadata_routing=True):
+            scorer = make_scorer(acc).set_score_request(sample_weight="my_w")
+            est = SkLogReg(max_iter=50).set_fit_request(sample_weight=False)
+            gs = sst.GridSearchCV(est, {"C": [1.0]}, cv=3, scoring=scorer,
+                                  refit=False)
+            gs.fit(X, y, my_w=np.ones(len(y)))
+        assert seen["sw"] is not None
+
+    def test_unsupported_sample_weight_scorer_warns(self, small_digits):
+        X, y = small_digits
+
+        def fake_score(y_true, y_pred):
+            return 0.5
+
+        gs = sst.GridSearchCV(SkLogReg(max_iter=50), {"C": [1.0]}, cv=3,
+                              scoring=fake_score, refit=False)
+        with pytest.warns(UserWarning,
+                          match="does not support sample_weight"):
+            gs.fit(X, y, sample_weight=np.ones(len(y)))
+
+    def test_groups_still_split(self, small_digits):
+        from sklearn.model_selection import GroupKFold
+        X, y = small_digits
+        groups = np.arange(len(y)) % 4
+        gs = sst.GridSearchCV(SkLogReg(max_iter=50), {"C": [1.0]},
+                              cv=GroupKFold(n_splits=4), refit=False)
+        gs.fit(X, y, groups=groups)
+        assert gs.n_splits_ == 4
