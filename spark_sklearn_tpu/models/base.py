@@ -127,3 +127,54 @@ def encode_labels(y):
     """Host-side label encoding shared by all classifier families."""
     classes, y_enc = np.unique(y, return_inverse=True)
     return classes, y_enc.astype(np.int32)
+
+
+def class_weight_multiplier(mask, y_enc, meta, class_weight):
+    """Per-sample weight multipliers for `class_weight` (traced).
+
+    mask: (..., n) fold masks (possibly many tasks batched on leading
+    axes); y_enc: (n,) encoded labels.  Returns a same-shape multiplier.
+
+    - dict {label: weight}: fold-independent lookup (host-built table).
+    - "balanced": sklearn's n_train / (n_classes * bincount(y_train)),
+      computed per fold from the mask's support (mask > 0), exactly the
+      train-fold counts compute_class_weight sees on the host path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if class_weight is None:
+        return None
+    k = meta["n_classes"]
+    y1h = jax.nn.one_hot(y_enc, k, dtype=mask.dtype)         # (n, k)
+    if isinstance(class_weight, str):
+        if class_weight != "balanced":
+            raise ValueError(
+                f"class_weight={class_weight!r} is not compiled; use the "
+                "host backend")
+        ind = (mask > 0).astype(mask.dtype)                  # (..., n)
+        cnt = ind @ y1h                                      # (..., k)
+        n_eff = jnp.sum(ind, axis=-1, keepdims=True)         # (..., 1)
+        per_class = n_eff / (k * jnp.maximum(cnt, 1.0))      # (..., k)
+        return per_class @ y1h.T                             # (..., n)
+    if isinstance(class_weight, dict):
+        classes = list(meta["classes"])
+        cw = np.ones(k, np.float64)
+        for label, weight in class_weight.items():
+            hits = [i for i, c in enumerate(classes) if c == label]
+            if not hits:
+                # sklearn raises its own wording on the host path
+                raise ValueError(
+                    f"class_weight key {label!r} is not a class label")
+            cw[hits[0]] = weight
+        arr = jnp.asarray(cw, mask.dtype)
+        return jnp.broadcast_to(arr[y_enc], mask.shape)
+    raise ValueError(
+        f"class_weight={class_weight!r} is not compiled; use the host "
+        "backend")
+
+
+def apply_class_weight(mask, y_enc, meta, class_weight):
+    """mask with `class_weight` multiplied in (identity when None)."""
+    mult = class_weight_multiplier(mask, y_enc, meta, class_weight)
+    return mask if mult is None else mask * mult

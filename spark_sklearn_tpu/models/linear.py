@@ -77,12 +77,9 @@ class LogisticRegressionFamily(Family):
         if penalty not in ("l2", None, "none"):
             raise ValueError(
                 f"penalty={penalty!r} is not compiled; use the host backend")
-        if static.get("class_weight") is not None:
-            # result-changing and not implemented: raising here makes the
-            # search fall back to backend='host' instead of silently
-            # returning unweighted fits
-            raise ValueError(
-                "class_weight is not compiled; use the host backend")
+        from spark_sklearn_tpu.models.base import apply_class_weight
+        train_w = apply_class_weight(
+            train_w, data["y"], meta, static.get("class_weight"))
         l2 = (0.5 / C) if penalty == "l2" else 0.0
 
         if k == 2:
@@ -154,9 +151,9 @@ class LogisticRegressionFamily(Family):
         if penalty not in ("l2", "elasticnet", None, "none"):
             raise ValueError(
                 f"penalty={penalty!r} is not compiled; use the host backend")
-        if static.get("class_weight") is not None:
-            raise ValueError(
-                "class_weight is not compiled; use the host backend")
+        from spark_sklearn_tpu.models.base import apply_class_weight
+        train_w = apply_class_weight(
+            train_w, data["y"], meta, static.get("class_weight"))
         use_fista = penalty == "elasticnet"
         inv_C_raw = 1.0 / C
         inv_C = inv_C_raw if penalty == "l2" else jnp.zeros_like(C)

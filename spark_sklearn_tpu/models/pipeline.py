@@ -24,6 +24,11 @@ class PipelineFamily:
     """Instance-level family (duck-typed to the Family protocol) built for a
     concrete sklearn Pipeline."""
 
+    #: sklearn raises on a bare sample_weight to Pipeline.fit (step
+    #: routing requires "step__sample_weight"); weighted searches take the
+    #: host path so that contract is reproduced, not silently reinvented
+    accepts_sample_weight = False
+
     def __init__(self, steps: List[Tuple[str, Any]], final_name: str,
                  final_family):
         self.steps = steps              # [(name, StepImpl), ...] transformers
@@ -36,6 +41,15 @@ class PipelineFamily:
             f"{final_name}__{k}": v
             for k, v in final_family.dynamic_params.items()
         }
+        if not final_family.has_per_task_fit() and \
+                getattr(final_family, "task_batched_accepts_fold_inputs",
+                        False):
+            # task-batched-only finals (SVC): compose by feeding per-fold
+            # transformed inputs into the final's task-batched fit
+            self.fit_task_batched = self._fit_task_batched_folds
+            hint = getattr(final_family, "max_tasks_hint", None)
+            if hint is not None:
+                self.max_tasks_hint = hint
         # forward the final step's default scorer (e.g. KMeans -> -inertia)
         # through the transformer chain
         final_default = getattr(final_family, "default_scorer", None)
@@ -49,7 +63,10 @@ class PipelineFamily:
             self.default_scorer = default_scorer
 
     def has_per_task_fit(self) -> bool:
-        return True
+        # task-batched-only finals (SVC) have no per-task fit to compose:
+        # dispatchers that vmap one fit per lane (the keyed fleet) must
+        # take their host path instead of tracing into NotImplementedError
+        return self.final.has_per_task_fit()
 
     # -- host side -------------------------------------------------------
     def extract_params(self, estimator) -> Dict[str, Any]:
@@ -91,7 +108,46 @@ class PipelineFamily:
             {**data, "X": X}, train_w, meta)
         return {"steps": states, "final": final_model}
 
+    def _fit_task_batched_folds(self, dynamic, static, data, w_task, meta):
+        """Task-batched composition: the transformer chain is fitted per
+        FOLD (first candidate's fold masks — tasks are candidate-major
+        with identical fold masks across candidates) and the stacked
+        (F, n, d) result feeds the final family's task-batched fit via
+        data["X_folds"].  The final (SVC) caches full-dataset decisions,
+        so scoring never needs the transformed X back."""
+        import jax
+
+        per_step = self._split_static(static)
+        n_folds = int(static.get("__n_folds__", 0))
+        if n_folds <= 0:
+            raise ValueError("engine must pass __n_folds__")
+        fold_w = w_task[:n_folds]                      # (F, n)
+
+        def tf(w_f):
+            X = data["X"]
+            for sname, step in self.steps:
+                st = step.fit(per_step[sname], X, w_f)
+                X = step.apply(per_step[sname], st, X)
+            return X
+
+        X_folds = jax.vmap(tf)(fold_w)                 # (F, n, d')
+        final_dynamic = {
+            k.split("__", 1)[1]: v for k, v in dynamic.items()
+            if k.startswith(f"{self.final_name}__")
+        }
+        final_static = {**per_step[self.final_name],
+                        "__n_folds__": n_folds,
+                        "__bf16__": static.get("__bf16__", False)}
+        model = self.final.fit_task_batched(
+            final_dynamic, final_static, {**data, "X_folds": X_folds},
+            w_task, meta)
+        # steps=None marks decision-cached mode: _transform is skipped
+        # (the final never consumes X at scoring time)
+        return {"steps": None, "final": model}
+
     def _transform(self, model, static, X):
+        if model["steps"] is None:       # decision-cached task-batched mode
+            return X
         per_step = self._split_static(static)
         for (sname, step), st in zip(self.steps, model["steps"]):
             X = step.apply(per_step[sname], st, X)
@@ -120,9 +176,74 @@ class PipelineFamily:
             model["final"], self._final_static(static), meta)
 
 
-def make_pipeline_family(pipeline) -> Optional[PipelineFamily]:
-    """Pipeline instance -> PipelineFamily, or None when any step is outside
-    the compiled registries (-> Tier B host path runs the pipeline whole)."""
+class BinnedInvariantPipelineFamily:
+    """Pipeline of monotone per-feature scalers feeding a histogram-tree
+    final.  Quantile binning is invariant under strictly monotone
+    per-feature maps, so the scaler steps provably cannot change the
+    binned codes the tree consumes: the compiled fit/score delegate
+    straight to the final family (the transform is the identity on
+    codes), keeping scaler+GBDT/RF grids fully compiled — the TPU-first
+    answer to BASELINE-config-#4/#5-shaped pipelines."""
+
+    accepts_sample_weight = False    # same Pipeline.fit contract as above
+
+    def __init__(self, final_name: str, final_family):
+        self.final_name = final_name
+        self.final = final_family
+        self.name = f"pipeline(binned-invariant+{final_family.name})"
+        self.is_classifier = final_family.is_classifier
+        self.keyed_compatible = False
+        self.dynamic_params = {
+            f"{final_name}__{k}": v
+            for k, v in final_family.dynamic_params.items()
+        }
+
+    def has_per_task_fit(self) -> bool:
+        return True
+
+    def _strip(self, d):
+        pref = f"{self.final_name}__"
+        return {k[len(pref):]: v for k, v in d.items()
+                if k.startswith(pref)}
+
+    def extract_params(self, estimator) -> Dict[str, Any]:
+        out = {}
+        for sname, step_est in estimator.named_steps.items():
+            for k, v in step_est.get_params(deep=False).items():
+                out[f"{sname}__{k}"] = v
+        return out
+
+    def prepare_data(self, X, y, dtype=np.float32):
+        return self.final.prepare_data(X, y, dtype=dtype)
+
+    def observe_candidates(self, candidates, base_params, meta):
+        if hasattr(self.final, "observe_candidates"):
+            self.final.observe_candidates(
+                [self._strip(c) for c in candidates],
+                self._strip(base_params), meta)
+
+    def fit(self, dynamic, static, data, train_w, meta):
+        return self.final.fit(self._strip(dynamic), self._strip(static),
+                              data, train_w, meta)
+
+    def predict(self, model, static, X, meta):
+        return self.final.predict(model, self._strip(static), X, meta)
+
+    def decision(self, model, static, X, meta):
+        return self.final.decision(model, self._strip(static), X, meta)
+
+    def predict_proba(self, model, static, X, meta):
+        return self.final.predict_proba(model, self._strip(static), X,
+                                        meta)
+
+    def sklearn_attrs(self, model, static, meta):
+        return self.final.sklearn_attrs(model, self._strip(static), meta)
+
+
+def make_pipeline_family(pipeline):
+    """Pipeline instance -> a pipeline family, or None when any step is
+    outside the compiled registries (-> Tier B host path runs the pipeline
+    whole)."""
     try:
         steps = list(pipeline.steps)
     except AttributeError:
@@ -139,14 +260,19 @@ def make_pipeline_family(pipeline) -> Optional[PipelineFamily]:
             return None
         resolved.append((sname, step))
     final_family = resolve_family(final_est)
-    if final_family is None or isinstance(final_family, PipelineFamily):
-        return None
-    if not final_family.has_per_task_fit():
-        # families exposing only fit_task_batched (SVC) can't compose with
-        # per-task fold-transformed inputs yet -> whole pipeline to Tier B
+    if final_family is None or isinstance(
+            final_family, (PipelineFamily, BinnedInvariantPipelineFamily)):
         return None
     if not getattr(final_family, "keyed_compatible", True):
-        # tree families consume pre-binned "codes", not the raw "X" the
-        # transformer chain produces -> whole pipeline to Tier B
+        # tree finals consume pre-binned "codes"; they compose only with
+        # monotone per-feature steps, under which the codes are provably
+        # unchanged (anything else -> Tier B)
+        if all(getattr(s, "monotone_per_feature", False)
+               for _, s in resolved):
+            return BinnedInvariantPipelineFamily(final_name, final_family)
+        return None
+    if not final_family.has_per_task_fit() and not getattr(
+            final_family, "task_batched_accepts_fold_inputs", False):
+        # task-batched-only finals must understand per-fold inputs
         return None
     return PipelineFamily(resolved, final_name, final_family)

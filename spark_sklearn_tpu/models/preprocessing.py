@@ -26,6 +26,9 @@ EPS = 1e-12
 class StandardScalerStep:
     name = "standard_scaler"
     dynamic_params: dict = {}
+    #: strictly monotone per-feature map: quantile binning (and therefore
+    #: histogram-tree fits) is provably invariant under this step
+    monotone_per_feature = True
 
     @staticmethod
     def fit(static, X, w):
@@ -51,6 +54,7 @@ class StandardScalerStep:
 class MinMaxScalerStep:
     name = "minmax_scaler"
     dynamic_params: dict = {}
+    monotone_per_feature = True
 
     @staticmethod
     def fit(static, X, w):
@@ -70,6 +74,8 @@ class MinMaxScalerStep:
 class MaxAbsScalerStep:
     name = "maxabs_scaler"
     dynamic_params: dict = {}
+    # |x|-scaling by a positive constant: monotone per feature
+    monotone_per_feature = True
 
     @staticmethod
     def fit(static, X, w):
@@ -86,6 +92,7 @@ class NormalizerStep:
 
     name = "normalizer"
     dynamic_params: dict = {}
+    monotone_per_feature = False   # row-wise, mixes features
 
     @staticmethod
     def fit(static, X, w):
@@ -114,6 +121,7 @@ class PCAStep:
 
     name = "pca"
     dynamic_params: dict = {}
+    monotone_per_feature = False   # rotation, mixes features
 
     @staticmethod
     def fit(static, X, w):

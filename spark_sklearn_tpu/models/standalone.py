@@ -19,7 +19,8 @@ class SVC(ClassifierMixin, BaseEstimator):
     """TPU-native kernel SVM (FISTA dual ascent — models/svm.py)."""
 
     def __init__(self, C=1.0, kernel="rbf", gamma="scale", degree=3,
-                 coef0=0.0, max_iter=-1, tol=1e-3, random_state=None):
+                 coef0=0.0, max_iter=-1, tol=1e-3, class_weight=None,
+                 random_state=None):
         self.C = C
         self.kernel = kernel
         self.gamma = gamma
@@ -27,6 +28,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         self.coef0 = coef0
         self.max_iter = max_iter
         self.tol = tol
+        self.class_weight = class_weight
         self.random_state = random_state
 
     def fit(self, X, y):
@@ -42,10 +44,11 @@ class SVC(ClassifierMixin, BaseEstimator):
         self.n_features_in_ = meta["n_features"]
         self._gamma_val = _resolve_gamma(
             self._static.get("gamma", "scale"), meta)
-        # the fit IS the dual solve; signed alphas are the model (the
-        # representer form d(x) = sum_i alpha_i y_i (K(x_i, x)+1) serves
-        # training AND new data with one kernel matmul)
-        self._alphas = self._solve_alphas()
+        # the fit IS the dual solve; signed alphas + KKT intercepts are
+        # the model (the representer form d(x) = sum_i alpha_i y_i
+        # K(x_i, x) + b serves training AND new data with one kernel
+        # matmul)
+        self._alphas, self._intercepts = self._solve_alphas()
         return self
 
     def _pair_decisions(self, X):
@@ -55,8 +58,9 @@ class SVC(ClassifierMixin, BaseEstimator):
                     jnp.asarray(self._X_train), self._static.get(
                         "kernel", "rbf"), self._gamma_val,
                     float(self._static.get("degree", 3)),
-                    float(self._static.get("coef0", 0.0))) + 1.0
-        return np.asarray(K @ self._alphas.T)        # (n_new, P)
+                    float(self._static.get("coef0", 0.0)))
+        return np.asarray(K @ self._alphas.T) + \
+            self._intercepts[None, :]                # (n_new, P)
 
     def _solve_alphas(self):
         """One dual solve via the family's shared FISTA kernel
@@ -70,7 +74,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         pairs = jnp.asarray(self._meta["pairs"])
         K = _kernel(X, X, self._static.get("kernel", "rbf"),
                     self._gamma_val, float(self._static.get("degree", 3)),
-                    float(self._static.get("coef0", 0.0))) + 1.0
+                    float(self._static.get("coef0", 0.0)))
         ypos = (y[None, :] == pairs[:, 0][:, None])
         yneg = (y[None, :] == pairs[:, 1][:, None])
         yb = ypos.astype(jnp.float32) - yneg.astype(jnp.float32)
@@ -81,9 +85,14 @@ class SVC(ClassifierMixin, BaseEstimator):
         max_iter = int(self._static.get("max_iter", -1))
         if max_iter in (-1, 0):
             max_iter = 300
-        A = fista_dual_ascent(K, yb, box, C,
-                              _power_step(K, n, jnp.float32), max_iter)
-        return np.asarray(A * yb)                     # signed alphas
+        from spark_sklearn_tpu.models.base import class_weight_multiplier
+        cw = class_weight_multiplier(
+            jnp.ones((n,), jnp.float32), jnp.asarray(self._y),
+            self._meta, self._static.get("class_weight"))
+        bound = C * box if cw is None else C * box * cw[None, :]
+        A, b = fista_dual_ascent(K, yb, bound,
+                                 _power_step(K, n, jnp.float32), max_iter)
+        return np.asarray(A * yb), np.asarray(b)      # signed alphas + b
 
     def decision_function(self, X):
         from spark_sklearn_tpu.models.svm import SVCFamily

@@ -3,25 +3,26 @@
 Reference counterpart: sklearn's SVC (libsvm SMO, one C++ working-set solve
 per Spark task; BASELINE config #2 is an SVC(rbf) CxGamma grid on MNIST-10k).
 SMO is a scalar, data-dependent algorithm that cannot map to a systolic
-array, so the TPU redesign solves the same dual QP with **box-projected
+array, so the TPU redesign solves the same dual QP with **projected
 gradient ascent** where every iteration is ONE kernel matmul for all
 (fold x class-pair) subproblems of a candidate at once:
 
-  max_a  1'a - 0.5 a' Q a,   0 <= a_i <= C,   Q = (y y') * (K + 1)
+  max_a  1'a - 0.5 a' Q a,   0 <= a_i <= C,  sum_i y_i a_i = 0,
+  Q = (y y') * K
 
-The +1 on the kernel absorbs the bias term (a standard reformulation that
-removes the equality constraint; the bias is recovered implicitly).  The
-step size 1/lambda_max(K+1) is safe for every masked subproblem because a
-principal submatrix of a PSD matrix cannot have a larger top eigenvalue,
-and the y-sign flip D(K+1)D is a similarity transform.
+This is the true libsvm dual, equality constraint included: each ascent
+step projects onto the box-and-hyperplane set via a vectorized bisection
+(`_project_box_hyperplane`) and the intercept comes from the KKT
+conditions (`_kkt_intercept`, libsvm's -rho).  The step size
+1/lambda_max(K) is safe for every masked subproblem because a principal
+submatrix of a PSD matrix cannot have a larger top eigenvalue, and the
+y-sign flip DKD is a similarity transform.
 
 Multi-class follows sklearn: one-vs-one over all k(k-1)/2 pairs with
 majority voting (confidence-scaled tie-break like _ovr_decision_function).
 
-Deviations from libsvm (documented, tested at the accuracy level):
-  - bias is regularised (absorbed into the kernel) — decision values can
-    differ slightly from libsvm's;
-  - fixed iteration budget instead of SMO's working-set convergence.
+Deviation from libsvm (documented, tested at the accuracy level): a
+fixed iteration budget instead of SMO's working-set convergence.
 """
 
 from __future__ import annotations
@@ -68,12 +69,70 @@ def _power_step(K, n, dtype):
     return 1.0 / (jnp.dot(v, K @ v) + 1e-6)
 
 
-def fista_dual_ascent(K, yb, box, C, step, max_iter):
-    """Nesterov-accelerated box-projected gradient ascent on the SVM dual.
+def _project_box_hyperplane(Z, yb, bound, n_bisect=40):
+    """Euclidean projection of each row of Z onto its subproblem's feasible
+    set {0 <= a_i <= bound_i} intersected with {sum_i y_i a_i = 0}.
 
-    K: (n, n) kernel (+1 bias absorption already applied); yb/box: (M, n)
-    signed labels and box masks for M subproblems advanced together —
-    every iteration is ONE (M, n) @ (n, n) matmul.  Shared by the search's
+    `bound` is per-element (C, class_weight-scaled C, or 0 outside the
+    subproblem's rows).  The projection is clip(z - nu*y, 0, bound) for
+    the nu making the hyperplane constraint hold; g(nu) = sum(y * clip(z
+    - nu*y, 0, bound)) is monotone decreasing, so nu comes from a
+    fixed-count vectorized bisection (cheap elementwise work next to the
+    (M, n) @ (n, n) ascent matmul)."""
+    lo = -(jnp.max(jnp.abs(Z), axis=1) + jnp.max(bound, axis=1))
+    hi = -lo
+
+    def bis(i, lh):
+        lo, hi = lh
+        mid = 0.5 * (lo + hi)
+        a = jnp.clip(Z - mid[:, None] * yb, 0.0, bound)
+        g = jnp.sum(yb * a, axis=1)
+        take_hi = g > 0
+        return jnp.where(take_hi, mid, lo), jnp.where(take_hi, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, n_bisect, bis, (lo, hi))
+    nu = 0.5 * (lo + hi)
+    return jnp.clip(Z - nu[:, None] * yb, 0.0, bound)
+
+
+def _kkt_intercept(K, A, yb, bound):
+    """Per-subproblem intercept b from the KKT conditions (libsvm's -rho):
+    mean of E_i = y_i - f0(x_i) over free SVs; when every alpha sits at a
+    bound, the midpoint of the feasible [max lower, min upper] interval."""
+    V = (A * yb) @ K                                     # (M, n)
+    E = yb - V
+    inb = bound > 0
+    at_lo = A <= bound * 1e-6
+    at_hi = A >= bound * (1.0 - 1e-6)
+    free = inb & ~at_lo & ~at_hi
+    nfree = jnp.sum(free, axis=1)
+    b_free = jnp.sum(jnp.where(free, E, 0.0), axis=1) / \
+        jnp.maximum(nfree, 1)
+    lo_mask = inb & ((at_lo & (yb > 0)) | (at_hi & (yb < 0)))
+    up_mask = inb & ((at_lo & (yb < 0)) | (at_hi & (yb > 0)))
+    big = jnp.asarray(jnp.inf, E.dtype)
+    max_lo = jnp.max(jnp.where(lo_mask, E, -big), axis=1)
+    min_up = jnp.min(jnp.where(up_mask, E, big), axis=1)
+    b_mid = 0.5 * (max_lo + min_up)
+    b_mid = jnp.where(
+        jnp.isfinite(b_mid), b_mid,
+        jnp.where(jnp.isfinite(max_lo), max_lo,
+                  jnp.where(jnp.isfinite(min_up), min_up, 0.0)))
+    return jnp.where(nfree > 0, b_free, b_mid)
+
+
+def fista_dual_ascent(K, yb, bound, step, max_iter):
+    """Nesterov-accelerated projected gradient ascent on the SVM dual
+
+        max_a  1'a - 0.5 a' Q a,   0 <= a_i <= bound_i,
+        sum_i y_i a_i = 0
+
+    (the true libsvm dual, equality constraint included; per-sample upper
+    bounds carry both the subproblem box mask and class_weight-scaled C).
+    K: (n, n) kernel; yb/bound: (M, n) signed labels and box bounds for M
+    subproblems advanced together — every iteration is ONE (M, n) @ (n, n)
+    matmul plus a vectorized hyperplane projection.  Returns (A, b):
+    alphas and the KKT intercept per subproblem.  Shared by the search's
     task-batched fit and the standalone SVC so the numerics live once.
     """
 
@@ -81,15 +140,15 @@ def fista_dual_ascent(K, yb, box, C, step, max_iter):
         A, Z, t = carry
         V = (Z * yb) @ K
         grad = 1.0 - yb * V
-        A_new = jnp.clip(Z + step * grad, 0.0, C) * box
+        A_new = _project_box_hyperplane(Z + step * grad, yb, bound)
         t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
         Z_new = A_new + ((t - 1.0) / t_new) * (A_new - A)
         return A_new, Z_new, t_new
 
-    A0 = jnp.zeros_like(box)
+    A0 = jnp.zeros_like(bound)
     A, _, _ = jax.lax.fori_loop(
         0, max_iter, ascent, (A0, A0, jnp.asarray(1.0, K.dtype)))
-    return A
+    return A, _kkt_intercept(K, A, yb, bound)
 
 
 def _resolve_gamma(gamma, meta):
@@ -107,6 +166,9 @@ class SVCFamily(Family):
     name = "svc"
     is_classifier = True
     dynamic_params = {"C": np.float32, "gamma": np.float32}
+    #: the task-batched fit understands per-fold-transformed inputs
+    #: (data["X_folds"], shape (F, n, d)) — what compiled Pipelines feed it
+    task_batched_accepts_fold_inputs = True
 
     # kernel matrices + per-task decision caches are the memory hot spot;
     # tell the search to keep task batches small
@@ -155,8 +217,6 @@ class SVCFamily(Family):
         kind = static.get("kernel", "rbf")
         if kind == "precomputed":
             raise ValueError("precomputed kernels: use backend='host'")
-        if static.get("class_weight") is not None:
-            raise ValueError("class_weight is not compiled; use host")
         degree = float(static.get("degree", 3))
         coef0 = float(static.get("coef0", 0.0))
         max_iter = int(static.get("max_iter", -1))
@@ -187,15 +247,58 @@ class SVCFamily(Family):
             ybin = -ybin
         in_pair = (ypos | yneg).astype(X.dtype)               # (P, n)
 
+        X_folds = data.get("X_folds")     # (F, n, d) fold-transformed, or
+        # None (plain SVC: one shared X, one kernel per candidate)
+        gamma_is_scale = "gamma" not in dynamic and \
+            static.get("gamma", "scale") == "scale"
+
+        # class_weight scales each sample's box bound: 0 <= a_i <= C * cw_i
+        # (libsvm's per-class C); "balanced" follows each fold's counts
+        from spark_sklearn_tpu.models.base import class_weight_multiplier
+        w_fold_masks = train_w.reshape(nc, n_folds, n)[0]     # (F, n)
+        cw_fold = class_weight_multiplier(
+            w_fold_masks, y, meta, static.get("class_weight"))
+        if cw_fold is None:
+            cw_fold = jnp.ones((n_folds, n), X.dtype)
+
         def one_candidate(carry, inp):
             C_c, g_c, w_f = inp                               # w_f (F, n)
-            K = _kernel(X, X, kind, g_c, degree, coef0) + 1.0  # (n, n)
-            step = _power_step(K, n, X.dtype)
-            # subproblem masks: (F, P, n) -> flatten (F*P, n)
-            box = (w_f[:, None, :] * in_pair[None, :, :]).reshape(-1, n)
-            yb = jnp.broadcast_to(ybin[None], (n_folds, P, n)).reshape(-1, n)
-            A = fista_dual_ascent(K, yb, box, C_c, step, max_iter)
-            dec = ((A * yb) @ K).reshape(n_folds, P, n)       # (F, P, n)
+            if X_folds is None:
+                K = _kernel(X, X, kind, g_c, degree, coef0)   # (n, n)
+                step = _power_step(K, n, X.dtype)
+                # subproblem bounds: (F, P, n) -> flatten (F*P, n)
+                bound = (C_c * (w_f * cw_fold)[:, None, :]
+                         * in_pair[None, :, :]).reshape(-1, n)
+                yb = jnp.broadcast_to(
+                    ybin[None], (n_folds, P, n)).reshape(-1, n)
+                A, b = fista_dual_ascent(K, yb, bound, step, max_iter)
+                dec = ((A * yb) @ K + b[:, None]).reshape(n_folds, P, n)
+            else:
+                # pipeline mode: each fold has its own transformed X, so
+                # kernels are per (candidate, fold); the P pair
+                # subproblems of a fold advance together and folds batch
+                # via vmap (an (F, P, n) x (F, n, n) bmm on the MXU).
+                # gamma='scale' must follow the TRANSFORMED fold X
+                # (sklearn resolves it on the X the final step receives).
+                def per_fold(Xf, w_row, cw_row):
+                    if gamma_is_scale:
+                        mrow = (w_row > 0).astype(Xf.dtype)
+                        cnt = jnp.sum(mrow) * Xf.shape[1] + 1e-12
+                        mu = jnp.sum(Xf * mrow[:, None]) / cnt
+                        var = jnp.sum(((Xf - mu) ** 2)
+                                      * mrow[:, None]) / cnt
+                        g_f = 1.0 / (Xf.shape[1]
+                                     * jnp.maximum(var, 1e-12))
+                    else:
+                        g_f = g_c
+                    Kf = _kernel(Xf, Xf, kind, g_f, degree, coef0)
+                    step = _power_step(Kf, n, Xf.dtype)
+                    bound = C_c * (w_row * cw_row)[None, :] * in_pair
+                    A, b = fista_dual_ascent(
+                        Kf, ybin, bound, step, max_iter)
+                    return (A * ybin) @ Kf + b[:, None]       # (P, n)
+
+                dec = jax.vmap(per_fold)(X_folds, w_f, cw_fold)  # (F,P,n)
             return carry, jnp.transpose(dec, (0, 2, 1))       # (F, n, P)
 
         _, decs = jax.lax.scan(

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from sklearn.base import BaseEstimator, MetaEstimatorMixin, clone, is_classifier
 from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
@@ -104,10 +105,15 @@ def _search_estimator_has(attr):
     return check
 
 
-class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
+from sklearn.callback import CallbackSupportMixin
+
+
+class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
     """Shared engine: candidate generation is the only subclass hook
     (`_get_candidates`), mirroring sklearn's `_run_search` split
-    (_search.py:1708/2109)."""
+    (_search.py:1708/2109).  Callback support follows sklearn's task tree:
+    root -> search -> candidate-split-evaluation leaves, plus a
+    refit-with-best-params task (sklearn callback module contract)."""
 
     def __init__(self, estimator, *, scoring=None, n_jobs=None, refit=True,
                  cv=None, verbose=0, error_score=np.nan,
@@ -138,12 +144,22 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     def _get_candidates(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
 
-    def _run_search(self, evaluate_candidates):
+    def _run_search(self, evaluate_candidates, *, callback_ctx=None):
         """sklearn's extension point (_search.py:1040-1134): subclasses may
         call `evaluate_candidates` any number of times with any candidate
         batches (e.g. successive-halving-style searches); each call returns
         `cv_results_`-shaped results for everything evaluated so far."""
-        evaluate_candidates(self._get_candidates())
+        candidates = self._get_candidates()
+        if callback_ctx is None:
+            evaluate_candidates(candidates)
+            return
+        search_ctx = callback_ctx.subcontext(
+            task_name="search",
+            max_subtasks=len(candidates) * self.n_splits_,
+            sequential_subtasks=False,
+        ).call_on_fit_task_begin(estimator=self)
+        evaluate_candidates(candidates, callback_ctx=search_ctx)
+        search_ctx.call_on_fit_task_end(estimator=self)
 
     # -- sklearn plumbing -----------------------------------------------
     def _check_refit_for_multimetric(self, scorer_names):
@@ -242,6 +258,14 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         return router
 
     def fit(self, X, y=None, **params):
+        # teardown of attached callbacks is guaranteed even when fit
+        # raises (sklearn wraps fit the same way via _fit_context)
+        from sklearn.callback._callback_support import (
+            callback_management_context)
+        with callback_management_context(self):
+            return self._fit_impl(X, y, params)
+
+    def _fit_impl(self, X, y, params):
         estimator = self.estimator
         if self.scoring is None and not hasattr(estimator, "score"):
             # sklearn validates this before any work (BaseSearchCV.fit)
@@ -269,6 +293,14 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
         params = _check_method_params(X, params=params)
         routed_params = self._get_routed_params_for_fit(params)
 
+        sw_meta = params.get("sample_weight")
+        metadata_callbacks = ({"sample_weight": sw_meta}
+                              if sw_meta is not None else None)
+        root_callback_ctx = self._init_callback_context(
+            max_subtasks=1 + (self.refit is not False)
+        ).call_on_fit_task_begin(
+            estimator=self, X=X, y=y, metadata=metadata_callbacks)
+
         splits = list(cv.split(X_arr, y, **routed_params.splitter.split))
         self.n_splits_ = len(splits)
         if hasattr(cv, "get_n_splits"):
@@ -295,6 +327,20 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
              if k != "sample_weight" and v is not None}
             | {k for k, v in score_params.items()
                if k != "sample_weight" and v is not None})
+        if use_compiled and fit_weight is not None and \
+                getattr(estimator, "class_weight", None) == "balanced" \
+                and np.any(np.asarray(fit_weight) == 0):
+            # sklearn's balanced counts are unweighted bincounts over ALL
+            # train-fold rows; the compiled tier derives them from the
+            # weighted mask's support, which drops zero-weight rows ->
+            # reproduce sklearn on the host instead
+            unsupported_compiled = unsupported_compiled | {"sample_weight"}
+        if use_compiled and fit_weight is not None and not getattr(
+                family, "accepts_sample_weight", True):
+            # e.g. Pipelines: sklearn raises on a bare sample_weight (step
+            # routing wants "step__sample_weight") — the host path
+            # reproduces that contract
+            unsupported_compiled = unsupported_compiled | {"sample_weight"}
         if use_compiled and unsupported_compiled:
             if self.backend == "tpu":
                 raise ValueError(
@@ -318,7 +364,7 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
 
         state = {"use_compiled": use_compiled}
 
-        def _dispatch(cands):
+        def _dispatch(cands, eval_ctxs):
             if self.n_splits_ == 0:
                 raise ValueError(
                     "No fits were performed. "
@@ -328,7 +374,8 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 try:
                     return self._fit_compiled(
                         family, X_arr, y, cands, splits,
-                        fit_weight=fit_weight, score_weight=score_weight)
+                        fit_weight=fit_weight, score_weight=score_weight,
+                        eval_ctxs=eval_ctxs)
                 except Exception as exc:  # unsupported static combo etc.
                     if self.backend == "tpu":
                         raise
@@ -340,9 +387,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             # sklearn estimators may validate its exact type); only the
             # compiled path needs the dense array form
             return self._fit_host(X, y, cands, splits, est_fit_params,
-                                  score_params)
+                                  score_params, eval_ctxs)
 
-        def evaluate_candidates(candidate_params):
+        def evaluate_candidates(candidate_params, callback_ctx=None):
             cands = list(candidate_params)
             if self.verbose > 0:
                 print(f"Fitting {self.n_splits_} folds for each of "
@@ -355,8 +402,21 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                         "Was the CV iterator empty? "
                         "Were there no candidates?")
                 return acc["results"]
+            # one leaf context per (candidate, split) pair, candidate-major
+            # like the task list (sklearn: "candidate-split-evaluation").
+            # Only allocated when callbacks are attached: a 10k-candidate
+            # grid must not build 50k context objects for nobody.
+            if callback_ctx is not None and \
+                    getattr(self, "_skl_callbacks", None):
+                eval_ctxs = [
+                    callback_ctx.subcontext(
+                        task_name="candidate-split-evaluation",
+                        task_id=tid)
+                    for tid in range(len(cands) * self.n_splits_)]
+            else:
+                eval_ctxs = None
             (test_scores, train_scores, fit_times, score_times,
-             scorer_names, scorer_attr) = _dispatch(cands)
+             scorer_names, scorer_attr) = _dispatch(cands, eval_ctxs)
             if acc["names"] is None:
                 acc["names"] = scorer_names
                 acc["test"] = {s: [] for s in scorer_names}
@@ -383,7 +443,13 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 np.concatenate(acc["score_t"]), acc["names"])
             return acc["results"]
 
-        self._run_search(evaluate_candidates)
+        from inspect import signature as _signature
+        if "callback_ctx" in _signature(self._run_search).parameters:
+            self._run_search(evaluate_candidates,
+                             callback_ctx=root_callback_ctx)
+        else:
+            # custom subclasses predating the callback API
+            self._run_search(evaluate_candidates)
 
         if not acc["params"]:
             raise ValueError(
@@ -420,16 +486,28 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             # fitted in place (sklearn _search.py:1166)
             self.best_estimator_ = clone(estimator).set_params(
                 **clone(self.best_params_, safe=False))
+            refit_subctx = root_callback_ctx.subcontext(
+                task_name="refit-with-best-params")
             t0 = time.perf_counter()
-            if y is not None:
-                self.best_estimator_.fit(X, y, **routed_params.estimator.fit)
-            else:
-                self.best_estimator_.fit(X, **routed_params.estimator.fit)
+            with refit_subctx.propagate_callback_context(
+                    self.best_estimator_):
+                refit_subctx.call_on_fit_task_begin(
+                    estimator=self, X=X, y=y, metadata=metadata_callbacks)
+                if y is not None:
+                    self.best_estimator_.fit(
+                        X, y, **routed_params.estimator.fit)
+                else:
+                    self.best_estimator_.fit(
+                        X, **routed_params.estimator.fit)
             self.refit_time_ = time.perf_counter() - t0
+            refit_subctx.call_on_fit_task_end(
+                estimator=self, X=X, y=y, metadata=metadata_callbacks)
             if hasattr(self.best_estimator_, "classes_"):
                 self.classes_ = self.best_estimator_.classes_
         if hasattr(X_arr, "shape") and len(getattr(X_arr, "shape", ())) == 2:
             self.n_features_in_ = X_arr.shape[1]
+        root_callback_ctx.call_on_fit_task_end(
+            estimator=self, X=X, y=y, metadata=metadata_callbacks)
         return self
 
     @staticmethod
@@ -476,8 +554,44 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     # Tier A: compiled path
     # ------------------------------------------------------------------
     def _fit_compiled(self, family, X, y, candidates, splits,
-                      fit_weight=None, score_weight=None):
+                      fit_weight=None, score_weight=None, eval_ctxs=None):
         config = self.config or TpuConfig()
+        if fit_weight is not None and \
+                np.any(np.asarray(fit_weight) == 0):
+            # 'balanced' may also arrive via the grid itself, not just the
+            # estimator (the _fit_impl guard covers only the latter); the
+            # compiled balanced counts come from the weighted mask's
+            # support, which drops zero-weight rows sklearn would count
+            if any(v == "balanced" for c in candidates for k, v in c.items()
+                   if k == "class_weight" or k.endswith("__class_weight")):
+                raise ValueError(
+                    "class_weight='balanced' with zero-valued sample "
+                    "weights is not compiled; use backend='host'")
+        out = self._fit_compiled_dispatch(
+            family, X, y, candidates, splits, config,
+            fit_weight=fit_weight, score_weight=score_weight)
+        # compiled tasks execute fused inside XLA programs, so per-task
+        # hooks fire host-side AFTER the sweep succeeds (begin/end per
+        # task, completion-report style — live per-task progress does not
+        # exist under fusion).  Firing post-hoc also means a compiled
+        # failure that falls back to the host path has fired nothing, so
+        # the host tier's _fit_and_score hooks are the only ones seen.
+        # X/y passed to hooks are the full replicated arrays — fold
+        # slicing exists only as masks on the device.
+        if eval_ctxs is not None and getattr(self, "_skl_callbacks", None):
+            n_folds = len(splits)
+            for t, ctx in enumerate(eval_ctxs):
+                train_idx = splits[t % n_folds][0]
+                md = ({"sample_weight": np.asarray(fit_weight)[train_idx]}
+                      if fit_weight is not None else None)
+                ctx.call_on_fit_task_begin(
+                    estimator=self, X=X, y=y, metadata=md)
+                ctx.call_on_fit_task_end(
+                    estimator=self, X=X, y=y, metadata=md)
+        return out
+
+    def _fit_compiled_dispatch(self, family, X, y, candidates, splits,
+                               config, fit_weight=None, score_weight=None):
         # closed-form linear-algebra families (ridge-type normal equations)
         # amplify f32 rounding through the Gram conditioning to ~1e-4 —
         # far from sklearn's f64 answers.  They advertise wants_float64 and
@@ -661,6 +775,11 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                          for s in scorer_names} if return_train else None)
         fit_times = np.empty((n_cand, n_folds))
         score_times = np.empty((n_cand, n_folds))
+        # per-(candidate, fold) fit-failure flags: a compiled fit that
+        # diverges to NaN parameters is a failed fit and gets error_score,
+        # exactly like a raising est.fit on the host path (SURVEY §5.3:
+        # "error_score must be reimplemented explicitly")
+        fit_failed = np.zeros((n_cand, n_folds), bool)
 
         ckpt = None
         if config.checkpoint_dir:
@@ -736,42 +855,46 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     max_cand_per_batch=max_cand_per_batch, n_folds=n_folds,
                     dtype=dtype, return_train=return_train,
                     test_scores=test_scores, train_scores=train_scores,
-                    fit_times=fit_times, score_times=score_times, ckpt=ckpt)
+                    fit_times=fit_times, score_times=score_times, ckpt=ckpt,
+                    fit_failed=fit_failed)
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
 
-        # a NaN hyperparameter is a failed fit (sklearn raises at
-        # validation; our solvers are too robust to blow up, so the chance-
-        # level score they produce must not masquerade as a result).  inf
-        # stays legal — sklearn itself uses C=np.inf for "no penalty".
-        # Genuinely non-finite SCORES pass through untouched, like
-        # sklearn's (error_score only covers fit failures; _format_results
-        # warns about non-finite score columns).
-        bad_cand = np.zeros(n_cand, bool)
+        # failed-fit accounting, sklearn error_score semantics
+        # (_warn_or_raise_about_fit_failures): two detectors feed it —
+        #   1. NaN hyperparameters (sklearn raises at validation; our
+        #      solvers won't blow up, so the chance-level score they
+        #      produce must not masquerade as a result).  inf stays legal —
+        #      sklearn itself uses C=np.inf for "no penalty".
+        #   2. per-(candidate, fold) NaN model parameters detected on
+        #      device after each launch (_run_groups): a diverging MLP or
+        #      an ill-conditioned solve is a failed fit, not a result.
+        # Genuinely non-finite SCORES from finite models pass through,
+        # like sklearn's (_format_results warns about those separately).
         for group in groups:
             for arr in group.dynamic_params.values():
                 if np.issubdtype(arr.dtype, np.floating):
-                    bad_cand[group.candidate_indices[
-                        np.isnan(arr)]] = True
-        if bad_cand.any():
-            n_bad = int(bad_cand.sum()) * n_folds
+                    fit_failed[group.candidate_indices[
+                        np.isnan(arr)], :] = True
+        if fit_failed.any():
+            n_bad = int(fit_failed.sum())
             if isinstance(self.error_score, str) and \
                     self.error_score == "raise":
                 raise ValueError(
-                    f"{n_bad} fits produced non-finite scores and "
+                    f"{n_bad} fits failed with non-finite parameters and "
                     "error_score='raise'")
             from sklearn.exceptions import FitFailedWarning
             warnings.warn(
                 f"\n{n_bad} fits failed out of a total of "
                 f"{n_cand * n_folds}.\nThe score on these train-test "
                 "partitions for these parameters will be set to "
-                f"{self.error_score}. (cause: non-finite "
-                "hyperparameters)", FitFailedWarning)
+                f"{self.error_score}. (cause: non-finite model "
+                "parameters or hyperparameters)", FitFailedWarning)
             for s in scorer_names:
-                test_scores[s][bad_cand, :] = self.error_score
+                test_scores[s][fit_failed] = self.error_score
                 if return_train:
-                    train_scores[s][bad_cand, :] = self.error_score
+                    train_scores[s][fit_failed] = self.error_score
         # scorer_ keeps the sklearn-facing objects so .score() works the
         # sklearn way even though CV scoring ran compiled
         if self.scoring is None or isinstance(self.scoring, str):
@@ -788,8 +911,24 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                     test_unw_dev, train_unw_dev, sw_blind,
                     fit_masks, mesh, config, n_task_shards, task_shard,
                     max_cand_per_batch, n_folds, dtype, return_train,
-                    test_scores, train_scores, fit_times, score_times, ckpt):
+                    test_scores, train_scores, fit_times, score_times, ckpt,
+                    fit_failed):
         task_batched = hasattr(family, "fit_task_batched")
+
+        @jax.jit
+        def health_jit(models):
+            """(nc_batch, n_folds) True where any inexact model leaf went
+            NaN — the compiled-tier analog of est.fit raising.  inf is NOT
+            flagged: families use inf sentinels legitimately (e.g. tree
+            split thresholds)."""
+            bad = None
+            for leaf in jax.tree_util.tree_leaves(models):
+                if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    continue
+                b = jnp.isnan(leaf).any(
+                    axis=tuple(range(2, leaf.ndim)))
+                bad = b if bad is None else (bad | b)
+            return bad
         if config.n_data_shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
             tb_mask_shard = NamedSharding(
@@ -869,6 +1008,9 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                                     rec["train"][s_])
                         fit_times[idx, :] = rec["fit_t"]
                         score_times[idx, :] = rec["score_t"]
+                        if rec.get("failed") is not None:
+                            fit_failed[idx, :] |= np.asarray(
+                                rec["failed"], bool)
                         report["n_chunks_resumed"] += 1
                         continue
                 dyn = {}
@@ -897,6 +1039,11 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                 jax.block_until_ready(models)
                 t_fit = time.perf_counter() - t0
 
+                bad = health_jit(models)
+                if bad is not None:
+                    fit_failed[idx, :] |= np.asarray(
+                        jax.device_get(bad))[:hi - lo]
+
                 t0 = time.perf_counter()
                 te, tr = score_jit(models, data_dev, test_dev, train_sc_dev,
                                    test_unw_dev, train_unw_dev)
@@ -923,13 +1070,14 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
                                    for s in scorer_names}
                                   if return_train else None),
                         "fit_t": t_fit / (nc_batch * n_folds),
-                        "score_t": t_score / (nc_batch * n_folds)})
+                        "score_t": t_score / (nc_batch * n_folds),
+                        "failed": fit_failed[idx, :].tolist()})
 
     # ------------------------------------------------------------------
     # Tier B: host fallback (full sklearn generality)
     # ------------------------------------------------------------------
     def _fit_host(self, X, y, candidates, splits, fit_params,
-                  score_params=None):
+                  score_params=None, eval_ctxs=None):
         from joblib import Parallel, delayed
         from sklearn.metrics import check_scoring
         from sklearn.metrics._scorer import _check_multimetric_scoring
@@ -966,19 +1114,21 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
             "backend": "host", "n_tasks": len(tasks),
             "n_jobs": self.n_jobs if self.n_jobs is not None else 1}
 
-        def run(params, train, test):
+        def run(params, train, test, callback_ctx):
             return _fit_and_score(
                 clone(estimator), X, y, scorer=scorer_for_fs,
                 train=train, test=test, verbose=self.verbose,
                 parameters=params, fit_params=fit_params or None,
                 score_params=score_params or None,
                 return_train_score=self.return_train_score,
-                return_times=True, error_score=self.error_score)
+                return_times=True, error_score=self.error_score,
+                caller=self, callback_ctx=callback_ctx)
 
+        ctxs = eval_ctxs if eval_ctxs is not None else [None] * len(tasks)
         n_jobs = self.n_jobs if self.n_jobs is not None else 1
         results = Parallel(n_jobs=n_jobs)(
-            delayed(run)(params, train, test)
-            for _, _, params, train, test in tasks)
+            delayed(run)(params, train, test, ctx)
+            for (_, _, params, train, test), ctx in zip(tasks, ctxs))
 
         # sklearn's own failure accounting: FitFailedWarning with the
         # "n fits failed out of a total of m" format, ValueError when all
@@ -1130,6 +1280,20 @@ class BaseSearchTPU(MetaEstimatorMixin, BaseEstimator):
     def inverse_transform(self, X):
         check_is_fitted(self)
         return self.best_estimator_.inverse_transform(X)
+
+    def _sk_visual_block_(self):
+        # sklearn's diagram repr (_search.py _sk_visual_block_): fitted
+        # searches display the refit best_estimator_, unfitted ones the
+        # wrapped estimator
+        from sklearn.utils._repr_html.estimator import _VisualBlock
+        if hasattr(self, "best_estimator_"):
+            key, estimator = "best_estimator_", self.best_estimator_
+        else:
+            key, estimator = "estimator", self.estimator
+        return _VisualBlock(
+            "parallel", [estimator],
+            names=[f"{key}: {estimator.__class__.__name__}"],
+            name_details=[str(estimator)])
 
     def __sklearn_tags__(self):
         # full tag delegation to the wrapped estimator, like sklearn's

@@ -257,15 +257,54 @@ class TestFamilyResolution:
 
         assert resolve_family(LogisticRegression()) is None
 
-    def test_class_weight_falls_back_to_host(self, digits):
-        """Regression (review #3): class_weight must not be silently
-        dropped by the compiled family."""
+    def test_class_weight_balanced_compiled_oracle(self, digits):
+        """class_weight='balanced' stays compiled and matches sklearn."""
+        from sklearn.model_selection import GridSearchCV as SkGS
         X, y = digits
-        with pytest.warns(UserWarning, match="falling back"):
-            gs = sst.GridSearchCV(
-                SkLogReg(max_iter=100, class_weight="balanced"),
-                {"C": [1.0]}, cv=3).fit(X, y)
-        assert gs.best_score_ > 0.9
+        # imbalance the classes so balanced weighting actually matters
+        keep = np.flatnonzero((y < 3) & (np.arange(len(y)) % (y + 1) == 0))
+        Xs, ys = X[keep], y[keep]
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=200, class_weight="balanced"),
+            {"C": [0.5, 2.0]}, cv=3, backend="tpu").fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(SkLogReg(max_iter=200, class_weight="balanced"),
+                  {"C": [0.5, 2.0]}, cv=3).fit(Xs, ys)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=1e-2)
+
+    def test_class_weight_dict_compiled_oracle(self, digits):
+        from sklearn.model_selection import GridSearchCV as SkGS
+        X, y = digits
+        mask = y < 2
+        Xs, ys = X[mask], y[mask]
+        cw = {0: 3.0, 1: 0.5}
+        gs = sst.GridSearchCV(
+            SkLogReg(max_iter=200, class_weight=cw),
+            {"C": [1.0]}, cv=3, backend="tpu").fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(SkLogReg(max_iter=200, class_weight=cw),
+                  {"C": [1.0]}, cv=3).fit(Xs, ys)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=1e-2)
+
+    def test_svc_class_weight_compiled_oracle(self, digits):
+        from sklearn.model_selection import GridSearchCV as SkGS
+        from sklearn.svm import SVC as SkSVC
+        X, y = digits
+        mask = y < 3
+        Xs, ys = X[mask][:350], y[mask][:350]
+        gs = sst.GridSearchCV(
+            SkSVC(class_weight="balanced"), {"C": [1.0, 4.0]}, cv=3,
+            backend="tpu").fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(SkSVC(class_weight="balanced"),
+                  {"C": [1.0, 4.0]}, cv=3).fit(Xs, ys)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=2e-2)
 
 
 class TestReviewRegressions:

@@ -43,6 +43,36 @@ class TestMLP:
             {"alpha": [1e-4]}, cv=3, backend="host").fit(X, y)
         assert abs(ours.best_score_ - theirs.best_score_) < 0.05
 
+    def test_diverging_candidate_gets_error_score(self, digits):
+        # a lr=1e6 MLP fit diverges to NaN weights on the device; that is
+        # a FAILED fit (error_score + FitFailedWarning), not a recorded
+        # garbage score — sklearn error_score semantics, compiled tier
+        # (sklearn parity note: with solver='adam' the lr=1e6 fit stays
+        # FINITE in sklearn too and records a chance-level score — only
+        # the sgd path genuinely overflows to NaN on both sides)
+        from sklearn.exceptions import FitFailedWarning
+        X, y = digits
+        gs = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(16,), max_iter=15,
+                          random_state=0, solver="sgd"),
+            {"learning_rate_init": [1e-3, 1e6]}, cv=3, backend="tpu",
+            error_score=-7.0, refit=False)
+        with pytest.warns(FitFailedWarning, match="fits failed"):
+            gs.fit(X, y)
+        scores = gs.cv_results_["mean_test_score"]
+        assert np.isfinite(scores[0]) and scores[0] != -7.0  # sane cand
+        assert scores[1] == -7.0        # diverged candidate masked
+
+    def test_diverging_candidate_error_score_raise(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(16,), max_iter=15,
+                          random_state=0, solver="sgd"),
+            {"learning_rate_init": [1e6]}, cv=3, backend="tpu",
+            error_score="raise", refit=False)
+        with pytest.raises(ValueError, match="non-finite"):
+            gs.fit(X, y)
+
     def test_mlp_binary_roc_auc_compiled(self, digits):
         # binary decision must be a 1-D margin so roc_auc traces; the full
         # (n, 2) logits used to crash the compiled scorer at trace time
@@ -94,6 +124,77 @@ class TestPipeline:
             ours.cv_results_["mean_test_score"],
             theirs.cv_results_["mean_test_score"], atol=7e-3)
         assert ours.best_params_ == theirs.best_params_
+
+    def test_pipeline_svc_grid_oracle(self, digits):
+        """Config #2 shape with a scaler: Pipeline(StandardScaler, SVC)
+        stays compiled (task-batched per-fold transform composition)."""
+        from sklearn.model_selection import GridSearchCV as SkGS
+        from sklearn.svm import SVC as SkSVC
+        X, y = digits
+        X, y = X[:500], y[:500]
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("clf", SkSVC())])
+        grid = {"clf__C": [0.5, 2.0], "clf__gamma": [0.01, 0.05]}
+        ours = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(pipe, grid, cv=3).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=2e-2)
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_pipeline_svc_gamma_scale_oracle(self, digits):
+        # gamma='scale' must resolve against the TRANSFORMED per-fold X
+        from sklearn.model_selection import GridSearchCV as SkGS
+        from sklearn.svm import SVC as SkSVC
+        X, y = digits
+        X, y = X[:400], y[:400]
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("clf", SkSVC(gamma="scale"))])
+        grid = {"clf__C": [1.0, 4.0]}
+        ours = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
+        theirs = SkGS(pipe, grid, cv=3).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=2e-2)
+
+    def test_pipeline_gbdt_binned_invariant_oracle(self, digits):
+        """Scaler+GBDT compiles via binning invariance (monotone
+        per-feature steps cannot change quantile codes)."""
+        from sklearn.ensemble import GradientBoostingClassifier as SkGBC
+        from sklearn.model_selection import GridSearchCV as SkGS
+        X, y = digits
+        mask = y < 3
+        X, y = X[mask][:300], y[mask][:300]
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("clf", SkGBC(n_estimators=20, max_depth=2,
+                                       random_state=0))])
+        grid = {"clf__learning_rate": [0.1, 0.3]}
+        ours = sst.GridSearchCV(pipe, grid, cv=3, backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(pipe, grid, cv=3).fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=5e-2)
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_pipeline_pca_gbdt_falls_back(self, digits):
+        # PCA mixes features: binning invariance does not hold -> host
+        from sklearn.decomposition import PCA
+        from sklearn.ensemble import GradientBoostingClassifier as SkGBC
+        pipe = Pipeline([("pca", PCA(n_components=8)),
+                         ("clf", SkGBC(n_estimators=5))])
+        assert resolve_family(pipe) is None
+
+    def test_pipeline_sample_weight_goes_host(self, digits):
+        # sklearn raises on bare sample_weight to Pipeline.fit; the host
+        # path reproduces that contract instead of silently weighting
+        X, y = digits
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("clf", SkLogReg(max_iter=50))])
+        gs = sst.GridSearchCV(pipe, {"clf__C": [1.0]}, cv=3, backend="tpu")
+        with pytest.raises(ValueError, match="not supported"):
+            gs.fit(X, y, sample_weight=np.ones(len(y)))
 
     def test_pipeline_mlp_grid(self, digits):
         X, y = digits

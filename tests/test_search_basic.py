@@ -282,16 +282,24 @@ class TestMoreOracles:
                 SkLogReg(max_iter=50), {"C": [float("nan")]}, cv=3,
                 backend="tpu", error_score="raise", refit=False).fit(X, y)
 
-    def test_pipeline_with_tree_final_goes_host(self, digits):
-        """Pipeline ending in a tree family must skip the compiled path
-        up front (data-contract mismatch)."""
+    def test_pipeline_with_tree_final_resolution(self, digits):
+        """Pipelines ending in a tree family compile iff every transformer
+        is monotone per-feature (quantile binning is invariant under
+        those, so the codes the tree consumes are provably unchanged)."""
+        from sklearn.decomposition import PCA
         from sklearn.ensemble import GradientBoostingClassifier
         from sklearn.pipeline import Pipeline
         from sklearn.preprocessing import StandardScaler
         from spark_sklearn_tpu.models.base import resolve_family
+        from spark_sklearn_tpu.models.pipeline import (
+            BinnedInvariantPipelineFamily)
         pipe = Pipeline([("s", StandardScaler()),
                          ("g", GradientBoostingClassifier())])
-        assert resolve_family(pipe) is None
+        assert isinstance(resolve_family(pipe),
+                          BinnedInvariantPipelineFamily)
+        mixed = Pipeline([("p", PCA(n_components=5)),
+                          ("g", GradientBoostingClassifier())])
+        assert resolve_family(mixed) is None
 
     def test_bf16_matmul_score_parity(self, digits):
         """bf16 MXU matmuls must stay within a small tolerance of fp32."""
