@@ -97,49 +97,6 @@ class KeyedEstimator(BaseEstimator):
         if missing:
             raise KeyError(f"DataFrame is missing columns: {missing}")
 
-        fleet = None
-        if self.estimatorType in ("predictor", "clusterer"):
-            fleet = self._try_fit_compiled(df)
-        if fleet is not None:
-            return fleet
-
-        models: Dict[tuple, Any] = {}
-        for key, pdf in df.groupby(self.keyCols, sort=True):
-            if not isinstance(key, tuple):
-                key = (key,)
-            X = _stack_x(pdf[self.xCol])
-            est = clone(self.sklearnEstimator)
-            if self.yCol is not None:
-                est.fit(X, np.asarray(pdf[self.yCol]))
-            else:
-                est.fit(X)
-            models[key] = est
-        return KeyedModel(
-            keyCols=self.keyCols, xCol=self.xCol, yCol=self.yCol,
-            outputCol=self.outputCol,
-            estimatorType=self.estimatorType, models=models)
-
-    def _try_fit_compiled(self, df) -> Optional["KeyedModel"]:
-        """The TPU-native per-key fleet: keys become ONE vmap axis.
-
-        Groups are padded to the longest group with zero sample weights
-        (same fixed-shape trick as CV fold masks), every key's estimator is
-        fitted by one jitted vmapped program, and the fleet lives as a
-        stacked parameter pytree with a leading key axis — replacing the
-        reference's pickled-estimator-per-row DataFrame column (reference:
-        keyed_models.py stores cloudpickled sklearn models; SURVEY §3.2).
-        Returns None when the estimator has no compiled family (-> host
-        loop, full sklearn generality).
-        """
-        from spark_sklearn_tpu.models.base import resolve_family
-
-        family = resolve_family(self.sklearnEstimator)
-        if family is None or not family.has_per_task_fit() or \
-                not getattr(family, "keyed_compatible", True):
-            return None
-        import jax
-        import jax.numpy as jnp
-
         work = df.reset_index(drop=True)   # positional index for gathers
         keys, slices = [], []
         for key, pdf in work.groupby(self.keyCols, sort=True):
@@ -147,83 +104,247 @@ class KeyedEstimator(BaseEstimator):
                 key = (key,)
             keys.append(key)
             slices.append(pdf)
-        G = len(keys)
-        L = max(len(p) for p in slices)
+
+        if self.estimatorType == "transformer":
+            fleet, host_pairs = self._fit_transformer_fleet(
+                work, keys, slices)
+        else:
+            fleet, host_pairs = self._fit_family_fleet(work, keys, slices)
+
+        models: Optional[Dict[tuple, Any]] = None
+        if host_pairs:
+            models = {}
+            for key, pdf in host_pairs:
+                X = _stack_x(pdf[self.xCol])
+                est = clone(self.sklearnEstimator)
+                if self.yCol is not None:
+                    est.fit(X, np.asarray(pdf[self.yCol]))
+                else:
+                    est.fit(X)
+                models[key] = est
+        return KeyedModel(
+            keyCols=self.keyCols, xCol=self.xCol, yCol=self.yCol,
+            outputCol=self.outputCol,
+            estimatorType=self.estimatorType, models=models, fleet=fleet)
+
+    @staticmethod
+    def _bucket_len(m: int, floor: int = 8) -> int:
+        """Pad length for a group of m rows: next power of two (>= floor).
+
+        Bucketed padding bounds the waste at 2x per group, so one huge key
+        among thousands of small ones costs O(G_small * L_small + L_big)
+        memory instead of the O(G * L_max) a single global pad would
+        (SURVEY §3.2 redesign note; the round-1 fleet padded globally).
+        """
+        L = floor
+        while L < m:
+            L *= 2
+        return L
+
+    def _fit_family_fleet(self, work, keys, slices):
+        """The TPU-native per-key fleet: keys become vmap axes.
+
+        Groups are padded to per-bucket maxima with zero sample weights
+        (same fixed-shape trick as CV fold masks), each bucket's keys are
+        fitted by one jitted vmapped program, and the fleet lives as ONE
+        stacked parameter pytree with a leading key axis (bucket results
+        are concatenated — model shapes depend on d/k, never on group
+        length) — replacing the reference's pickled-estimator-per-row
+        DataFrame column (reference: keyed_models.py stores cloudpickled
+        sklearn models; SURVEY §3.2).
+
+        Returns (fleet | None, host_pairs): keys the compiled path cannot
+        serve — no compiled family, too few rows for the estimator, or a
+        classifier key lacking some of the global classes (per-key
+        classes_ semantics) — are returned for per-key host fits instead
+        of failing the whole fleet to the host loop.
+        """
+        from spark_sklearn_tpu.models.base import resolve_family
+
+        pairs = list(zip(keys, slices))
+        if not pairs:
+            return None, pairs
+        family = resolve_family(self.sklearnEstimator)
+        if family is None or not family.has_per_task_fit() or \
+                not getattr(family, "keyed_compatible", True):
+            return None, pairs
 
         X_all = _stack_x(work[self.xCol]).astype(np.float32)
-        static_probe = family.extract_params(self.sklearnEstimator)
-        min_needed = (family.min_group_size(static_probe)
-                      if hasattr(family, "min_group_size") else 1)
-        if min(len(p) for p in slices) < min_needed:
-            # some key has too few rows for this estimator (e.g. fewer
-            # samples than n_clusters) — host loop raises per key the way
-            # sklearn would
-            return None
-        d = X_all.shape[1]
         unsupervised = self.yCol is None
         y_all = None if unsupervised else np.asarray(work[self.yCol])
         try:
             _, meta = family.prepare_data(X_all, y_all)
         except Exception:
-            return None
+            return None, pairs
         static = family.extract_params(self.sklearnEstimator)
+        min_needed = (family.min_group_size(static)
+                      if hasattr(family, "min_group_size") else 1)
 
         if unsupervised:
-            enc = np.zeros(len(work), np.float64)
+            enc = None   # no targets: _fit_bucketed uses 2-arg fit_one
         elif family.is_classifier:
             lookup = {v: i for i, v in enumerate(meta["classes"])}
             enc = np.array([lookup[v] for v in y_all], np.float64)
-            # per-key classes_ semantics: a key whose group lacks some of
-            # the global classes must be fitted over its OWN label set (the
-            # host loop does that); the stacked fleet label-encodes
-            # globally, so it only applies when every key saw every class
-            for pdf in slices:
-                if len(set(enc[pdf.index.to_numpy()])) < meta["n_classes"]:
-                    return None
         else:
             enc = np.asarray(y_all, np.float64)
-        Xs = np.zeros((G, L, d), np.float32)
-        ys = np.zeros((G, L), np.float64)
-        ws = np.zeros((G, L), np.float32)
-        for i, pdf in enumerate(slices):
-            m = len(pdf)
-            pos = pdf.index.to_numpy()
-            Xs[i, :m] = X_all[pos]
-            ys[i, :m] = enc[pos]
-            ws[i, :m] = 1.0
 
-        def fit_one(Xg, yg, wg):
-            if unsupervised:
-                data_g = {"X": Xg}
-            elif family.is_classifier:
-                k = meta["n_classes"]
-                data_g = {"X": Xg, "y": yg.astype(jnp.int32),
-                          "y1h": jax.nn.one_hot(
-                              yg.astype(jnp.int32), k, dtype=Xg.dtype)}
+        eligible, host_pairs = [], []
+        for key, pdf in pairs:
+            if len(pdf) < min_needed:
+                # too few rows for this estimator on the compiled path
+                # (e.g. fewer samples than n_clusters) — host fit raises
+                # per key the way sklearn would
+                host_pairs.append((key, pdf))
+            elif not unsupervised and family.is_classifier and \
+                    len(set(enc[pdf.index.to_numpy()])) < meta["n_classes"]:
+                # per-key classes_ semantics: a key whose group lacks some
+                # of the global classes must be fitted over its OWN label
+                # set, which only the host loop does
+                host_pairs.append((key, pdf))
             else:
-                data_g = {"X": Xg, "y": yg.astype(Xg.dtype)}
-            return family.fit({}, static, data_g, wg, meta)
+                eligible.append((key, pdf))
+        if not eligible:
+            return None, host_pairs
 
-        # ys already holds encoded class indices (classifiers) or raw
-        # targets (regressors) from the fill loop above
-        ys_dev = jnp.asarray(ys, jnp.int32 if family.is_classifier
-                             else jnp.float32)
+        if unsupervised:
+            def fit_one(Xg, wg):
+                return family.fit(
+                    {}, static, family.build_fit_data(Xg, None, meta),
+                    wg, meta)
+        else:
+            def fit_one(Xg, yg, wg):
+                return family.fit(
+                    {}, static, family.build_fit_data(Xg, yg, meta),
+                    wg, meta)
 
+        y_dtype = np.int32 if (not unsupervised and family.is_classifier) \
+            else np.float32
         try:
-            models = jax.jit(jax.vmap(fit_one))(
-                jnp.asarray(Xs), ys_dev, jnp.asarray(ws))
+            fleet_keys, models = self._fit_bucketed(
+                eligible, X_all, enc, y_dtype, fit_one)
         except Exception as exc:
             import warnings
             warnings.warn(
                 f"compiled keyed fleet failed ({exc!r}); falling back to "
                 "per-key host fits", UserWarning)
-            return None
-        return KeyedModel(
-            keyCols=self.keyCols, xCol=self.xCol, yCol=self.yCol,
-            outputCol=self.outputCol, estimatorType=self.estimatorType,
-            models=None, fleet=dict(
-                family=family, models=models, meta=meta, static=static,
-                key_index={k: i for i, k in enumerate(keys)}))
+            return None, host_pairs + eligible
+        return dict(
+            kind="family", family=family, models=models, meta=meta,
+            static=static,
+            key_index={k: i for i, k in enumerate(fleet_keys)}), host_pairs
+
+    def _fit_bucketed(self, eligible, X_all, enc, y_dtype, fit_one):
+        """Shared bucketed-fleet launcher: pad each group to its bucket
+        length, run one jit(vmap(fit_one)) per bucket, concatenate the
+        stacked result pytrees on the key axis.  `fit_one` takes
+        (Xg, yg, wg) when `enc` is given, (Xg, wg) when it is None
+        (transformer steps have no targets).  Returns (keys_in_fleet_order,
+        stacked_models)."""
+        import jax
+        import jax.numpy as jnp
+
+        buckets: Dict[int, list] = {}
+        for key, pdf in eligible:
+            buckets.setdefault(self._bucket_len(len(pdf)), []).append(
+                (key, pdf))
+
+        d = X_all.shape[1]
+        fleet_keys, stacked = [], []
+        for L in sorted(buckets):
+            group = buckets[L]
+            Gb = len(group)
+            Xs = np.zeros((Gb, L, d), np.float32)
+            ws = np.zeros((Gb, L), np.float32)
+            ys = None if enc is None else np.zeros((Gb, L), y_dtype)
+            for i, (_, pdf) in enumerate(group):
+                m = len(pdf)
+                pos = pdf.index.to_numpy()
+                Xs[i, :m] = X_all[pos]
+                ws[i, :m] = 1.0
+                if ys is not None:
+                    ys[i, :m] = enc[pos]
+            args = [jnp.asarray(Xs)]
+            if ys is not None:
+                args.append(jnp.asarray(ys))
+            args.append(jnp.asarray(ws))
+            stacked.append(jax.jit(jax.vmap(fit_one))(*args))
+            fleet_keys.extend(k for k, _ in group)
+        if jax.tree_util.tree_leaves(stacked[0]):
+            models = jax.tree_util.tree_map(
+                lambda *leaves: jnp.concatenate(leaves, axis=0), *stacked)
+        else:
+            models = stacked[0]   # stateless step (e.g. Normalizer)
+        return fleet_keys, models
+
+    def _fit_transformer_fleet(self, work, keys, slices):
+        """Compiled transformer-type fleets: one vmapped weighted-stats fit
+        per bucket over the preprocessing steps (StandardScaler and
+        friends), stored as a stacked state pytree — transform is a gather
+        on the key axis + the step's pure apply."""
+        from spark_sklearn_tpu.models.preprocessing import resolve_step
+
+        pairs = list(zip(keys, slices))
+        if not pairs:
+            return None, pairs
+        step = resolve_step(self.sklearnEstimator)
+        if step is None:
+            return None, pairs
+
+        static = dict(self.sklearnEstimator.get_params(deep=False))
+        X_all = _stack_x(work[self.xCol]).astype(np.float32)
+        if hasattr(step, "check_static"):
+            try:
+                step.check_static(static, X_all.shape[1])
+            except ValueError:
+                # configs the compiled path cannot serve (PCA 'mle'/None
+                # n_components, out-of-range widths) go straight to the
+                # host loop — sklearn raises its own error there if the
+                # config is genuinely invalid; the warning below is
+                # reserved for unexpected fleet failures
+                return None, pairs
+        min_needed = (step.min_group_size(static)
+                      if hasattr(step, "min_group_size") else 1)
+
+        eligible, host_pairs = [], []
+        for key, pdf in pairs:
+            (eligible if len(pdf) >= min_needed else host_pairs).append(
+                (key, pdf))
+        if not eligible:
+            return None, host_pairs
+
+        try:
+            fleet_keys, states = self._fit_bucketed(
+                eligible, X_all, None, None,
+                lambda Xg, wg: step.fit(static, Xg, wg))
+        except Exception as exc:
+            # unsupported static config (e.g. PCA 'mle') -> host loop
+            import warnings
+            warnings.warn(
+                f"compiled keyed transformer fleet failed ({exc!r}); "
+                "falling back to per-key host fits", UserWarning)
+            return None, host_pairs + eligible
+        return dict(
+            kind="step", step=step, models=states, meta={}, static=static,
+            key_index={k: i for i, k in enumerate(fleet_keys)}), host_pairs
+
+
+class TpuTransformer:
+    """A fitted transformer state as its device representation — the
+    transformer-type counterpart of converter.TpuModel, exposed per key by
+    `KeyedModel.keyedModels`."""
+
+    def __init__(self, step, state, static):
+        self.step = step
+        self.state = state
+        self.static = static
+
+    def transform(self, X):
+        import jax.numpy as jnp
+        X = jnp.asarray(np.asarray(X), jnp.float32)
+        return np.asarray(self.step.apply(self.static, self.state, X))
+
+    def __repr__(self):
+        return f"TpuTransformer(step={self.step.name})"
 
 
 class KeyedModel:
@@ -243,28 +364,37 @@ class KeyedModel:
 
     @property
     def backend(self) -> str:
+        """"tpu" (all keys in the compiled fleet), "host" (all keys fitted
+        by the per-key sklearn loop), or "hybrid" (keys the compiled path
+        cannot serve — too small, missing classes — were host-fitted while
+        the rest stayed on the fleet)."""
+        if self.fleet is not None and self.models:
+            return "hybrid"
         return "tpu" if self.fleet is not None else "host"
 
     @property
     def keyedModels(self) -> pd.DataFrame:
         """One row per key with an `estimator` cell that supports
-        `.predict` on BOTH backends (fitted sklearn estimator on the host
-        path, a TpuModel view of the stacked pytree on the fleet path)."""
+        `.predict`/`.transform` on BOTH backends (fitted sklearn estimator
+        on the host path, a TpuModel/TpuTransformer view of the stacked
+        pytree on the fleet path)."""
         rows = []
         if self.fleet is not None:
             import jax
             from spark_sklearn_tpu.convert.converter import TpuModel
-            fam = self.fleet["family"]
             for key, i in self.fleet["key_index"].items():
                 leaf = jax.tree_util.tree_map(
                     lambda a: a[i], self.fleet["models"])
-                rows.append(dict(
-                    zip(self.keyCols, key),
-                    estimator=TpuModel(fam, leaf, self.fleet["static"],
-                                       self.fleet["meta"])))
-            return pd.DataFrame(rows)
-        for key, est in self.models.items():
-            rows.append(dict(zip(self.keyCols, key), estimator=est))
+                if self.fleet["kind"] == "step":
+                    view: Any = TpuTransformer(
+                        self.fleet["step"], leaf, self.fleet["static"])
+                else:
+                    view = TpuModel(self.fleet["family"], leaf,
+                                    self.fleet["static"], self.fleet["meta"])
+                rows.append(dict(zip(self.keyCols, key), estimator=view))
+        if self.models:
+            for key, est in self.models.items():
+                rows.append(dict(zip(self.keyCols, key), estimator=est))
         return pd.DataFrame(rows)
 
     def transform(self, df: pd.DataFrame) -> pd.DataFrame:
@@ -282,16 +412,13 @@ class KeyedModel:
             if not isinstance(key, tuple):
                 key = (key,)
             pos = pdf.index.to_numpy()
-            if self.fleet is not None:
+            if self.fleet is not None and \
+                    key in self.fleet["key_index"]:
                 vals = self._fleet_predict(key, pdf)
-                if vals is None:
-                    for p in pos:
-                        out_values[p] = np.nan
-                else:
-                    for p, v in zip(pos, vals):
-                        out_values[p] = v
+                for p, v in zip(pos, vals):
+                    out_values[p] = v
                 continue
-            est = self.models.get(key)
+            est = self.models.get(key) if self.models else None
             if est is None:
                 fill = None if self.estimatorType == "transformer" else np.nan
                 for p in pos:
@@ -314,17 +441,20 @@ class KeyedModel:
         return res
 
     def _fleet_predict(self, key, pdf):
-        """Batched predict from the stacked-pytree fleet (one gather on the
-        key axis + the family's compiled predict)."""
+        """Batched predict/transform from the stacked-pytree fleet (one
+        gather on the key axis + the family's compiled predict or the
+        step's pure apply)."""
         import jax
         import jax.numpy as jnp
-        idx = self.fleet["key_index"].get(key)
-        if idx is None:
-            return None
-        fam = self.fleet["family"]
+        idx = self.fleet["key_index"][key]
         model = jax.tree_util.tree_map(
             lambda a: a[idx], self.fleet["models"])
         X = jnp.asarray(_stack_x(pdf[self.xCol]), jnp.float32)
+        if self.fleet["kind"] == "step":
+            out = np.asarray(self.fleet["step"].apply(
+                self.fleet["static"], model, X))
+            return list(out.astype(np.float64))
+        fam = self.fleet["family"]
         pred = np.asarray(fam.predict(
             model, self.fleet["static"], X, self.fleet["meta"]))
         if fam.is_classifier:

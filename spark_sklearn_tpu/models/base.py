@@ -103,6 +103,26 @@ class Family:
 
     # --- device side (pure, jit/vmap-safe) -------------------------------
     @classmethod
+    def build_fit_data(cls, Xg, yg, meta):
+        """Device-side data dict for a single-group fit (the keyed fleet's
+        analog of prepare_data, traced under vmap).  `yg` is None for
+        unsupervised fits; classifiers receive already-encoded labels.
+        Families whose loss consumes extra keys (MLPRegressor's
+        "y_target") override this so the contract lives with the family.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if yg is None:
+            return {"X": Xg}
+        if cls.is_classifier:
+            yi = yg.astype(jnp.int32)
+            return {"X": Xg, "y": yi,
+                    "y1h": jax.nn.one_hot(yi, meta["n_classes"],
+                                          dtype=Xg.dtype)}
+        return {"X": Xg, "y": yg.astype(Xg.dtype)}
+
+    @classmethod
     def fit(cls, dynamic, static, data, train_w, meta):
         raise NotImplementedError
 

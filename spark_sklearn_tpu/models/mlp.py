@@ -248,6 +248,13 @@ class MLPRegressorFamily(MLPClassifierFamily):
     is_classifier = False
 
     @classmethod
+    def build_fit_data(cls, Xg, yg, meta):
+        yt = yg.astype(Xg.dtype)
+        # the loss consumes "y_target" in (n, n_targets) layout; keyed
+        # fleets carry a single y column -> (n, 1)
+        return {"X": Xg, "y": yt, "y_target": yt[:, None]}
+
+    @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
         y = np.asarray(y, dtype=dtype)
         data = {

@@ -68,7 +68,11 @@ class MinMaxScalerStep:
 
     @staticmethod
     def apply(static, state, X):
-        return (X - state["min"]) * state["scale"] + state["lo"]
+        out = (X - state["min"]) * state["scale"] + state["lo"]
+        if static.get("clip", False):
+            lo, hi = static.get("feature_range", (0.0, 1.0))
+            out = jnp.clip(out, lo, hi)
+        return out
 
 
 class MaxAbsScalerStep:
@@ -124,16 +128,42 @@ class PCAStep:
     monotone_per_feature = False   # rotation, mixes features
 
     @staticmethod
-    def fit(static, X, w):
+    def min_group_size(static) -> int:
+        """A PCA fit needs at least n_components rows (keyed-fleet
+        eligibility hook, mirroring Family.min_group_size)."""
+        nc = static.get("n_components")
+        if isinstance(nc, (int, np.integer)) and not isinstance(nc, bool):
+            return max(1, int(nc))
+        return 1
+
+    @staticmethod
+    def check_static(static, n_features=None):
+        """Raise ValueError for configs the compiled path cannot serve
+        (callers probe this BEFORE launching so designed host fallbacks
+        stay silent; fit also calls it so trace-time misuse still fails).
+
+        sklearn raises for n_components outside [0, min(n_samples,
+        n_features)]; a silent evecs[:, :nc] truncation would diverge
+        from the host-fitted keys in a hybrid fleet.
+        """
         nc = static.get("n_components")
         if nc is None or isinstance(nc, bool) or \
                 not isinstance(nc, (int, np.integer)):
             raise ValueError(
                 "PCA needs an integer n_components on the compiled path")
-        nc = int(nc)
+        if nc < 0:
+            raise ValueError(f"n_components={nc} must be >= 0")
+        if n_features is not None and nc > n_features:
+            raise ValueError(
+                f"n_components={nc} must be <= n_features={n_features}")
         if static.get("svd_solver", "auto") not in ("auto", "full",
                                                     "covariance_eigh"):
             raise ValueError("only full-SVD PCA is compiled")
+
+    @staticmethod
+    def fit(static, X, w):
+        PCAStep.check_static(static, X.shape[1])
+        nc = int(static["n_components"])
         wsum = jnp.sum(w) + EPS
         mean = (w @ X) / wsum
         Xc = X - mean

@@ -382,9 +382,10 @@ class TestKeyedTierA:
         acc = np.mean(out["output"] == df["y"])
         assert acc > 0.9
 
-    def test_missing_class_key_falls_back_to_host(self):
+    def test_missing_class_key_host_fitted_per_key(self):
         # a key whose group lacks one of the global classes must get its
-        # own classes_ (host per-key semantics), not a globally-encoded fit
+        # own classes_ (host per-key semantics), not a globally-encoded
+        # fit — but ONLY that key leaves the fleet (hybrid), not every key
         rng = np.random.default_rng(4)
         df = pd.DataFrame({
             "k": np.repeat(["a", "b"], 40),
@@ -397,10 +398,117 @@ class TestKeyedTierA:
         km = sst.KeyedEstimator(
             sklearnEstimator=SkLogReg(max_iter=100), keyCols=["k"],
             xCol="x", yCol="y").fit(df)
-        assert km.backend == "host"
+        assert km.backend == "hybrid"
+        assert ("a",) in km.fleet["key_index"]
+        assert ("b",) in km.models
         out = km.transform(df)
         # key "b"'s model must only ever emit its own two classes
         assert set(out["output"][40:]) <= {"pos", "neg"}
+
+    def test_bucketed_fleet_skewed_group_sizes(self):
+        """One huge key among many small ones stays compiled with bounded
+        padding (bucketed fleet; round-1 padded every group to the global
+        max)."""
+        rng = np.random.default_rng(5)
+        n_small, rows_small, rows_big = 40, 10, 3000
+        ks = np.concatenate([np.repeat([f"s{i}" for i in range(n_small)],
+                                       rows_small),
+                             np.repeat(["big"], rows_big)])
+        df = pd.DataFrame({
+            "k": ks, "x": [rng.normal(size=3) for _ in range(len(ks))]})
+        df["y"] = [v.sum() + 0.01 * rng.normal() for v in df.x]
+        km = sst.KeyedEstimator(
+            sklearnEstimator=SkLinReg(), keyCols=["k"], xCol="x",
+            yCol="y").fit(df)
+        assert km.backend == "tpu"
+        assert len(km.fleet["key_index"]) == n_small + 1
+        out = km.transform(df)
+        assert np.max(np.abs(out["output"] - df["y"])) < 0.1
+
+    def test_small_group_host_fitted_per_key(self, keyed_df):
+        """A single under-sized key is host-fitted per key; the rest stay
+        on the compiled fleet (round 1 failed the whole fleet to host)."""
+        from sklearn.cluster import KMeans
+        tiny = pd.DataFrame({
+            "k": ["tiny"] * 2,
+            "x": [np.zeros(4), np.ones(4)],
+        })
+        df = pd.concat([keyed_df[["k", "x"]], tiny], ignore_index=True)
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=KMeans(n_clusters=3, n_init=2), keyCols=["k"],
+            xCol="x", estimatorType="clusterer")
+        with pytest.raises(ValueError):
+            # sklearn raises for n_samples < n_clusters — per-key host
+            # semantics preserved for the offending key
+            ke.fit(df)
+        km = ke.fit(keyed_df[["k", "x"]])
+        assert km.backend == "tpu"
+
+    def test_empty_dataframe_fits_empty_model(self):
+        """Zero-row input returns an empty KeyedModel on every
+        estimatorType (review finding: the fleet builders crashed)."""
+        from sklearn.preprocessing import StandardScaler
+        empty = pd.DataFrame({"k": [], "x": [], "y": []})
+        for est, kw in [(SkLinReg(), {"yCol": "y"}),
+                        (StandardScaler(),
+                         {"estimatorType": "transformer"})]:
+            km = sst.KeyedEstimator(
+                sklearnEstimator=est, keyCols=["k"], xCol="x",
+                **kw).fit(empty)
+            assert len(km.keyedModels) == 0
+            out = km.transform(pd.DataFrame(
+                {"k": ["a"], "x": [np.zeros(3)], "y": [0.0]}))
+            assert len(out) == 1
+
+    def test_pca_n_components_exceeds_features_raises(self, keyed_df):
+        """sklearn raises when n_components > n_features; the fleet must
+        not silently truncate (review finding)."""
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=PCA(n_components=9), keyCols=["k"], xCol="x",
+            estimatorType="transformer")
+        with pytest.raises(ValueError):
+            ke.fit(keyed_df)   # x has 4 features
+
+    def test_pca_default_falls_back_silently(self, keyed_df, recwarn):
+        """PCA() (n_components=None) is a designed host fallback — no
+        'fleet failed' warning noise (review finding)."""
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error", UserWarning)
+            km = sst.KeyedEstimator(
+                sklearnEstimator=PCA(), keyCols=["k"], xCol="x",
+                estimatorType="transformer").fit(keyed_df)
+        assert km.backend == "host"
+
+    def test_transformer_fleet_minmax_clip(self, keyed_df):
+        """MinMaxScaler(clip=True) must clamp fleet transforms to the
+        feature range like sklearn (review finding: clip was ignored)."""
+        from sklearn.preprocessing import MinMaxScaler
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=MinMaxScaler(clip=True), keyCols=["k"],
+            xCol="x", estimatorType="transformer")
+        km = ke.fit(keyed_df)
+        assert km.backend == "tpu"
+        far = pd.DataFrame({"k": ["a", "b"],
+                            "x": [np.full(4, 100.0), np.full(4, -100.0)]})
+        out = np.stack(km.transform(far)["output"].to_numpy())
+        assert out.min() >= 0.0 and out.max() <= 1.0
+
+    def test_transformer_fleet_compiled_scaler(self, keyed_df):
+        """StandardScaler keyed fleets run as one vmapped weighted-stats
+        program; outputs match per-key sklearn fits."""
+        from sklearn.preprocessing import StandardScaler
+        ke = sst.KeyedEstimator(
+            sklearnEstimator=StandardScaler(), keyCols=["k"], xCol="x",
+            estimatorType="transformer")
+        km = ke.fit(keyed_df)
+        assert km.backend == "tpu"
+        out = km.transform(keyed_df)
+        for key, pdf in keyed_df.groupby("k"):
+            X = np.stack(pdf["x"].to_numpy())
+            want = StandardScaler().fit_transform(X)
+            got = np.stack(out.loc[pdf.index, "output"].to_numpy())
+            assert np.allclose(got, want, atol=1e-4), key
 
     def test_unseen_key_fleet_nan(self, keyed_df):
         km = sst.KeyedEstimator(
