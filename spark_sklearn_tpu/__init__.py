@@ -24,7 +24,7 @@ from spark_sklearn_tpu.search.grid import GridSearchCV, RandomizedSearchCV
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
 from spark_sklearn_tpu.convert.converter import Converter
 from spark_sklearn_tpu.keyed.keyed import KeyedEstimator, KeyedModel
-from spark_sklearn_tpu.keyed.gapply import gapply
+from spark_sklearn_tpu.keyed.gapply import compiled_group_func, gapply
 from spark_sklearn_tpu.sparse.csr import CSRMatrix
 from spark_sklearn_tpu.utils.session import (
     TpuSession,
@@ -40,6 +40,7 @@ __all__ = [
     "KeyedEstimator",
     "KeyedModel",
     "gapply",
+    "compiled_group_func",
     "CSRMatrix",
     "TpuConfig",
     "TpuSession",

@@ -37,6 +37,72 @@ def _stack_x(col) -> np.ndarray:
     return np.stack([np.asarray(v, dtype=np.float64) for v in col])
 
 
+def bucket_len(m: int, floor: int = 8) -> int:
+    """Pad length for a group of m rows: next power of two (>= floor).
+
+    Bucketed padding bounds the waste at 2x per group, so one huge key
+    among thousands of small ones costs O(G_small * L_small + L_big)
+    memory instead of the O(G * L_max) a single global pad would
+    (SURVEY §3.2 redesign note; the round-1 fleet padded globally).
+    Shared by the keyed fleets and gapply's compiled segment path.
+    """
+    L = floor
+    while L < m:
+        L *= 2
+    return L
+
+
+def run_bucketed(mats, encs, y_dtype, fit_one, launch=None):
+    """The bucketed-fleet launcher shared by keyed fleets and gapply.
+
+    mats: per-group (m_i, d) float32 arrays; encs: matching (m_i,) target
+    arrays, or None for target-less fits (transformer steps, gapply
+    segment funcs — `fit_one` then takes (Xg, wg) instead of
+    (Xg, yg, wg)).  Each group is zero-padded to its bucket length, each
+    bucket runs as one jit(vmap(fit_one)) program, and the stacked result
+    pytrees are concatenated on the group axis.  `launch` overrides the
+    per-bucket callable (callers that reuse a cached jit across calls).
+
+    Returns (order, stacked): order[j] = index into `mats` of stacked
+    row j.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if launch is None:
+        launch = jax.jit(jax.vmap(fit_one))
+
+    buckets: Dict[int, list] = {}
+    for i, m in enumerate(mats):
+        buckets.setdefault(bucket_len(len(m)), []).append(i)
+
+    d = mats[0].shape[1]
+    order, stacked = [], []
+    for L in sorted(buckets):
+        idxs = buckets[L]
+        Xs = np.zeros((len(idxs), L, d), np.float32)
+        ws = np.zeros((len(idxs), L), np.float32)
+        ys = None if encs is None else np.zeros((len(idxs), L), y_dtype)
+        for j, gi in enumerate(idxs):
+            m = len(mats[gi])
+            Xs[j, :m] = mats[gi]
+            ws[j, :m] = 1.0
+            if ys is not None:
+                ys[j, :m] = encs[gi]
+        args = [jnp.asarray(Xs)]
+        if ys is not None:
+            args.append(jnp.asarray(ys))
+        args.append(jnp.asarray(ws))
+        stacked.append(launch(*args))
+        order.extend(idxs)
+    if jax.tree_util.tree_leaves(stacked[0]):
+        models = jax.tree_util.tree_map(
+            lambda *leaves: jnp.concatenate(leaves, axis=0), *stacked)
+    else:
+        models = stacked[0]   # stateless result (e.g. Normalizer step)
+    return order, models
+
+
 class KeyedEstimator(BaseEstimator):
     """Fits one estimator per distinct key of a DataFrame.
 
@@ -127,19 +193,7 @@ class KeyedEstimator(BaseEstimator):
             outputCol=self.outputCol,
             estimatorType=self.estimatorType, models=models, fleet=fleet)
 
-    @staticmethod
-    def _bucket_len(m: int, floor: int = 8) -> int:
-        """Pad length for a group of m rows: next power of two (>= floor).
-
-        Bucketed padding bounds the waste at 2x per group, so one huge key
-        among thousands of small ones costs O(G_small * L_small + L_big)
-        memory instead of the O(G * L_max) a single global pad would
-        (SURVEY §3.2 redesign note; the round-1 fleet padded globally).
-        """
-        L = floor
-        while L < m:
-            L *= 2
-        return L
+    _bucket_len = staticmethod(bucket_len)
 
     def _fit_family_fleet(self, work, keys, slices):
         """The TPU-native per-key fleet: keys become vmap axes.
@@ -234,47 +288,15 @@ class KeyedEstimator(BaseEstimator):
             key_index={k: i for i, k in enumerate(fleet_keys)}), host_pairs
 
     def _fit_bucketed(self, eligible, X_all, enc, y_dtype, fit_one):
-        """Shared bucketed-fleet launcher: pad each group to its bucket
-        length, run one jit(vmap(fit_one)) per bucket, concatenate the
-        stacked result pytrees on the key axis.  `fit_one` takes
-        (Xg, yg, wg) when `enc` is given, (Xg, wg) when it is None
-        (transformer steps have no targets).  Returns (keys_in_fleet_order,
+        """Adapter over the module-level `run_bucketed` launcher: slices
+        per-key group matrices/targets out of the full arrays and maps the
+        launcher's order back to keys.  Returns (keys_in_fleet_order,
         stacked_models)."""
-        import jax
-        import jax.numpy as jnp
-
-        buckets: Dict[int, list] = {}
-        for key, pdf in eligible:
-            buckets.setdefault(self._bucket_len(len(pdf)), []).append(
-                (key, pdf))
-
-        d = X_all.shape[1]
-        fleet_keys, stacked = [], []
-        for L in sorted(buckets):
-            group = buckets[L]
-            Gb = len(group)
-            Xs = np.zeros((Gb, L, d), np.float32)
-            ws = np.zeros((Gb, L), np.float32)
-            ys = None if enc is None else np.zeros((Gb, L), y_dtype)
-            for i, (_, pdf) in enumerate(group):
-                m = len(pdf)
-                pos = pdf.index.to_numpy()
-                Xs[i, :m] = X_all[pos]
-                ws[i, :m] = 1.0
-                if ys is not None:
-                    ys[i, :m] = enc[pos]
-            args = [jnp.asarray(Xs)]
-            if ys is not None:
-                args.append(jnp.asarray(ys))
-            args.append(jnp.asarray(ws))
-            stacked.append(jax.jit(jax.vmap(fit_one))(*args))
-            fleet_keys.extend(k for k, _ in group)
-        if jax.tree_util.tree_leaves(stacked[0]):
-            models = jax.tree_util.tree_map(
-                lambda *leaves: jnp.concatenate(leaves, axis=0), *stacked)
-        else:
-            models = stacked[0]   # stateless step (e.g. Normalizer)
-        return fleet_keys, models
+        mats = [X_all[pdf.index.to_numpy()] for _, pdf in eligible]
+        encs = None if enc is None else \
+            [enc[pdf.index.to_numpy()] for _, pdf in eligible]
+        order, models = run_bucketed(mats, encs, y_dtype, fit_one)
+        return [eligible[i][0] for i in order], models
 
     def _fit_transformer_fleet(self, work, keys, slices):
         """Compiled transformer-type fleets: one vmapped weighted-stats fit

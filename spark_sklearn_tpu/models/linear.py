@@ -333,6 +333,18 @@ def _weighted_center(X, y, w):
     return X - xm, y - ym, xm, ym
 
 
+def _centered_problem(static, X, y, train_w):
+    """Shared OLS/Ridge preamble: positive= guard + optional weighted
+    centering.  Returns (Xc, yc, xm, ym)."""
+    if static.get("positive", False):
+        raise ValueError(
+            "positive=True is not compiled; use the host backend")
+    if bool(static.get("fit_intercept", True)):
+        return _weighted_center(X, y, train_w)
+    d = X.shape[1]
+    return X, y, jnp.zeros((d,), X.dtype), jnp.asarray(0.0, X.dtype)
+
+
 class RidgeFamily(Family):
     name = "ridge"
     is_classifier = False
@@ -355,16 +367,7 @@ class RidgeFamily(Family):
         d = X.shape[1]
         alpha = jnp.asarray(dynamic.get("alpha", static.get("alpha", 1.0)),
                             X.dtype)
-        fit_intercept = bool(static.get("fit_intercept", True))
-        if static.get("positive", False):
-            raise ValueError(
-                "positive=True is not compiled; use the host backend")
-        if fit_intercept:
-            Xc, yc, xm, ym = _weighted_center(X, y, train_w)
-        else:
-            Xc, yc = X, y
-            xm = jnp.zeros((d,), X.dtype)
-            ym = jnp.asarray(0.0, X.dtype)
+        Xc, yc, xm, ym = _centered_problem(static, X, y, train_w)
         Xw = Xc * train_w[:, None]
         A = Xw.T @ Xc + alpha * jnp.eye(d, dtype=X.dtype)
         b = Xw.T @ yc
@@ -388,9 +391,16 @@ class LinearRegressionFamily(RidgeFamily):
 
     @classmethod
     def fit(cls, dynamic, static, data, train_w, meta):
-        static = dict(static)
-        static["alpha"] = 1e-7  # numerically-stabilised OLS
-        return RidgeFamily.fit.__func__(cls, {}, static, data, train_w, meta)
+        """Weighted OLS as minimum-norm lstsq (SVD), matching sklearn's
+        scipy.linalg.lstsq path: on rank-deficient X the solution is the
+        minimum-norm one, where a ridge-with-tiny-alpha stand-in (the
+        round-1 implementation) diverges from sklearn."""
+        X, y = data["X"], data["y"]
+        Xc, yc, xm, ym = _centered_problem(static, X, y, train_w)
+        sw = jnp.sqrt(train_w)
+        w, *_ = jnp.linalg.lstsq(Xc * sw[:, None], yc * sw)
+        intercept = ym - jnp.dot(xm, w)
+        return {"coef": w, "intercept": intercept}
 
 
 # ----------------------------------------------------------------------------
@@ -420,17 +430,8 @@ class ElasticNetFamily(Family):
         l1r = jnp.asarray(
             dynamic.get("l1_ratio", static.get("l1_ratio", 0.5)), X.dtype)
         max_iter = int(static.get("max_iter", 1000))
-        fit_intercept = bool(static.get("fit_intercept", True))
-        if static.get("positive", False):
-            raise ValueError(
-                "positive=True is not compiled; use the host backend")
         n_eff = jnp.sum(train_w) + jnp.finfo(X.dtype).eps
-        if fit_intercept:
-            Xc, yc, xm, ym = _weighted_center(X, y, train_w)
-        else:
-            Xc, yc = X, y
-            xm = jnp.zeros((d,), X.dtype)
-            ym = jnp.asarray(0.0, X.dtype)
+        Xc, yc, xm, ym = _centered_problem(static, X, y, train_w)
         Xw = Xc * train_w[:, None]
         # Lipschitz constant of (1/n) X^T W X via power iteration
         G = Xw.T @ Xc / n_eff

@@ -1052,8 +1052,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 t_score = time.perf_counter() - t0
                 del models
 
-                fit_times[idx, :] = t_fit / (nc_batch * n_folds)
-                score_times[idx, :] = t_score / (nc_batch * n_folds)
+                # charge the launch wall to the REAL candidates in the
+                # chunk (not the padded lane count), so summing ALL
+                # per-split fit-time cells (mean_fit_time x n_splits over
+                # candidates) reconstructs the true device wall; XLA fuses
+                # all lanes into one program, so a finer per-candidate
+                # split is not measurable (ROADMAP)
+                fit_times[idx, :] = t_fit / ((hi - lo) * n_folds)
+                score_times[idx, :] = t_score / ((hi - lo) * n_folds)
                 for s in scorer_names:
                     test_scores[s][idx, :] = np.asarray(te[s])[:hi - lo]
                     if return_train:
@@ -1069,8 +1075,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         "train": ({s: train_scores[s][idx, :].tolist()
                                    for s in scorer_names}
                                   if return_train else None),
-                        "fit_t": t_fit / (nc_batch * n_folds),
-                        "score_t": t_score / (nc_batch * n_folds),
+                        "fit_t": t_fit / ((hi - lo) * n_folds),
+                        "score_t": t_score / ((hi - lo) * n_folds),
                         "failed": fit_failed[idx, :].tolist()})
 
     # ------------------------------------------------------------------

@@ -243,6 +243,25 @@ class TestSparseInput:
 
 
 class TestMoreOracles:
+    def test_linear_regression_rank_deficient_min_norm(self):
+        """On rank-deficient X the compiled OLS must return sklearn's
+        minimum-norm lstsq solution, not a tiny-ridge approximation
+        (VERDICT round-1 weak #8)."""
+        from sklearn.linear_model import LinearRegression
+        rng = np.random.default_rng(0)
+        X4 = rng.normal(size=(60, 4))
+        X = np.hstack([X4, X4[:, :2]]).astype(np.float32)  # rank 4 of 6
+        y = (X4[:, 0] - 2 * X4[:, 1]
+             + 0.1 * rng.normal(size=60)).astype(np.float32)
+        sk = LinearRegression().fit(X, y)
+        gs = sst.GridSearchCV(
+            LinearRegression(), {"fit_intercept": [True]}, cv=3,
+            backend="tpu", refit=True).fit(X, y)
+        np.testing.assert_allclose(
+            gs.best_estimator_.coef_, sk.coef_, atol=1e-4)
+        assert abs(np.linalg.norm(gs.best_estimator_.coef_)
+                   - np.linalg.norm(sk.coef_)) < 1e-4
+
     def test_elasticnet_lasso_oracle(self, diabetes):
         from sklearn.linear_model import ElasticNet, Lasso
         from sklearn.model_selection import GridSearchCV as SkGS
