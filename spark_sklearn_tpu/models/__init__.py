@@ -1,6 +1,7 @@
 from spark_sklearn_tpu.models import linear  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import mlp  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import svm  # noqa: F401 — registers families
+from spark_sklearn_tpu.models import svr  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import trees  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import cluster  # noqa: F401 — registers families
 from spark_sklearn_tpu.models.estimators import (  # noqa: F401

@@ -1,0 +1,469 @@
+"""SVR / LinearSVC / LinearSVR families — the remaining libsvm/liblinear
+estimators, re-designed for the MXU.
+
+Reference counterpart: sklearn's SVR/LinearSVC/LinearSVR run unchanged as
+host Python inside Spark tasks (reference: grid_search.py -> sklearn
+_fit_and_score).  The TPU redesign:
+
+- SVR solves the epsilon-SVR dual with the SAME box-and-hyperplane
+  projected ascent as SVC (models/svm.py): the paired variables
+  u = (a, a*) live in one (M, 2n) row per subproblem, the signs
+  s = (+1...,-1...) take the role SVC's labels play in the equality
+  constraint sum(a - a*) = 0, and the tiled kernel [[K,K],[K,K]] acts
+  through ONE (M, n) @ (n, n) matmul per iteration (its top eigenvalue is
+  2*lambda_max(K), so SVC's power-iteration step halves).
+- LinearSVC/LinearSVR solve liblinear's PRIMAL smooth losses
+  (squared_hinge / squared_epsilon_insensitive) with the same batched
+  L-BFGS engine as logistic regression (ops/solvers.glm_lbfgs_batched):
+  all (candidate x fold) tasks advance as one wide matmul.  liblinear's
+  augmented-column intercept convention (intercept_scaling, intercept
+  REGULARISED) is reproduced exactly.  The nonsmooth duals (hinge,
+  epsilon_insensitive, crammer_singer, penalty='l1') raise -> the search
+  falls back to the host tier, matching sklearn bit-for-bit there.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_sklearn_tpu.models.base import Family, register_family
+from spark_sklearn_tpu.models.svm import (
+    _kernel,
+    _power_step,
+    _project_box_hyperplane,
+    _resolve_gamma,
+)
+
+
+def svr_dual_ascent(K, y, eps, bound_half, step, max_iter):
+    """Nesterov-accelerated projected ascent on the epsilon-SVR dual
+
+        max_{a,a*}  -0.5 (a-a*)' K (a-a*) - eps 1'(a+a*) + y'(a-a*)
+        0 <= a_i, a*_i <= C_i,   sum_i (a_i - a*_i) = 0
+
+    in the stacked form u = (a, a*) with signs s = (+1^n, -1^n): the
+    equality is sum(s*u) = 0 (SVC's hyperplane with s for labels) and the
+    quadratic acts through beta = a - a* so each iteration is one
+    (M, n) @ (n, n) matmul.  bound_half: (M, n) per-sample C (fold-masked,
+    sample-weight-scaled); applies to both halves.  Returns (beta, b).
+    """
+    M, n = bound_half.shape
+    dtype = K.dtype
+    s = jnp.concatenate([jnp.ones((n,), dtype), -jnp.ones((n,), dtype)])
+    lin = s * jnp.concatenate([y, y]) - eps            # (2n,) per-element
+    bound = jnp.concatenate([bound_half, bound_half], axis=1)   # (M, 2n)
+
+    def ascent(i, carry):
+        U, Z, t = carry
+        beta = (Z * s).reshape(M, 2, n).sum(axis=1)    # a - a*  (M, n)
+        V_half = beta @ K                              # (M, n)
+        V = jnp.concatenate([V_half, V_half], axis=1)  # (M, 2n)
+        grad = lin - s * V
+        U_new = _project_box_hyperplane(Z + step * grad, s[None, :], bound)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        Z_new = U_new + ((t - 1.0) / t_new) * (U_new - U)
+        return U_new, Z_new, t_new
+
+    U0 = jnp.zeros_like(bound)
+    U, _, _ = jax.lax.fori_loop(
+        0, max_iter, ascent, (U0, U0, jnp.asarray(1.0, dtype)))
+    beta = (U * s).reshape(M, 2, n).sum(axis=1)
+    return beta, _svr_intercept(K, U, beta, y, eps, bound_half)
+
+
+def _svr_intercept(K, U, beta, y, eps, bound_half):
+    """KKT intercept (libsvm's -rho for epsilon-SVR): over free SVs,
+    y - f0 - b = +eps for 0 < a < C and -eps for 0 < a* < C; when nothing
+    is free, the midpoint of the feasible [max lower, min upper] interval
+    from the at-bound conditions."""
+    M, n = bound_half.shape
+    f0 = beta @ K                                       # (M, n)
+    E = y[None, :] - f0
+    a = U[:, :n]
+    a_star = U[:, n:]
+    inb = bound_half > 0
+    tol_lo = bound_half * 1e-6
+    tol_hi = bound_half * (1.0 - 1e-6)
+    free_a = inb & (a > tol_lo) & (a < tol_hi)
+    free_as = inb & (a_star > tol_lo) & (a_star < tol_hi)
+    nfree = jnp.sum(free_a, axis=1) + jnp.sum(free_as, axis=1)
+    b_free = (jnp.sum(jnp.where(free_a, E - eps, 0.0), axis=1)
+              + jnp.sum(jnp.where(free_as, E + eps, 0.0), axis=1)) \
+        / jnp.maximum(nfree, 1)
+    # at-bound conditions: a=0 -> b >= E-eps; a*=C -> b >= E+eps;
+    #                      a=C -> b <= E-eps; a*=0 -> b <= E+eps
+    big = jnp.asarray(jnp.inf, E.dtype)
+    lb = jnp.maximum(
+        jnp.max(jnp.where(inb & (a <= tol_lo), E - eps, -big), axis=1),
+        jnp.max(jnp.where(inb & (a_star >= tol_hi), E + eps, -big), axis=1))
+    ub = jnp.minimum(
+        jnp.min(jnp.where(inb & (a >= tol_hi), E - eps, big), axis=1),
+        jnp.min(jnp.where(inb & (a_star <= tol_lo), E + eps, big), axis=1))
+    b_mid = 0.5 * (lb + ub)
+    b_mid = jnp.where(jnp.isfinite(b_mid), b_mid,
+                      jnp.where(jnp.isfinite(lb), lb,
+                                jnp.where(jnp.isfinite(ub), ub, 0.0)))
+    return jnp.where(nfree > 0, b_free, b_mid)
+
+
+class SVRFamily(Family):
+    name = "svr"
+    is_classifier = False
+    dynamic_params = {"C": np.float32, "gamma": np.float32,
+                      "epsilon": np.float32}
+    # task-batched only (like SVC): the keyed fleet and per-task callers
+    # skip it via has_per_task_fit(); keyed_compatible stays True so
+    # make_pipeline_family composes it as a fold-input final, NOT as a
+    # binned-invariant tree final
+    task_batched_accepts_fold_inputs = True
+
+    @staticmethod
+    def max_tasks_hint(n_samples: int, meta) -> int:
+        budget = 1 << 30
+        return max(1, budget // max(1, n_samples * 8))
+
+    @classmethod
+    def prepare_data(cls, X, y, dtype=np.float32):
+        data = {"X": np.ascontiguousarray(X, dtype=dtype),
+                "y": np.ascontiguousarray(y, dtype=dtype)}
+        meta = {"n_features": int(X.shape[1]),
+                "x_var": float(np.var(np.asarray(X)))}
+        return data, meta
+
+    @classmethod
+    def fit_task_batched(cls, dynamic, static, data, train_w, meta):
+        """Candidate-major tasks (task t = (cand t//F, fold t%F)); one
+        kernel per candidate shared by its F fold subproblems.  Caches the
+        full-dataset regression values f(x) per task (the search scores on
+        masked rows, so predict never rebuilds kernels)."""
+        X, y = data["X"], data["y"]
+        n, d = X.shape
+        B = train_w.shape[0]
+        kind = static.get("kernel", "rbf")
+        if kind == "precomputed":
+            raise ValueError("precomputed kernels: use backend='host'")
+        degree = float(static.get("degree", 3))
+        coef0 = float(static.get("coef0", 0.0))
+        max_iter = int(static.get("max_iter", -1))
+        if max_iter in (-1, 0):
+            max_iter = 300
+        n_folds = int(static.get("__n_folds__", 0))
+        if n_folds <= 0:
+            raise ValueError("engine must pass __n_folds__ for SVR")
+        nc = B // n_folds
+
+        gamma_default = _resolve_gamma(static.get("gamma", "scale"), meta)
+        C_task = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("C", static.get("C", 1.0)), X.dtype), (B,))
+        g_task = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("gamma", gamma_default), X.dtype), (B,))
+        e_task = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("epsilon", static.get("epsilon", 0.1)),
+            X.dtype), (B,))
+        C_cand = C_task.reshape(nc, n_folds)[:, 0]
+        g_cand = g_task.reshape(nc, n_folds)[:, 0]
+        e_cand = e_task.reshape(nc, n_folds)[:, 0]
+        w_cand = train_w.reshape(nc, n_folds, n)
+
+        X_folds = data.get("X_folds")      # (F, n, d) pipeline mode
+        gamma_is_scale = "gamma" not in dynamic and \
+            static.get("gamma", "scale") == "scale"
+
+        def one_candidate(carry, inp):
+            C_c, g_c, e_c, w_f = inp
+            if X_folds is None:
+                K = _kernel(X, X, kind, g_c, degree, coef0)
+                step = 0.5 * _power_step(K, n, X.dtype)   # lam_max doubles
+                bound = C_c * w_f                          # (F, n)
+                beta, b = svr_dual_ascent(K, y, e_c, bound, step, max_iter)
+                f = beta @ K + b[:, None]                  # (F, n)
+            else:
+                def per_fold(Xf, w_row):
+                    if gamma_is_scale:
+                        mrow = (w_row > 0).astype(Xf.dtype)
+                        cnt = jnp.sum(mrow) * Xf.shape[1] + 1e-12
+                        mu = jnp.sum(Xf * mrow[:, None]) / cnt
+                        var = jnp.sum(((Xf - mu) ** 2)
+                                      * mrow[:, None]) / cnt
+                        g_f = 1.0 / (Xf.shape[1]
+                                     * jnp.maximum(var, 1e-12))
+                    else:
+                        g_f = g_c
+                    Kf = _kernel(Xf, Xf, kind, g_f, degree, coef0)
+                    step = 0.5 * _power_step(Kf, n, Xf.dtype)
+                    beta, b = svr_dual_ascent(
+                        Kf, y, e_c, (C_c * w_row)[None, :], step, max_iter)
+                    return (beta @ Kf + b[:, None])[0]
+
+                f = jax.vmap(per_fold)(X_folds, w_f)       # (F, n)
+            return carry, f
+
+        _, fs = jax.lax.scan(
+            one_candidate, 0.0, (C_cand, g_cand, e_cand, w_cand))
+        return {"f": fs.reshape(B, n)}
+
+    @classmethod
+    def predict(cls, model, static, X, meta):
+        return model["f"]
+
+    @classmethod
+    def sklearn_attrs(cls, model, static, meta):
+        return {"n_features_in_": meta["n_features"]}
+
+
+# ----------------------------------------------------------------------------
+# liblinear primal families
+# ----------------------------------------------------------------------------
+
+def _check_linear_svc_static(static):
+    if static.get("penalty", "l2") != "l2":
+        raise ValueError("penalty='l1' is not compiled; use backend='host'")
+    if static.get("loss", "squared_hinge") != "squared_hinge":
+        raise ValueError(
+            "loss='hinge' (nonsmooth dual) is not compiled; use "
+            "backend='host'")
+    if static.get("multi_class", "ovr") != "ovr":
+        raise ValueError(
+            "multi_class='crammer_singer' is not compiled; use "
+            "backend='host'")
+
+
+class LinearSVCFamily(Family):
+    """liblinear's L2-regularised squared-hinge primal, one-vs-rest.
+
+    liblinear regularises the intercept via the appended
+    intercept_scaling column — reproduced exactly (coef dimension d+1,
+    all penalised), so scores track sklearn's LinearSVC, not a
+    hand-rolled unpenalised-intercept variant.
+    """
+
+    name = "linear_svc"
+    is_classifier = True
+    dynamic_params = {"C": np.float32, "tol": np.float32}
+
+    @classmethod
+    def prepare_data(cls, X, y, dtype=np.float32):
+        from spark_sklearn_tpu.models.base import encode_labels
+        classes, y_enc = encode_labels(y)
+        data = {
+            "X": np.ascontiguousarray(X, dtype=dtype),
+            "y": y_enc,
+            "y1h": np.eye(len(classes), dtype=dtype)[y_enc],
+        }
+        meta = {"n_classes": int(len(classes)), "classes": classes,
+                "n_features": int(X.shape[1])}
+        return data, meta
+
+    @classmethod
+    def fit(cls, dynamic, static, data, train_w, meta):
+        model = cls.fit_task_batched(
+            {k: jnp.asarray(v)[None] for k, v in dynamic.items()},
+            static, data, train_w[None, :], meta)
+        return jax.tree_util.tree_map(lambda a: a[0], model)
+
+    @classmethod
+    def fit_task_batched(cls, dynamic, static, data, train_w, meta):
+        from spark_sklearn_tpu.ops.solvers import glm_lbfgs_batched
+
+        _check_linear_svc_static(static)
+        X = data["X"]
+        n, d = X.shape
+        k = meta["n_classes"]
+        ko = 1 if k == 2 else k          # liblinear: one machine for binary
+        B = train_w.shape[0]
+        C = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("C", static.get("C", 1.0)), X.dtype), (B,))
+        tol = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("tol", static.get("tol", 1e-4)), X.dtype), (B,))
+        max_iter = int(static.get("max_iter", 1000))
+        fit_intercept = bool(static.get("fit_intercept", True))
+        isc = float(static.get("intercept_scaling", 1.0))
+
+        from spark_sklearn_tpu.models.base import apply_class_weight
+        train_w = apply_class_weight(
+            train_w, data["y"], meta, static.get("class_weight"))
+
+        # liblinear intercept: an appended constant column, REGULARISED
+        Xa = jnp.concatenate(
+            [X, jnp.full((n, 1), isc, X.dtype)], axis=1) if fit_intercept \
+            else X
+        da = Xa.shape[1]
+        # targets in {-1, +1}: OvR per class; binary = one machine for
+        # classes_[1]
+        if k == 2:
+            T = (2.0 * data["y"].astype(X.dtype) - 1.0)[:, None]  # (n, 1)
+        else:
+            T = 2.0 * data["y1h"] - 1.0                           # (n, k)
+        wT = train_w.T                                            # (n, B)
+
+        def Ax(x):                                    # (B, da*ko) -> Z
+            W = x.reshape(B, ko, da)
+            return jnp.einsum("nd,bkd->nbk", Xa, W)
+
+        def data_loss(Z):
+            r = jnp.maximum(0.0, 1.0 - T[:, None, :] * Z)
+            return C * jnp.sum(wT[:, :, None] * r * r, axis=(0, 2))
+
+        def data_grad(Z):
+            r = jnp.maximum(0.0, 1.0 - T[:, None, :] * Z)
+            return C[None, :, None] * wT[:, :, None] \
+                * (-2.0 * T[:, None, :] * r)
+
+        def AT(G):
+            return jnp.einsum("nbk,nd->bkd", G, Xa).reshape(B, ko * da)
+
+        def reg_loss(x):
+            return 0.5 * jnp.sum(x * x, axis=1)
+
+        def reg_grad(x):
+            return x
+
+        res = glm_lbfgs_batched(
+            Ax, data_loss, data_grad, AT, reg_loss, reg_grad,
+            jnp.zeros((B, ko * da), X.dtype), max_iter=max_iter, tol=tol)
+        W = res.x.reshape(B, ko, da)
+        if fit_intercept:
+            coef = W[:, :, :d]
+            intercept = W[:, :, d] * isc
+        else:
+            coef = W
+            intercept = jnp.zeros((B, ko), X.dtype)
+        return {"coef": coef, "intercept": intercept,
+                "converged": res.converged, "n_iter": res.n_iter}
+
+    @classmethod
+    def decision(cls, model, static, X, meta):
+        Z = X @ jnp.swapaxes(model["coef"], -1, -2) + model["intercept"]
+        if meta["n_classes"] == 2:
+            return Z[..., 0]
+        return Z
+
+    @classmethod
+    def predict(cls, model, static, X, meta):
+        Z = cls.decision(model, static, X, meta)
+        if meta["n_classes"] == 2:
+            return (Z > 0).astype(jnp.int32)
+        return jnp.argmax(Z, axis=-1).astype(jnp.int32)
+
+    @classmethod
+    def sklearn_attrs(cls, model, static, meta):
+        return {
+            "coef_": np.asarray(model["coef"]),
+            "intercept_": np.asarray(model["intercept"]),
+            "classes_": meta["classes"],
+            "n_features_in_": meta["n_features"],
+            "n_iter_": int(np.asarray(model["n_iter"]))
+            if "n_iter" in model else None,
+        }
+
+
+class LinearSVRFamily(Family):
+    """liblinear's squared-epsilon-insensitive primal (LinearSVR with
+    loss='squared_epsilon_insensitive'; the nonsmooth default
+    'epsilon_insensitive' raises -> host tier).  Same regularised
+    appended-column intercept convention as LinearSVC."""
+
+    name = "linear_svr"
+    is_classifier = False
+    dynamic_params = {"C": np.float32, "tol": np.float32,
+                      "epsilon": np.float32}
+
+    @classmethod
+    def prepare_data(cls, X, y, dtype=np.float32):
+        data = {"X": np.ascontiguousarray(X, dtype=dtype),
+                "y": np.ascontiguousarray(y, dtype=dtype)}
+        meta = {"n_features": int(X.shape[1])}
+        return data, meta
+
+    @classmethod
+    def fit(cls, dynamic, static, data, train_w, meta):
+        model = cls.fit_task_batched(
+            {k: jnp.asarray(v)[None] for k, v in dynamic.items()},
+            static, data, train_w[None, :], meta)
+        return jax.tree_util.tree_map(lambda a: a[0], model)
+
+    @classmethod
+    def fit_task_batched(cls, dynamic, static, data, train_w, meta):
+        from spark_sklearn_tpu.ops.solvers import glm_lbfgs_batched
+
+        if static.get("loss", "epsilon_insensitive") != \
+                "squared_epsilon_insensitive":
+            raise ValueError(
+                "loss='epsilon_insensitive' (nonsmooth) is not compiled; "
+                "use backend='host' or loss='squared_epsilon_insensitive'")
+        X, y = data["X"], data["y"]
+        n, d = X.shape
+        B = train_w.shape[0]
+        C = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("C", static.get("C", 1.0)), X.dtype), (B,))
+        eps_t = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("epsilon", static.get("epsilon", 0.0)),
+            X.dtype), (B,))
+        tol = jnp.broadcast_to(jnp.asarray(
+            dynamic.get("tol", static.get("tol", 1e-4)), X.dtype), (B,))
+        max_iter = int(static.get("max_iter", 1000))
+        fit_intercept = bool(static.get("fit_intercept", True))
+        isc = float(static.get("intercept_scaling", 1.0))
+
+        Xa = jnp.concatenate(
+            [X, jnp.full((n, 1), isc, X.dtype)], axis=1) if fit_intercept \
+            else X
+        da = Xa.shape[1]
+        wT = train_w.T                                  # (n, B)
+
+        def Ax(x):                                      # (B, da) -> (n, B)
+            return Xa @ x.T
+
+        def data_loss(Z):
+            r = jnp.maximum(0.0, jnp.abs(Z - y[:, None]) - eps_t[None, :])
+            return C * jnp.sum(wT * r * r, axis=0)
+
+        def data_grad(Z):
+            e = Z - y[:, None]
+            r = jnp.maximum(0.0, jnp.abs(e) - eps_t[None, :])
+            return C[None, :] * wT * 2.0 * jnp.sign(e) * r
+
+        def AT(G):
+            return G.T @ Xa
+
+        res = glm_lbfgs_batched(
+            Ax, data_loss, data_grad, AT,
+            lambda x: 0.5 * jnp.sum(x * x, axis=1), lambda x: x,
+            jnp.zeros((B, da), X.dtype), max_iter=max_iter, tol=tol)
+        if fit_intercept:
+            coef = res.x[:, :d]
+            intercept = res.x[:, d] * isc
+        else:
+            coef = res.x
+            intercept = jnp.zeros((B,), X.dtype)
+        return {"coef": coef, "intercept": intercept,
+                "converged": res.converged, "n_iter": res.n_iter}
+
+    @classmethod
+    def predict(cls, model, static, X, meta):
+        return X @ model["coef"] + model["intercept"]
+
+    @classmethod
+    def sklearn_attrs(cls, model, static, meta):
+        return {"coef_": np.asarray(model["coef"]),
+                "intercept_": np.asarray(model["intercept"]),
+                "n_features_in_": meta["n_features"]}
+
+
+register_family(
+    SVRFamily,
+    "sklearn.svm._classes.SVR",
+    "sklearn.svm.SVR",
+)
+register_family(
+    LinearSVCFamily,
+    "sklearn.svm._classes.LinearSVC",
+    "sklearn.svm.LinearSVC",
+)
+register_family(
+    LinearSVRFamily,
+    "sklearn.svm._classes.LinearSVR",
+    "sklearn.svm.LinearSVR",
+)

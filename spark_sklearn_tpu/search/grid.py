@@ -377,7 +377,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         fit_weight=fit_weight, score_weight=score_weight,
                         eval_ctxs=eval_ctxs)
                 except Exception as exc:  # unsupported static combo etc.
-                    if self.backend == "tpu":
+                    if self.backend == "tpu" or \
+                            getattr(exc, "_sst_no_fallback", False):
+                        # _sst_no_fallback: error_score='raise' with
+                        # invalid candidate params — sklearn raises this
+                        # exact exception; a host re-run would only repeat
+                        # the failure after redundant work
                         raise
                     state["use_compiled"] = False  # fall back ONCE
                     warnings.warn(
@@ -706,13 +711,56 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         need_unweighted = score_weight is not None and bool(sw_blind)
 
         base_params = family.extract_params(self.estimator)
+        # sklearn raises InvalidParameterError inside fit() for
+        # out-of-range hyperparameters (LinearSVC C=0, negative alpha...);
+        # the compiled solvers accept any finite value, so reproduce the
+        # per-candidate failure host-side BEFORE launching: invalid
+        # candidates are excluded from the compiled launch entirely (a
+        # static value like degree='junk' would crash tracing) and get
+        # error_score on every fold with ZERO fit/score times, exactly
+        # like a raising est.fit (upstream test_search_cv_timing).
+        # set_params stays outside the try: unknown param KEYS abort the
+        # whole search, as in sklearn.
+        preval_failed = np.zeros(n_cand, bool)
+        preval_exc = None
+        for ci, params in enumerate(candidates):
+            cand = clone(self.estimator).set_params(**params)
+            try:
+                if hasattr(cand, "_validate_params"):
+                    cand._validate_params()
+                for sub in cand.get_params(deep=True).values():
+                    if hasattr(sub, "_validate_params") and \
+                            hasattr(sub, "get_params"):
+                        sub._validate_params()
+            except Exception as exc:
+                preval_failed[ci] = True
+                if preval_exc is None:
+                    preval_exc = exc
+        if preval_exc is not None and isinstance(self.error_score, str) \
+                and self.error_score == "raise":
+            # marker consumed by _dispatch: re-raise instead of the usual
+            # fall-back-to-host (sklearn raises this exact exception with
+            # no fallback warning and no duplicate host work)
+            preval_exc._sst_no_fallback = True
+            raise preval_exc
+
+        launch_index = None
+        launch_candidates = candidates
+        if preval_failed.any():
+            launch_index = np.flatnonzero(~preval_failed)
+            launch_candidates = [candidates[i] for i in launch_index]
         if hasattr(family, "observe_candidates"):
             # e.g. tree families need the grid-wide max n_estimators to fix
-            # the compiled program's static tree count
-            family.observe_candidates(candidates, base_params, meta)
+            # the compiled program's static tree count (valid candidates
+            # only — an invalid static value would crash the observation)
+            family.observe_candidates(launch_candidates, base_params, meta)
         dyn_names = list(family.dynamic_params)
         groups = build_compile_groups(
-            candidates, dyn_names, family.dynamic_params)
+            launch_candidates, dyn_names, family.dynamic_params)
+        if launch_index is not None:
+            for g in groups:
+                g.candidate_indices = launch_index[
+                    np.asarray(g.candidate_indices)]
 
         mesh = build_mesh(config)
         n_task_shards = mesh.shape[mesh_lib.TASK_AXIS]
@@ -780,6 +828,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # exactly like a raising est.fit on the host path (SURVEY §5.3:
         # "error_score must be reimplemented explicitly")
         fit_failed = np.zeros((n_cand, n_folds), bool)
+        fit_failed[preval_failed, :] = True
 
         ckpt = None
         if config.checkpoint_dir:
@@ -856,10 +905,21 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     dtype=dtype, return_train=return_train,
                     test_scores=test_scores, train_scores=train_scores,
                     fit_times=fit_times, score_times=score_times, ckpt=ckpt,
-                    fit_failed=fit_failed)
+                    fit_failed=fit_failed, candidates=candidates)
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
+        if preval_failed.any():
+            # failed fits never ran: sklearn records 0.0 for their times
+            fit_times[preval_failed, :] = 0.0
+            score_times[preval_failed, :] = 0.0
+            if self.verbose > 1:
+                # excluded from every launch -> their END lines (showing
+                # error_score, like sklearn's failed fits) print here
+                self._print_task_end_lines(
+                    candidates, np.flatnonzero(preval_failed), n_folds,
+                    scorer_names, test_scores, train_scores, return_train,
+                    0.0, fit_failed)
 
         # failed-fit accounting, sklearn error_score semantics
         # (_warn_or_raise_about_fit_failures): two detectors feed it —
@@ -912,7 +972,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     fit_masks, mesh, config, n_task_shards, task_shard,
                     max_cand_per_batch, n_folds, dtype, return_train,
                     test_scores, train_scores, fit_times, score_times, ckpt,
-                    fit_failed):
+                    fit_failed, candidates):
         task_batched = hasattr(family, "fit_task_batched")
 
         @jax.jit
@@ -1068,6 +1128,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 report["n_launches"] += 1
                 report["fit_wall_s"] += t_fit
                 report["score_wall_s"] += t_score
+                if self.verbose > 1:
+                    self._print_task_end_lines(
+                        candidates, idx, n_folds, scorer_names,
+                        test_scores, train_scores, return_train,
+                        (t_fit + t_score) / ((hi - lo) * n_folds),
+                        fit_failed)
                 if ckpt is not None:
                     ckpt.put(chunk_id, {
                         "test": {s: test_scores[s][idx, :].tolist()
@@ -1078,6 +1144,54 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         "fit_t": t_fit / ((hi - lo) * n_folds),
                         "score_t": t_score / ((hi - lo) * n_folds),
                         "failed": fit_failed[idx, :].tolist()})
+
+    def _print_task_end_lines(self, candidates, idx, n_folds, scorer_names,
+                              test_scores, train_scores, return_train,
+                              t_task, fit_failed):
+        """sklearn's `_fit_and_score` verbose>1 "[CV i/n] END ..." lines,
+        emitted post-launch (compiled tasks execute fused, so per-task
+        lines appear when their launch completes — same completion-report
+        contract as the callback hooks).  Format mirrors the installed
+        sklearn/model_selection/_validation.py:892-915.  Cells already
+        known to be failed fits print error_score (sklearn prints the
+        substituted score, never the garbage the lane computed)."""
+        from joblib.logger import short_format_time
+
+        err = self.error_score if not isinstance(self.error_score, str) \
+            else np.nan
+
+        def cell(scores, gidx, f):
+            return err if fit_failed[gidx, f] else scores[gidx, f]
+
+        for gidx in idx:
+            params = candidates[gidx]
+            params_msg = ", ".join(
+                f"{k}={params[k]}" for k in sorted(params))
+            for f in range(n_folds):
+                progress_msg = (f" {f + 1}/{n_folds}"
+                                if self.verbose > 2 else "")
+                result_msg = params_msg + (";" if params_msg else "")
+                if len(scorer_names) > 1:
+                    for s in sorted(scorer_names):
+                        result_msg += f" {s}: ("
+                        if return_train:
+                            result_msg += ("train="
+                                           f"{cell(train_scores[s], gidx, f):.3f}, ")
+                        result_msg += f"test={cell(test_scores[s], gidx, f):.3f})"
+                else:
+                    s = scorer_names[0]
+                    result_msg += ", score="
+                    if return_train:
+                        result_msg += (
+                            f"(train={cell(train_scores[s], gidx, f):.3f}, "
+                            f"test={cell(test_scores[s], gidx, f):.3f})")
+                    else:
+                        result_msg += f"{cell(test_scores[s], gidx, f):.3f}"
+                result_msg += f" total time={short_format_time(t_task)}"
+                end_msg = f"[CV{progress_msg}] END "
+                end_msg += "." * max(0, 80 - len(end_msg) - len(result_msg))
+                end_msg += result_msg
+                print(end_msg)
 
     # ------------------------------------------------------------------
     # Tier B: host fallback (full sklearn generality)

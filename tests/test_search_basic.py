@@ -33,13 +33,14 @@ def _expected_keys(n_splits, scorer="score", train=False):
 class TestGridSearchLogReg:
     def test_matches_sklearn_oracle(self, digits):
         X, y = digits
+        X, y = X[:900], y[:900]
         grid = {"C": [0.01, 0.1, 1.0, 10.0]}
         cv = StratifiedKFold(n_splits=3)
 
         ours = sst.GridSearchCV(
-            SkLogReg(max_iter=200), grid, cv=cv).fit(X, y)
+            SkLogReg(max_iter=120), grid, cv=cv).fit(X, y)
         theirs = SkGridSearchCV(
-            SkLogReg(max_iter=200), grid, cv=cv).fit(X, y)
+            SkLogReg(max_iter=120), grid, cv=cv).fit(X, y)
 
         a = ours.cv_results_["mean_test_score"]
         b = theirs.cv_results_["mean_test_score"]
@@ -242,6 +243,55 @@ class TestSparseInput:
         assert gs.best_score_ > 0.4
 
 
+class TestParamPrevalidation:
+    def test_invalid_static_value_gets_error_score(self, digits):
+        """A candidate whose static param would crash tracing (SVC
+        degree='junk') is excluded from the launch and recorded as a
+        failed fit — the valid candidates still run compiled."""
+        from sklearn.svm import SVC
+        X, y = digits
+        m = y < 2
+        with pytest.warns(Warning):
+            gs = sst.GridSearchCV(
+                SVC(), {"degree": [3, "junk"]}, cv=3, backend="tpu",
+                error_score=np.nan, refit=False).fit(X[m][:150], y[m][:150])
+        scores = gs.cv_results_["mean_test_score"]
+        good = gs.cv_results_["param_degree"] == 3
+        assert np.isfinite(scores[good]).all()
+        assert np.isnan(scores[~good]).all()
+        assert gs.cv_results_["mean_score_time"][~good][0] == 0.0
+
+    def test_error_score_raise_no_fallback(self, digits):
+        """error_score='raise' with an invalid candidate raises sklearn's
+        own exception, with NO fall-back-to-host warning or host re-run."""
+        from sklearn.svm import LinearSVC
+        from sklearn.utils._param_validation import InvalidParameterError
+        X, y = digits
+        m = y < 2
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error", UserWarning)
+            with pytest.raises(InvalidParameterError):
+                sst.GridSearchCV(
+                    LinearSVC(), {"C": [-1.0, 1.0]}, cv=3,
+                    error_score="raise").fit(X[m][:120], y[m][:120])
+
+    def test_verbose_end_lines_show_error_score(self, digits, capsys):
+        """verbose>1 END lines print error_score for failed candidates,
+        not the garbage a degenerate lane computed."""
+        from sklearn.svm import LinearSVC
+        X, y = digits
+        m = y < 2
+        with pytest.warns(Warning):
+            sst.GridSearchCV(
+                LinearSVC(), {"C": [0.0, 1.0]}, cv=3, verbose=2,
+                error_score=np.nan, refit=False).fit(X[m][:120], y[m][:120])
+        out = capsys.readouterr().out
+        assert out.count("score=nan") == 3          # the C=0 candidate
+        assert len([ln for ln in out.splitlines()
+                    if "] END" in ln]) == 6         # 2 candidates x 3 folds
+
+
 class TestMoreOracles:
     def test_linear_regression_rank_deficient_min_norm(self):
         """On rank-deficient X the compiled OLS must return sklearn's
@@ -295,8 +345,11 @@ class TestMoreOracles:
         assert gs.cv_results_["mean_test_score"][0] > 0.8
 
     def test_compiled_error_score_raise(self, digits):
+        # C=nan fails sklearn's own param validation, which the compiled
+        # tier now reproduces host-side (round-2 prevalidation): the
+        # exception is sklearn's InvalidParameterError, as on the host path
         X, y = digits
-        with pytest.raises(ValueError, match="non-finite"):
+        with pytest.raises(ValueError, match="parameter of LogisticRegr"):
             sst.GridSearchCV(
                 SkLogReg(max_iter=50), {"C": [float("nan")]}, cv=3,
                 backend="tpu", error_score="raise", refit=False).fit(X, y)

@@ -21,8 +21,11 @@ class TestSVC:
         assert abs(ours.best_score_ - theirs.best_score_) < 0.03
 
     def test_multiclass_grid_close_to_sklearn(self, digits):
+        # 6 classes keep the one-vs-one structure (15 pairs) while costing
+        # ~1/3 of the full 10-class 45-pair problem on the 1-core CPU mesh
         X, y = digits
-        Xs, ys = X[:500], y[:500]
+        m = y < 6
+        Xs, ys = X[m][:300], y[m][:300]
         grid = {"C": [0.5, 5.0], "gamma": [0.01, 0.05]}
         ours = sst.GridSearchCV(
             SVC(kernel="rbf"), grid, cv=3, backend="tpu").fit(Xs, ys)
@@ -35,7 +38,8 @@ class TestSVC:
 
     def test_linear_kernel(self, digits):
         X, y = digits
-        Xs, ys = X[:300], y[:300]
+        m = y < 6
+        Xs, ys = X[m][:200], y[m][:200]
         gs = sst.GridSearchCV(
             SVC(kernel="linear"), {"C": [1.0]}, cv=3,
             backend="tpu").fit(Xs, ys)
@@ -43,7 +47,8 @@ class TestSVC:
 
     def test_gamma_scale_static(self, digits):
         X, y = digits
-        Xs, ys = X[:300], y[:300]
+        m = y < 6
+        Xs, ys = X[m][:200], y[m][:200]
         gs = sst.GridSearchCV(
             SVC(), {"C": [1.0, 10.0]}, cv=3, backend="tpu").fit(Xs, ys)
         assert gs.best_score_ > 0.85

@@ -1,0 +1,131 @@
+"""SVR / LinearSVC / LinearSVR compiled-family tests vs sklearn oracles."""
+
+import numpy as np
+import pytest
+from sklearn.svm import SVR, LinearSVC, LinearSVR
+
+import spark_sklearn_tpu as sst
+
+
+class TestSVR:
+    def test_rbf_grid_close_to_sklearn(self, diabetes):
+        X, y = diabetes
+        Xs, ys = X[:200], ((y - y.mean()) / y.std()).astype(np.float32)[:200]
+        grid = {"C": [0.5, 2.0], "epsilon": [0.05, 0.2]}
+        ours = sst.GridSearchCV(
+            SVR(kernel="rbf"), grid, cv=3, backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(
+            SVR(kernel="rbf"), grid, cv=3, backend="host").fit(Xs, ys)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.05)
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_linear_kernel_and_gamma(self, diabetes):
+        X, y = diabetes
+        Xs, ys = X[:150], ((y - y.mean()) / y.std()).astype(np.float32)[:150]
+        ours = sst.GridSearchCV(
+            SVR(kernel="linear"), {"C": [1.0]}, cv=3,
+            backend="tpu").fit(Xs, ys)
+        theirs = sst.GridSearchCV(
+            SVR(kernel="linear"), {"C": [1.0]}, cv=3,
+            backend="host").fit(Xs, ys)
+        assert abs(ours.best_score_ - theirs.best_score_) < 0.05
+
+    def test_pipeline_svr_stays_compiled(self, diabetes):
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+        X, y = diabetes
+        Xs, ys = X[:150], ((y - y.mean()) / y.std()).astype(np.float32)[:150]
+        pipe = Pipeline([("sc", StandardScaler()), ("svr", SVR())])
+        ours = sst.GridSearchCV(
+            pipe, {"svr__C": [0.5, 2.0]}, cv=3, backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(
+            pipe, {"svr__C": [0.5, 2.0]}, cv=3, backend="host").fit(Xs, ys)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.05)
+
+    def test_precomputed_falls_back(self, diabetes):
+        X, y = diabetes
+        Xs = X[:80]
+        K = np.asarray(Xs @ Xs.T)
+        gs = sst.GridSearchCV(
+            SVR(kernel="precomputed"), {"C": [1.0]}, cv=3).fit(K, y[:80])
+        assert gs.search_report["backend"] == "host"
+
+
+class TestLinearSVC:
+    def test_binary_close_to_sklearn(self, digits):
+        X, y = digits
+        m = y < 2
+        Xb, yb = X[m][:200], y[m][:200]
+        ours = sst.GridSearchCV(
+            LinearSVC(), {"C": [0.1, 1.0]}, cv=3, backend="tpu").fit(Xb, yb)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(
+            LinearSVC(), {"C": [0.1, 1.0]}, cv=3, backend="host").fit(Xb, yb)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.03)
+
+    def test_multiclass_ovr_close_to_sklearn(self, digits):
+        X, y = digits
+        m = y < 5
+        Xs, ys = X[m][:250], y[m][:250]
+        ours = sst.GridSearchCV(
+            LinearSVC(), {"C": [1.0]}, cv=3, backend="tpu").fit(Xs, ys)
+        theirs = sst.GridSearchCV(
+            LinearSVC(), {"C": [1.0]}, cv=3, backend="host").fit(Xs, ys)
+        assert abs(ours.best_score_ - theirs.best_score_) < 0.03
+        assert ours.best_score_ > 0.9
+
+    def test_hinge_loss_falls_back_to_host(self, digits):
+        X, y = digits
+        m = y < 2
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                LinearSVC(loss="hinge"), {"C": [1.0]},
+                cv=3).fit(X[m][:120], y[m][:120])
+        assert gs.search_report["backend"] == "host"
+
+    def test_keyed_linear_svc_fleet(self):
+        import pandas as pd
+        rng = np.random.default_rng(3)
+        df = pd.DataFrame({
+            "k": np.repeat(["a", "b"], 60),
+            "x": [rng.normal(size=3) for _ in range(120)],
+        })
+        df["y"] = np.where(
+            np.repeat([1.0, -1.0], 60) * [v[0] for v in df.x] > 0,
+            "pos", "neg")
+        km = sst.KeyedEstimator(
+            sklearnEstimator=LinearSVC(), keyCols=["k"], xCol="x",
+            yCol="y").fit(df)
+        assert km.backend == "tpu"
+        out = km.transform(df)
+        assert np.mean(out["output"] == df["y"]) > 0.9
+
+
+class TestLinearSVR:
+    def test_squared_eps_close_to_sklearn(self, diabetes):
+        X, y = diabetes
+        yn = ((y - y.mean()) / y.std()).astype(np.float32)
+        est = LinearSVR(loss="squared_epsilon_insensitive", max_iter=2000)
+        grid = {"C": [0.5, 2.0], "epsilon": [0.0, 0.1]}
+        ours = sst.GridSearchCV(est, grid, cv=3, backend="tpu").fit(X, yn)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(est, grid, cv=3, backend="host").fit(X, yn)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.05)
+
+    def test_default_nonsmooth_falls_back(self, diabetes):
+        X, y = diabetes
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                LinearSVR(max_iter=2000), {"C": [1.0]}, cv=3).fit(
+                X[:150], y[:150])
+        assert gs.search_report["backend"] == "host"

@@ -64,29 +64,29 @@ class TestGBDT:
 class TestRandomForest:
     def test_rfc_close_to_sklearn(self, digits):
         X, y = digits
-        Xs, ys = X[:400], y[:400]
+        Xs, ys = X[:250], y[:250]
         ours = sst.GridSearchCV(
-            RandomForestClassifier(n_estimators=25, random_state=0),
+            RandomForestClassifier(n_estimators=12, random_state=0),
             {"max_depth": [5]}, cv=3, backend="tpu").fit(Xs, ys)
         theirs = sst.GridSearchCV(
-            RandomForestClassifier(n_estimators=25, random_state=0),
+            RandomForestClassifier(n_estimators=12, random_state=0),
             {"max_depth": [5]}, cv=3, backend="host").fit(Xs, ys)
-        assert abs(ours.best_score_ - theirs.best_score_) < 0.07
-        assert ours.best_score_ > 0.80
+        assert abs(ours.best_score_ - theirs.best_score_) < 0.08
+        assert ours.best_score_ > 0.75
 
     def test_rfc_randomized_search_config3_shape(self, digits):
         """Config #3 shape: RandomizedSearchCV over (n_estimators,
         max_depth)."""
         from scipy.stats import randint
         X, y = digits
-        Xs, ys = X[:300], y[:300]
+        Xs, ys = X[:240], y[:240]
         rs = sst.RandomizedSearchCV(
             RandomForestClassifier(random_state=0),
-            {"n_estimators": randint(10, 30),
+            {"n_estimators": randint(8, 16),
              "max_depth": randint(3, 5)},
-            n_iter=4, cv=3, random_state=7, backend="tpu").fit(Xs, ys)
+            n_iter=3, cv=3, random_state=7, backend="tpu").fit(Xs, ys)
         assert np.all(np.isfinite(rs.cv_results_["mean_test_score"]))
-        assert rs.best_score_ > 0.75
+        assert rs.best_score_ > 0.7
 
     def test_rfr_regression(self, diabetes):
         X, y = diabetes
