@@ -261,59 +261,61 @@ def glm_lbfgs_batched(
         bad = dginit >= 0
         p = jnp.where(bad[:, None], -g, p)
         dginit = jnp.where(bad, -jnp.sum(g * g, axis=1), dginit)
+        # a lane whose direction went non-finite (overflowed gradient or
+        # history) is frozen this iteration: p=0 keeps x/Z exact under
+        # x + alpha*p, where alpha*non-finite would be NaN and poison the
+        # state (the pre-step-masking code preserved the last finite
+        # iterate with where()-guards; this keeps that guarantee)
+        lane_bad = jnp.logical_not(jnp.logical_and(
+            jnp.all(jnp.isfinite(p), axis=1), jnp.isfinite(dginit)))
+        p = jnp.where(lane_bad[:, None], 0.0, p)
+        dginit = jnp.where(lane_bad, 0.0, dginit)
 
         a0 = jnp.where(
             it == 0,
             jnp.minimum(jnp.ones((B,), dtype), 1.0 / (gnorm(g) + eps)),
             jnp.ones((B,), dtype))
 
-        # --- matmul-free backtracking line search -------------------------
-        # Z moves linearly along p, so each trial is elementwise on
-        # Zx + a*Zp; lanes halve independently and the loop exits as soon
-        # as EVERY live lane has an accepted step (almost always the very
-        # first trial), instead of paying all ls_trials evaluations
+        # --- matmul-free, single-pass backtracking line search ------------
+        # Z moves linearly along p, so a trial is elementwise on
+        # Zx + a*Zp.  A sequential halving loop with an all-lanes early
+        # exit is a trap at large B: ONE stubborn lane forces EVERY lane
+        # through all trials, each a full Z-sized memory pass (profiled at
+        # ~14 passes/iteration on the 5000-lane digits grid — line search
+        # was most of the solver).  Instead evaluate ALL ls_trials
+        # candidate steps in one fused pass: vmap over the trial axis
+        # turns the halvings into register-level compute over a single
+        # read of (Z, Zp), then each lane picks its largest passing step.
         Zp = Ax(p)                                   # the ONE forward matmul
 
         def eval_trial(a):
             Zt = Z + _bcast(a, Z) * Zp
             return data_loss(Zt) + reg_loss(x + a[:, None] * p)
 
-        f0_try = eval_trial(a0)
-        found0 = jnp.logical_or(f0_try <= f + c1 * a0 * dginit, st["done"])
+        halvings = 0.5 ** jnp.arange(ls_trials, dtype=dtype)
+        alphas = a0[None, :] * halvings[:, None]            # (T, B)
+        losses = jax.vmap(eval_trial)(alphas)               # (T, B)
+        armijo = losses <= f[None, :] + c1 * alphas * dginit[None, :]
+        # first (largest-step) passing trial per lane; no trial passed ->
+        # take the last (smallest) step rather than stall
+        first_ok = jnp.argmax(armijo, axis=0)               # (B,)
+        found = jnp.any(armijo, axis=0)
+        pick = jnp.where(found, first_ok, ls_trials - 1)
+        alpha = jnp.take_along_axis(alphas, pick[None, :], axis=0)[0]
+        f_pick = jnp.take_along_axis(losses, pick[None, :], axis=0)[0]
 
-        def ls_cond(carry):
-            a, best_a, found, t = carry
-            return jnp.logical_and(t < ls_trials,
-                                   jnp.logical_not(jnp.all(found)))
-
-        def ls_body(carry):
-            a, best_a, found, t = carry
-            a = jnp.where(found, a, a * 0.5)
-            ft = eval_trial(a)
-            ok = ft <= f + c1 * a * dginit
-            newly = jnp.logical_and(ok, jnp.logical_not(found))
-            best_a = jnp.where(newly, a, best_a)
-            return a, best_a, jnp.logical_or(found, newly), t + 1
-
-        _, alpha, found, _ = lax.while_loop(
-            ls_cond, ls_body,
-            (a0, jnp.where(found0, a0, 0.0), found0,
-             jnp.asarray(1, jnp.int32)))
-        # no trial passed: take the last (smallest) step rather than stall
-        alpha = jnp.where(found, alpha,
-                          a0 * (0.5 ** (ls_trials - 1)))
-
+        # mask the STEP, not the state: dead lanes (done, or a non-finite
+        # trial loss) take alpha=0, so x_new == x and Z_new == Z exactly
+        # and g_new recomputes to the same value — no Z-sized select
+        # passes (profiled at ~4ms/iteration of pure bandwidth)
+        live = jnp.logical_and(jnp.isfinite(f_pick),
+                               jnp.logical_not(st["done"]))
+        alpha = jnp.where(live, alpha, 0.0)
         x_new = x + alpha[:, None] * p
         Z_new = Z + _bcast(alpha, Z) * Zp
-        f_new = full_f(x_new, Z_new)
+        # the picked trial's loss IS full_f(x_new, Z_new): reuse, no pass
+        f_new = jnp.where(live, f_pick, f)
         g_new = full_grad(x_new, Z_new)              # the ONE backward matmul
-
-        ok = jnp.isfinite(f_new)
-        live = jnp.logical_and(ok, jnp.logical_not(st["done"]))
-        x_new = jnp.where(live[:, None], x_new, x)
-        Z_new = jnp.where(_bcast(live, Z), Z_new, Z)
-        f_new = jnp.where(live, f_new, f)
-        g_new = jnp.where(live[:, None], g_new, g)
 
         s = x_new - x
         yv = g_new - g

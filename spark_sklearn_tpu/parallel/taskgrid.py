@@ -102,18 +102,40 @@ def build_compile_groups(
     return out
 
 
-def _hashable(v: Any):
-    if isinstance(v, (list, tuple)):
-        return tuple(_hashable(x) for x in v)
-    if isinstance(v, np.ndarray):
-        return (v.shape, v.tobytes())
+def freeze(v: Any, strict: bool = False):
+    """Recursively hashable view of nested params/arrays.
+
+    Shared by compile-group keying (repr fallback: grouping by repr of an
+    exotic value is safe — worst case two groups that could have been
+    one) and the search's cross-search program cache (`strict=True`:
+    raises TypeError so unkeyable captures skip the cache instead of
+    aliasing).  Object-dtype ndarrays hash by ELEMENT — ``tobytes()`` on
+    them is raw PyObject pointers, and a recycled address would alias two
+    different values."""
     if isinstance(v, dict):
-        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+        return tuple(sorted((str(k), freeze(x, strict))
+                            for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return ("__seq__",) + tuple(freeze(x, strict) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return ("__set__",) + tuple(
+            sorted((freeze(x, strict) for x in v), key=repr))
+    if isinstance(v, np.ndarray):
+        if v.dtype == object:
+            return ("__ndo__", v.shape,
+                    tuple(freeze(x, strict) for x in v.ravel().tolist()))
+        return ("__nd__", v.shape, str(v.dtype), v.tobytes())
     try:
         hash(v)
         return v
     except TypeError:
+        if strict:
+            raise
         return repr(v)
+
+
+def _hashable(v: Any):
+    return freeze(v)
 
 
 def build_fold_masks(

@@ -71,6 +71,56 @@ logger = logging.getLogger("spark_sklearn_tpu.search")
 _nullcontext = _contextlib.nullcontext
 
 
+def _freeze(obj):
+    """Strict hashable view for program-cache keys (shared helper in
+    parallel/taskgrid.py); raises TypeError for unkeyable values."""
+    from spark_sklearn_tpu.parallel.taskgrid import freeze
+    return freeze(obj, strict=True)
+
+
+_PROGRAM_CACHE: Dict[Any, Any] = {}
+_PROGRAM_CACHE_MAX = 128
+
+
+def _cached_program(key, build):
+    """Cross-search cache of jitted callables.
+
+    The fit/score programs are built from per-search closures, so without
+    this every search re-traces and re-lowers programs jax has already
+    compiled (~0.7 s per search at bench scale even with a warm persistent
+    compile cache — the XLA binary is cached, the python->jaxpr->HLO walk
+    is not).  Keyed by everything the closures capture; jax.jit's own
+    cache below handles shapes/dtypes.  Unkeyable captures (e.g. a fresh
+    user lambda) just skip the cache.
+    """
+    try:
+        k = _freeze(key)
+    except TypeError:
+        return build()
+    fn = _PROGRAM_CACHE.get(k)
+    if fn is None:
+        if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        fn = build()
+        _PROGRAM_CACHE[k] = fn
+    return fn
+
+
+@jax.jit
+def _models_health(models):
+    """(nc_batch, n_folds) True where any inexact model leaf went NaN —
+    the compiled-tier analog of est.fit raising.  inf is NOT flagged:
+    families use inf sentinels legitimately (e.g. tree split
+    thresholds)."""
+    bad = None
+    for leaf in jax.tree_util.tree_leaves(models):
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            continue
+        b = jnp.isnan(leaf).any(axis=tuple(range(2, leaf.ndim)))
+        bad = b if bad is None else (bad | b)
+    return bad
+
+
 def _looks_like_estimator(obj) -> bool:
     return hasattr(obj, "get_params") and (
         hasattr(obj, "fit") or hasattr(obj, "predict"))
@@ -618,6 +668,98 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         finally:
             jax.config.update("jax_enable_x64", prev_x64)
 
+    def _prevalidate_candidates(self, candidates):
+        """Host-side per-candidate hyperparameter validation (sklearn
+        raises InvalidParameterError inside fit(); the compiled solvers
+        accept any finite value, so the failure is reproduced here).
+
+        Fast path: sklearn's ``_validate_params`` checks each declared
+        param independently against the class's declarative
+        ``_parameter_constraints``, so a candidate only needs its CHANGED
+        keys re-checked against the owning (sub-)estimator's constraints —
+        the unchanged rest was validated once on the base clone.  A
+        clone-per-candidate loop (the previous implementation) costs ~1 ms
+        per candidate, which at bench scale (1000 candidates) was ~25% of
+        the whole warm search.  Candidates that rewire sub-estimators
+        (estimator-valued values) fall back to the full clone+validate.
+        """
+        n_cand = len(candidates)
+        failed = np.zeros(n_cand, bool)
+        first_exc = None
+
+        def validate_all(cand):
+            if hasattr(cand, "_validate_params"):
+                cand._validate_params()
+            for sub in cand.get_params(deep=True).values():
+                if hasattr(sub, "_validate_params") and \
+                        hasattr(sub, "get_params"):
+                    sub._validate_params()
+
+        try:
+            from sklearn.utils._param_validation import (
+                validate_parameter_constraints)
+        except ImportError:            # future sklearn moved it: slow path
+            validate_parameter_constraints = None
+
+        base = clone(self.estimator)
+        base_exc = None
+        try:
+            validate_all(base)
+        except Exception as exc:
+            base_exc = exc
+        deep = base.get_params(deep=True)
+
+        def rewires(params):
+            return any(
+                hasattr(v, "get_params") or (
+                    isinstance(v, (list, tuple))
+                    and any(hasattr(e, "get_params") for e in v))
+                for v in params.values())
+
+        def validate_fast(params):
+            """Check only the candidate's changed values against their
+            owners' declarative constraints (what _validate_params does
+            per key); keys are already known to exist in `deep`."""
+            for k, v in params.items():
+                if "__" in k:
+                    prefix, bare = k.rsplit("__", 1)
+                    owner = deep.get(prefix)
+                else:
+                    owner, bare = base, k
+                constraints = getattr(owner, "_parameter_constraints", None)
+                if constraints and bare in constraints:
+                    validate_parameter_constraints(
+                        {bare: constraints[bare]}, {bare: v},
+                        caller_name=type(owner).__name__)
+
+        for ci, params in enumerate(candidates):
+            # base_exc disables the fast path entirely: a candidate may
+            # OVERRIDE the base's invalid value with a valid one, which
+            # only the real clone+set_params+validate can decide
+            fast = validate_parameter_constraints is not None \
+                and base_exc is None and not rewires(params)
+            if fast and any(k not in deep for k in params):
+                fast = False           # key may be unknown: let set_params
+                                       # produce its own (aborting) error
+            cand = None
+            if not fast:
+                # unknown param KEYS abort the whole search (set_params
+                # raises OUTSIDE the try), exactly as before
+                cand = clone(self.estimator).set_params(**params)
+            exc = None
+            try:
+                if fast:
+                    validate_fast(params)
+                else:
+                    validate_all(cand)
+            except Exception as e:
+                exc = e
+            if exc is not None:
+                failed[ci] = True
+                if first_exc is None:
+                    first_exc = exc
+        return failed, first_exc
+
     def _fit_compiled_impl(self, family, X, y, candidates, splits, config,
                            fit_weight=None, score_weight=None,
                            dtype_override=None):
@@ -721,21 +863,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # like a raising est.fit (upstream test_search_cv_timing).
         # set_params stays outside the try: unknown param KEYS abort the
         # whole search, as in sklearn.
-        preval_failed = np.zeros(n_cand, bool)
-        preval_exc = None
-        for ci, params in enumerate(candidates):
-            cand = clone(self.estimator).set_params(**params)
-            try:
-                if hasattr(cand, "_validate_params"):
-                    cand._validate_params()
-                for sub in cand.get_params(deep=True).values():
-                    if hasattr(sub, "_validate_params") and \
-                            hasattr(sub, "get_params"):
-                        sub._validate_params()
-            except Exception as exc:
-                preval_failed[ci] = True
-                if preval_exc is None:
-                    preval_exc = exc
+        preval_failed, preval_exc = self._prevalidate_candidates(candidates)
         if preval_exc is not None and isinstance(self.error_score, str) \
                 and self.error_score == "raise":
             # marker consumed by _dispatch: re-raise instead of the usual
@@ -944,6 +1072,22 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 raise ValueError(
                     f"{n_bad} fits failed with non-finite parameters and "
                     "error_score='raise'")
+            if fit_failed.all():
+                # sklearn's _warn_or_raise_about_fit_failures raises when
+                # EVERY fit failed, even with a numeric error_score (the
+                # host tier inherits this from sklearn directly).  Only
+                # host-reproducible failures (invalid params caught by
+                # prevalidation) suppress the host fallback: an all-NaN
+                # outcome from the float32 device solvers might still
+                # succeed under sklearn's float64 host fits
+                all_failed = ValueError(
+                    f"\nAll the {n_cand * n_folds} fits failed.\n"
+                    "It is very likely that your model is misconfigured.\n"
+                    "You can try to debug the error by setting "
+                    "error_score='raise'.")
+                if preval_failed.all():
+                    all_failed._sst_no_fallback = True
+                raise all_failed
             from sklearn.exceptions import FitFailedWarning
             warnings.warn(
                 f"\n{n_bad} fits failed out of a total of "
@@ -974,21 +1118,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     test_scores, train_scores, fit_times, score_times, ckpt,
                     fit_failed, candidates):
         task_batched = hasattr(family, "fit_task_batched")
-
-        @jax.jit
-        def health_jit(models):
-            """(nc_batch, n_folds) True where any inexact model leaf went
-            NaN — the compiled-tier analog of est.fit raising.  inf is NOT
-            flagged: families use inf sentinels legitimately (e.g. tree
-            split thresholds)."""
-            bad = None
-            for leaf in jax.tree_util.tree_leaves(models):
-                if not jnp.issubdtype(leaf.dtype, jnp.inexact):
-                    continue
-                b = jnp.isnan(leaf).any(
-                    axis=tuple(range(2, leaf.ndim)))
-                bad = b if bad is None else (bad | b)
-            return bad
+        health_jit = _models_health
         if config.n_data_shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
             tb_mask_shard = NamedSharding(
@@ -1018,7 +1148,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         lambda l: l.reshape(
                             (nc_batch, n_folds) + l.shape[1:]), model)
 
-                fit_jit = jax.jit(fit_batch_tb)
+                fit_jit = _cached_program(
+                    ("fit_tb", family, static, meta, nc_batch, n_folds,
+                     bool(config.bf16_matmul)),
+                    lambda: jax.jit(fit_batch_tb))
 
             def fit_batch(dyn_arrs, data_d, train_m, static=static):
                 def one_cand(dyn_scalars):
@@ -1047,8 +1180,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 return jax.vmap(one_cand)(models)
 
             if not task_batched:
-                fit_jit = jax.jit(fit_batch, out_shardings=task_shard)
-            score_jit = jax.jit(score_batch)
+                fit_jit = _cached_program(
+                    ("fit", family, static, meta, mesh),
+                    lambda: jax.jit(fit_batch, out_shardings=task_shard))
+            score_jit = _cached_program(
+                ("score", family, static, meta,
+                 tuple(sorted(scorers.items())), return_train, sw_blind),
+                lambda: jax.jit(score_batch))
 
             for lo in range(0, nc, nc_batch):
                 hi = min(lo + nc_batch, nc)

@@ -276,6 +276,30 @@ class TestParamPrevalidation:
                     LinearSVC(), {"C": [-1.0, 1.0]}, cv=3,
                     error_score="raise").fit(X[m][:120], y[m][:120])
 
+    def test_candidate_overrides_invalid_base_param(self, digits):
+        """A candidate that OVERRIDES the base estimator's invalid value
+        with a valid one must fit normally (sklearn clones + set_params
+        before validating, so the base's C=-1 never reaches fit)."""
+        from sklearn.svm import LinearSVC
+        X, y = digits
+        m = y < 2
+        gs = sst.GridSearchCV(
+            LinearSVC(C=-1.0), {"C": [0.5, 1.0]}, cv=3,
+            error_score=np.nan, refit=False).fit(X[m][:150], y[m][:150])
+        assert np.isfinite(gs.cv_results_["mean_test_score"]).all()
+
+    def test_all_candidates_invalid_raises(self, digits):
+        """When EVERY fit fails prevalidation, the search raises like
+        sklearn's _warn_or_raise_about_fit_failures — even with a
+        numeric error_score."""
+        from sklearn.svm import LinearSVC
+        X, y = digits
+        m = y < 2
+        with pytest.raises(ValueError, match="All the .* fits failed"):
+            sst.GridSearchCV(
+                LinearSVC(), {"C": [-1.0, -2.0]}, cv=3,
+                error_score=np.nan, refit=False).fit(X[m][:120], y[m][:120])
+
     def test_verbose_end_lines_show_error_score(self, digits, capsys):
         """verbose>1 END lines print error_score for failed candidates,
         not the garbage a degenerate lane computed."""
