@@ -10,10 +10,14 @@ matmuls instead of Python-loop epochs.
 Numeric conventions follow sklearn's MLP (_multilayer_perceptron.py):
 Glorot-uniform init, softmax/logistic output, mean cross-entropy (or 0.5*MSE
 for regression) plus alpha*0.5*||W||^2/batch_n regularisation, default
-batch_size=min(200, n), constant learning rate.  Early stopping and
-adaptive/invscaling schedules are not compiled (they raise -> the search
-falls back to the host path).  Training runs the full `max_iter` epochs —
-inside one fused program that is cheaper than dynamic stopping would be.
+batch_size=min(200, n), and sklearn's stopping rules compiled into a
+`lax.while_loop` over epochs: training-loss plateau (`tol` /
+`n_iter_no_change`), validation-score early stopping with best-weight
+restore (`early_stopping=True` holds out `validation_fraction` of the
+train-fold rows via a PRNG-derived held-out mask — same semantics as
+sklearn's train_test_split, not the same row indices), and the sgd
+`invscaling` / `adaptive` learning-rate schedules.  Under `vmap` the
+while_loop runs until every candidate lane has stopped.
 """
 
 from __future__ import annotations
@@ -61,14 +65,13 @@ def _forward(params, X, act):
 
 
 def _check_supported(static):
-    if static.get("early_stopping", False):
-        raise ValueError("early_stopping is not compiled; use backend='host'")
-    if static.get("learning_rate", "constant") != "constant":
-        raise ValueError(
-            "learning_rate schedules are not compiled; use backend='host'")
     solver = static.get("solver", "adam")
     if solver not in ("adam", "sgd"):
         raise ValueError(f"solver={solver!r} is not compiled")
+    if static.get("learning_rate", "constant") not in (
+            "constant", "invscaling", "adaptive"):
+        raise ValueError(
+            f"learning_rate={static.get('learning_rate')!r} is not compiled")
 
 
 class MLPClassifierFamily(Family):
@@ -102,6 +105,11 @@ class MLPClassifierFamily(Family):
     @classmethod
     def fit(cls, dynamic, static, data, train_w, meta):
         _check_supported(static)
+        # device arrays throughout: minibatch rows are gathered by TRACED
+        # permutation indices, which numpy inputs (a direct family.fit
+        # call outside the engine) cannot serve
+        data = {k: jnp.asarray(v) for k, v in data.items()}
+        train_w = jnp.asarray(train_w)
         X = data["X"]
         n, d = X.shape
         dtype = X.dtype
@@ -151,14 +159,12 @@ class MLPClassifierFamily(Family):
             l2 = sum(jnp.sum(layer["W"] ** 2) for layer in p)
             return data_loss + 0.5 * a * l2 / wsum
 
-        grad_fn = jax.grad(batch_loss)
-
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         if solver == "adam":
             opt_state = {"m": zeros, "v": zeros,
                          "t": jnp.asarray(0.0, dtype)}
 
-            def update(p, g, st):
+            def update(p, g, st, lr_eff):
                 t = st["t"] + 1.0
                 m = jax.tree_util.tree_map(
                     lambda m_, g_: b1 * m_ + (1 - b1) * g_, st["m"], g)
@@ -169,24 +175,61 @@ class MLPClassifierFamily(Family):
                 vhat = jax.tree_util.tree_map(
                     lambda v_: v_ / (1 - b2 ** t), v)
                 p_new = jax.tree_util.tree_map(
-                    lambda p_, mh, vh: p_ - lr * mh /
+                    lambda p_, mh, vh: p_ - lr_eff * mh /
                     (jnp.sqrt(vh) + eps_adam), p, mhat, vhat)
                 return p_new, {"m": m, "v": v, "t": t}
         else:  # sgd with momentum
             opt_state = {"vel": zeros}
 
-            def update(p, g, st):
+            def update(p, g, st, lr_eff):
                 vel = jax.tree_util.tree_map(
-                    lambda v_, g_: momentum * v_ - lr * g_, st["vel"], g)
+                    lambda v_, g_: momentum * v_ - lr_eff * g_, st["vel"], g)
                 p_new = jax.tree_util.tree_map(
                     lambda p_, v_: p_ + v_, p, vel)
                 return p_new, {"vel": vel}
 
-        def epoch(carry, ek):
-            p, st = carry
+        # ---- sklearn stopping semantics (while_loop over epochs) ---------
+        tol = float(static.get("tol", 1e-4))
+        n_iter_no_change = int(static.get("n_iter_no_change", 10))
+        early_stopping = bool(static.get("early_stopping", False))
+        lr_schedule = static.get("learning_rate", "constant")
+        power_t = float(static.get("power_t", 0.5))
+        val_frac = float(static.get("validation_fraction", 0.1))
+
+        if early_stopping:
+            # hold out ~validation_fraction of the TRAIN-FOLD rows with a
+            # PRNG mask: same semantics as sklearn's train_test_split
+            # (score a held-out slice each epoch, restore best weights),
+            # independent of the fold mask so every task shares one split
+            key, vkey = jax.random.split(key)
+            val_sel = (jax.random.uniform(vkey, (n,)) < val_frac).astype(
+                dtype)
+            fit_w = train_w * (1.0 - val_sel)
+            val_w = train_w * val_sel
+        else:
+            fit_w = train_w
+            val_w = None
+
+        # sklearn advances its invscaling clock by the number of rows the
+        # net actually trains on per epoch — the train-fold subset, minus
+        # the early-stopping validation hold-out — not the full dataset
+        n_fit_rows = jnp.sum((fit_w > 0).astype(dtype))
+
+        def epoch_lr(it):
+            """sklearn's SGDOptimizer.iteration_ends: lr fixed within an
+            epoch, rescaled from the count of samples seen (invscaling);
+            adam ignores schedules like sklearn's AdamOptimizer."""
+            if solver != "sgd" or lr_schedule != "invscaling":
+                return lr
+            # epoch 0 runs at lr_init (sklearn decays AFTER each epoch,
+            # from the count of samples seen so far)
+            t_seen = it.astype(dtype) * n_fit_rows
+            return lr / (t_seen + 1.0) ** power_t
+
+        def run_epoch(p, st, ek, lr_eff):
+            perm = jax.random.permutation(ek, n)
             # pad with index 0 at ZERO weight (a modulo wrap would silently
             # double-count wrapped samples at full weight)
-            perm = jax.random.permutation(ek, n)
             idx_pad = jnp.concatenate(
                 [perm, jnp.zeros((n_pad - n,), perm.dtype)])
             wmul = jnp.concatenate(
@@ -195,19 +238,92 @@ class MLPClassifierFamily(Family):
             wmuls = wmul.reshape(n_batches, batch_size)
 
             def one_batch(c, inp):
-                p_, st_ = c
+                p_, st_, acc = c
                 idx, wm = inp
-                w_idx = train_w[idx] * wm
-                g = grad_fn(p_, idx, w_idx, alpha)
-                p_, st_ = update(p_, g, st_)
-                return (p_, st_), None
+                w_idx = fit_w[idx] * wm
+                loss, g = jax.value_and_grad(batch_loss)(
+                    p_, idx, w_idx, alpha)
+                wsum = jnp.maximum(jnp.sum(w_idx), 1.0)
+                p_, st_ = update(p_, g, st_, lr_eff)
+                # sklearn accumulates batch_loss * batch_size / n_total
+                return (p_, st_, acc + loss * wsum), None
 
-            (p, st), _ = jax.lax.scan(one_batch, (p, st), (batches, wmuls))
-            return (p, st), None
+            (p, st, acc), _ = jax.lax.scan(
+                one_batch, (p, st, jnp.asarray(0.0, dtype)),
+                (batches, wmuls))
+            wtot = jnp.maximum(jnp.sum(fit_w), 1.0)
+            return p, st, acc / wtot
 
-        epoch_keys = jax.random.split(key, max_iter)
-        (params, _), _ = jax.lax.scan(epoch, (params, opt_state), epoch_keys)
-        return {"layers": params}
+        def val_score(p):
+            out = _forward(p, X, act)
+            wsum = jnp.maximum(jnp.sum(val_w), jnp.asarray(1e-12, dtype))
+            if cls.is_classifier:
+                pred = jnp.argmax(out, axis=1)
+                return jnp.sum(val_w * (pred == data["y"])) / wsum
+            yt = data["y_target"]
+            err = jnp.sum((out - yt) ** 2, axis=1)
+            resid = jnp.sum(val_w * err) / wsum
+            ym = jnp.sum(val_w[:, None] * yt, axis=0) / wsum
+            tot = jnp.sum(val_w * jnp.sum((yt - ym[None, :]) ** 2,
+                                          axis=1)) / wsum
+            return 1.0 - resid / jnp.maximum(tot,
+                                             jnp.asarray(1e-12, dtype))
+
+        big = jnp.asarray(np.finfo(np.float32).max, dtype)
+        state = dict(
+            p=params, opt=opt_state, key=key,
+            it=jnp.asarray(0, jnp.int32),
+            stop=jnp.asarray(False),
+            # best validation score (early stopping) / best loss (plateau)
+            best_score=-big, best_loss=big,
+            no_improve=jnp.asarray(0, jnp.int32),
+            lr_div=jnp.asarray(1.0, dtype),      # adaptive: lr /= 5 steps
+            best_p=params,
+        )
+
+        def cond(s):
+            return jnp.logical_and(s["it"] < max_iter,
+                                   jnp.logical_not(s["stop"]))
+
+        def body(s):
+            key, ek = jax.random.split(s["key"])
+            lr_eff = epoch_lr(s["it"]) / s["lr_div"]
+            p, opt, loss = run_epoch(s["p"], s["opt"], ek, lr_eff)
+            if early_stopping:
+                score = val_score(p)
+                improved_tol = score >= s["best_score"] + tol
+                is_best = score > s["best_score"]
+                best_score = jnp.where(is_best, score, s["best_score"])
+                best_p = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(is_best, new, old),
+                    p, s["best_p"])
+                best_loss = s["best_loss"]
+            else:
+                improved_tol = loss <= s["best_loss"] - tol
+                best_loss = jnp.minimum(loss, s["best_loss"])
+                best_score = s["best_score"]
+                best_p = s["best_p"]
+            no_improve = jnp.where(improved_tol, 0, s["no_improve"] + 1)
+            trigger = no_improve > n_iter_no_change
+            if solver == "sgd" and lr_schedule == "adaptive":
+                # sklearn: divide lr by 5 and keep going; stop once the
+                # effective lr has decayed below 1e-6
+                can_decay = lr_eff / 5.0 > 1e-6
+                lr_div = jnp.where(jnp.logical_and(trigger, can_decay),
+                                   s["lr_div"] * 5.0, s["lr_div"])
+                stop = jnp.logical_and(trigger,
+                                       jnp.logical_not(can_decay))
+                no_improve = jnp.where(trigger, 0, no_improve)
+            else:
+                lr_div = s["lr_div"]
+                stop = trigger
+            return dict(p=p, opt=opt, key=key, it=s["it"] + 1, stop=stop,
+                        best_score=best_score, best_loss=best_loss,
+                        no_improve=no_improve, lr_div=lr_div, best_p=best_p)
+
+        s = jax.lax.while_loop(cond, body, state)
+        final_p = s["best_p"] if early_stopping else s["p"]
+        return {"layers": final_p, "n_iter": s["it"]}
 
     @classmethod
     def _logits(cls, model, static, X, meta):
@@ -234,13 +350,16 @@ class MLPClassifierFamily(Family):
     @classmethod
     def sklearn_attrs(cls, model, static, meta):
         layers = model["layers"]
-        return {
+        attrs = {
             "coefs_": [np.asarray(l["W"]) for l in layers],
             "intercepts_": [np.asarray(l["b"]) for l in layers],
             "classes_": meta.get("classes"),
             "n_features_in_": meta["n_features"],
             "n_layers_": len(layers) + 1,
         }
+        if "n_iter" in model:
+            attrs["n_iter_"] = int(model["n_iter"])
+        return attrs
 
 
 class MLPRegressorFamily(MLPClassifierFamily):

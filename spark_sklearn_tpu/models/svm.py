@@ -69,6 +69,27 @@ def _power_step(K, n, dtype):
     return 1.0 / (jnp.dot(v, K @ v) + 1e-6)
 
 
+def _box_fista(grad_fn, project, x0, step, max_iter):
+    """Nesterov-accelerated projected gradient on a constrained QP — the
+    ONE loop behind every dual here (SVC pairs, nu-duals, SVR pairs, the
+    liblinear hinge/epsilon duals): the TPU answer to libsvm/liblinear's
+    sequential working-set and coordinate-descent solvers, where every
+    (subproblem, sample) coordinate advances together through wide
+    matmuls.  Minimises; ascent callers negate their gradient."""
+    dtype = x0.dtype
+
+    def body(i, carry):
+        x, z, t = carry
+        x_new = project(z - step * grad_fn(z))
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_new = x_new + ((t - 1.0) / t_new) * (x_new - x)
+        return x_new, z_new, t_new
+
+    x, _, _ = jax.lax.fori_loop(
+        0, max_iter, body, (x0, x0, jnp.asarray(1.0, dtype)))
+    return x
+
+
 def _project_box_hyperplane(Z, yb, bound, n_bisect=40):
     """Euclidean projection of each row of Z onto its subproblem's feasible
     set {0 <= a_i <= bound_i} intersected with {sum_i y_i a_i = 0}.
@@ -93,6 +114,94 @@ def _project_box_hyperplane(Z, yb, bound, n_bisect=40):
     lo, hi = jax.lax.fori_loop(0, n_bisect, bis, (lo, hi))
     nu = 0.5 * (lo + hi)
     return jnp.clip(Z - nu[:, None] * yb, 0.0, bound)
+
+
+def _project_box_sum(Z, bound, target, n_bisect=40):
+    """Euclidean projection of each row of Z onto
+    {0 <= a_i <= bound_i, sum_i a_i = target} — clip(z - lam, 0, bound)
+    for the lam making the sum hit `target` (monotone decreasing in lam,
+    fixed-count vectorized bisection).  `target` is per-row (M,)."""
+    zmax = jnp.max(jnp.abs(Z), axis=1) + jnp.max(bound, axis=1) + 1.0
+    lo, hi = -zmax, zmax
+
+    def bis(i, lh):
+        lo, hi = lh
+        mid = 0.5 * (lo + hi)
+        g = jnp.sum(jnp.clip(Z - mid[:, None], 0.0, bound), axis=1)
+        take_hi = g > target
+        return jnp.where(take_hi, mid, lo), jnp.where(take_hi, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, n_bisect, bis, (lo, hi))
+    mid = 0.5 * (lo + hi)
+    return jnp.clip(Z - mid[:, None], 0.0, bound)
+
+
+def _masked_mean_or_mid(vals, free, at_hi, at_lo):
+    """libsvm's r1/r2 rule: mean of `vals` over free SVs; when none are
+    free, the midpoint of [max over at-upper-bound, min over at-0]."""
+    big = jnp.asarray(jnp.inf, vals.dtype)
+    nfree = jnp.sum(free, axis=1)
+    mean_free = jnp.sum(jnp.where(free, vals, 0.0), axis=1) / \
+        jnp.maximum(nfree, 1)
+    lb = jnp.max(jnp.where(at_hi, vals, -big), axis=1)
+    ub = jnp.min(jnp.where(at_lo, vals, big), axis=1)
+    mid = 0.5 * (lb + ub)
+    mid = jnp.where(jnp.isfinite(mid), mid,
+                    jnp.where(jnp.isfinite(lb), lb,
+                              jnp.where(jnp.isfinite(ub), ub, 0.0)))
+    return jnp.where(nfree > 0, mean_free, mid)
+
+
+def nu_dual_ascent(K, yb, bound, nu, step, max_iter):
+    """libsvm's nu-SVC dual (Solver_NU), batched over M subproblems:
+
+        min_a 0.5 a'Q a,   0 <= a_i <= bound_i,
+        y'a = 0,  e'a = nu * l          (l = subproblem row count)
+
+    The two equalities DECOMPOSE over the class signs: sum over the
+    positive half = sum over the negative half = nu*l/2, so each
+    projection is two independent box+sum bisections — no coupled 2-D
+    multiplier search.  After the solve, the KKT multipliers follow
+    libsvm's calculate_rho: free +1 SVs average the gradient to r1, free
+    -1 SVs to r2; the decision is rescaled by r = (r1+r2)/2 (alpha /= r,
+    rho = (r1-r2)/2 / r).  Returns per-subproblem full-set decision rows;
+    infeasible subproblems (nu*l/2 exceeding a half's box capacity — the
+    case where sklearn raises 'specified nu is infeasible') come back as
+    NaN rows for the engine's failed-fit detector.
+    """
+    pos_b = jnp.where(yb > 0, bound, 0.0)
+    neg_b = jnp.where(yb < 0, bound, 0.0)
+    l_sub = jnp.sum(bound > 0, axis=1).astype(K.dtype)
+    target = 0.5 * nu * l_sub                                   # (M,)
+    cap = jnp.minimum(jnp.sum(pos_b, axis=1), jnp.sum(neg_b, axis=1))
+    feasible = target <= cap * (1.0 + 1e-6)
+
+    def project(Zt):
+        return _project_box_sum(Zt, pos_b, target) + \
+            _project_box_sum(Zt, neg_b, target)
+
+    def grad(Z):
+        return yb * ((Z * yb) @ K)
+
+    A = _box_fista(grad, project, project(jnp.zeros_like(bound)),
+                   step, max_iter)
+
+    V = (A * yb) @ K
+    G = yb * V                         # gradient of 0.5 a'Qa
+    inb = bound > 0
+    at_lo = A <= bound * 1e-6
+    at_hi = A >= bound * (1.0 - 1e-6)
+    free = inb & ~at_lo & ~at_hi
+    pos, neg = yb > 0, yb < 0
+    r1 = _masked_mean_or_mid(G, free & pos, inb & pos & at_hi,
+                             inb & pos & at_lo)
+    r2 = _masked_mean_or_mid(G, free & neg, inb & neg & at_hi,
+                             inb & neg & at_lo)
+    r = 0.5 * (r1 + r2)                # lambda_e: the alpha rescale
+    rho = 0.5 * (r1 - r2)              # lambda_y
+    ok = jnp.logical_and(feasible, r > 1e-12)
+    dec = (V - rho[:, None]) / r[:, None]
+    return jnp.where(ok[:, None], dec, jnp.nan)
 
 
 def _kkt_intercept(K, A, yb, bound):
@@ -136,18 +245,12 @@ def fista_dual_ascent(K, yb, bound, step, max_iter):
     task-batched fit and the standalone SVC so the numerics live once.
     """
 
-    def ascent(i, carry):
-        A, Z, t = carry
-        V = (Z * yb) @ K
-        grad = 1.0 - yb * V
-        A_new = _project_box_hyperplane(Z + step * grad, yb, bound)
-        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-        Z_new = A_new + ((t - 1.0) / t_new) * (A_new - A)
-        return A_new, Z_new, t_new
+    def grad(Z):                       # descent form of the ascent grad
+        return -(1.0 - yb * ((Z * yb) @ K))
 
-    A0 = jnp.zeros_like(bound)
-    A, _, _ = jax.lax.fori_loop(
-        0, max_iter, ascent, (A0, A0, jnp.asarray(1.0, K.dtype)))
+    A = _box_fista(
+        grad, lambda Zt: _project_box_hyperplane(Zt, yb, bound),
+        jnp.zeros_like(bound), step, max_iter)
     return A, _kkt_intercept(K, A, yb, bound)
 
 
@@ -166,9 +269,22 @@ class SVCFamily(Family):
     name = "svc"
     is_classifier = True
     dynamic_params = {"C": np.float32, "gamma": np.float32}
+    #: the per-candidate scalar the dual consumes (NuSVC swaps in "nu")
+    primary_param = "C"
+    primary_default = 1.0
     #: the task-batched fit understands per-fold-transformed inputs
     #: (data["X_folds"], shape (F, n, d)) — what compiled Pipelines feed it
     task_batched_accepts_fold_inputs = True
+
+    @classmethod
+    def _pair_dec(cls, K, p_c, base_bound, yb, step, max_iter):
+        """Solve the M stacked pair subproblems and return their (M, n)
+        full-set decision rows.  `p_c` is the candidate's primary scalar
+        (C here: scales the box), `base_bound` the fold/weight/pair box
+        mask."""
+        bound = p_c * base_bound
+        A, b = fista_dual_ascent(K, yb, bound, step, max_iter)
+        return (A * yb) @ K + b[:, None]
 
     # kernel matrices + per-task decision caches are the memory hot spot;
     # tell the search to keep task batches small
@@ -230,8 +346,10 @@ class SVCFamily(Family):
         nc = B // n_folds
 
         gamma_default = _resolve_gamma(static.get("gamma", "scale"), meta)
+        pp = cls.primary_param
         C_task = jnp.broadcast_to(jnp.asarray(
-            dynamic.get("C", static.get("C", 1.0)), X.dtype), (B,))
+            dynamic.get(pp, static.get(pp, cls.primary_default)),
+            X.dtype), (B,))
         g_task = jnp.broadcast_to(jnp.asarray(
             dynamic.get("gamma", gamma_default), X.dtype), (B,))
         C_cand = C_task.reshape(nc, n_folds)[:, 0]
@@ -266,13 +384,14 @@ class SVCFamily(Family):
             if X_folds is None:
                 K = _kernel(X, X, kind, g_c, degree, coef0)   # (n, n)
                 step = _power_step(K, n, X.dtype)
-                # subproblem bounds: (F, P, n) -> flatten (F*P, n)
-                bound = (C_c * (w_f * cw_fold)[:, None, :]
-                         * in_pair[None, :, :]).reshape(-1, n)
+                # subproblem box masks: (F, P, n) -> flatten (F*P, n)
+                base = ((w_f * cw_fold)[:, None, :]
+                        * in_pair[None, :, :]).reshape(-1, n)
                 yb = jnp.broadcast_to(
                     ybin[None], (n_folds, P, n)).reshape(-1, n)
-                A, b = fista_dual_ascent(K, yb, bound, step, max_iter)
-                dec = ((A * yb) @ K + b[:, None]).reshape(n_folds, P, n)
+                dec = cls._pair_dec(
+                    K, C_c, base, yb, step, max_iter).reshape(
+                    n_folds, P, n)
             else:
                 # pipeline mode: each fold has its own transformed X, so
                 # kernels are per (candidate, fold); the P pair
@@ -293,10 +412,9 @@ class SVCFamily(Family):
                         g_f = g_c
                     Kf = _kernel(Xf, Xf, kind, g_f, degree, coef0)
                     step = _power_step(Kf, n, Xf.dtype)
-                    bound = C_c * (w_row * cw_row)[None, :] * in_pair
-                    A, b = fista_dual_ascent(
-                        Kf, ybin, bound, step, max_iter)
-                    return (A * ybin) @ Kf + b[:, None]       # (P, n)
+                    base = (w_row * cw_row)[None, :] * in_pair
+                    return cls._pair_dec(
+                        Kf, C_c, base, ybin, step, max_iter)  # (P, n)
 
                 dec = jax.vmap(per_fold)(X_folds, w_f, cw_fold)  # (F,P,n)
             return carry, jnp.transpose(dec, (0, 2, 1))       # (F, n, P)
@@ -341,8 +459,33 @@ class SVCFamily(Family):
                 "n_features_in_": meta["n_features"]}
 
 
+class NuSVCFamily(SVCFamily):
+    """nu-SVC: same one-vs-one kernel machinery as SVC, but each pair
+    subproblem solves libsvm's nu-parameterised dual (`nu_dual_ascent`)
+    — box bound 1 per sample (class_weight-scaled), the two equality
+    constraints split into per-class-half sum projections, and the
+    decision rescaled by the KKT multiplier r.  Infeasible nu (sklearn
+    raises ValueError in fit) surfaces as NaN decisions -> the search's
+    failed-fit detector assigns error_score, the compiled analog of the
+    host tier's raise."""
+
+    name = "nu_svc"
+    dynamic_params = {"nu": np.float32, "gamma": np.float32}
+    primary_param = "nu"
+    primary_default = 0.5
+
+    @classmethod
+    def _pair_dec(cls, K, p_c, base_bound, yb, step, max_iter):
+        return nu_dual_ascent(K, yb, base_bound, p_c, step, max_iter)
+
+
 register_family(
     SVCFamily,
     "sklearn.svm._classes.SVC",
     "sklearn.svm.SVC",
+)
+register_family(
+    NuSVCFamily,
+    "sklearn.svm._classes.NuSVC",
+    "sklearn.svm.NuSVC",
 )

@@ -12,14 +12,17 @@ _fit_and_score).  The TPU redesign:
   constraint sum(a - a*) = 0, and the tiled kernel [[K,K],[K,K]] acts
   through ONE (M, n) @ (n, n) matmul per iteration (its top eigenvalue is
   2*lambda_max(K), so SVC's power-iteration step halves).
-- LinearSVC/LinearSVR solve liblinear's PRIMAL smooth losses
+- LinearSVC/LinearSVR solve liblinear's smooth PRIMAL losses
   (squared_hinge / squared_epsilon_insensitive) with the same batched
-  L-BFGS engine as logistic regression (ops/solvers.glm_lbfgs_batched):
-  all (candidate x fold) tasks advance as one wide matmul.  liblinear's
-  augmented-column intercept convention (intercept_scaling, intercept
-  REGULARISED) is reproduced exactly.  The nonsmooth duals (hinge,
-  epsilon_insensitive, crammer_singer, penalty='l1') raise -> the search
-  falls back to the host tier, matching sklearn bit-for-bit there.
+  L-BFGS engine as logistic regression (ops/solvers.glm_lbfgs_batched),
+  and the nonsmooth losses (hinge / epsilon_insensitive) through their
+  box-constrained DUAL QPs with accelerated projected gradient
+  (`_box_fista`) — the TPU answer to liblinear's sequential dual
+  coordinate descent; all (candidate x fold) tasks advance as one wide
+  matmul either way.  liblinear's augmented-column intercept convention
+  (intercept_scaling, intercept REGULARISED) is reproduced exactly.
+  crammer_singer and penalty='l1' raise -> the search falls back to the
+  host tier, matching sklearn bit-for-bit there.
 """
 
 from __future__ import annotations
@@ -30,9 +33,12 @@ import numpy as np
 
 from spark_sklearn_tpu.models.base import Family, register_family
 from spark_sklearn_tpu.models.svm import (
+    _box_fista,
     _kernel,
+    _masked_mean_or_mid,
     _power_step,
     _project_box_hyperplane,
+    _project_box_sum,
     _resolve_gamma,
 )
 
@@ -55,20 +61,15 @@ def svr_dual_ascent(K, y, eps, bound_half, step, max_iter):
     lin = s * jnp.concatenate([y, y]) - eps            # (2n,) per-element
     bound = jnp.concatenate([bound_half, bound_half], axis=1)   # (M, 2n)
 
-    def ascent(i, carry):
-        U, Z, t = carry
+    def grad(Z):                       # descent form of the ascent grad
         beta = (Z * s).reshape(M, 2, n).sum(axis=1)    # a - a*  (M, n)
         V_half = beta @ K                              # (M, n)
         V = jnp.concatenate([V_half, V_half], axis=1)  # (M, 2n)
-        grad = lin - s * V
-        U_new = _project_box_hyperplane(Z + step * grad, s[None, :], bound)
-        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-        Z_new = U_new + ((t - 1.0) / t_new) * (U_new - U)
-        return U_new, Z_new, t_new
+        return -(lin - s * V)
 
-    U0 = jnp.zeros_like(bound)
-    U, _, _ = jax.lax.fori_loop(
-        0, max_iter, ascent, (U0, U0, jnp.asarray(1.0, dtype)))
+    U = _box_fista(
+        grad, lambda Zt: _project_box_hyperplane(Zt, s[None, :], bound),
+        jnp.zeros_like(bound), step, max_iter)
     beta = (U * s).reshape(M, 2, n).sum(axis=1)
     return beta, _svr_intercept(K, U, beta, y, eps, bound_half)
 
@@ -108,16 +109,80 @@ def _svr_intercept(K, U, beta, y, eps, bound_half):
     return jnp.where(nfree > 0, b_free, b_mid)
 
 
+def nu_svr_dual_ascent(K, y, nu, bound_half, step, max_iter):
+    """libsvm's nu-SVR dual (solve_nu_svr): stacked u = (a, a*) with
+    per-element box C (already folded into `bound_half` by the caller,
+    fold/sample-weight-scaled), sum over EACH half = C*nu*l/2 — i.e.
+    nu/2 of the half's total box capacity, which keeps the libsvm value
+    under fold masks and sample weights — and no epsilon in the
+    objective: the tube width is implicit, recovered from the KKT
+    conditions together with b.  Always feasible for nu in (0, 1]."""
+    M, n = bound_half.shape
+    dtype = K.dtype
+    s = jnp.concatenate([jnp.ones((n,), dtype), -jnp.ones((n,), dtype)])
+    lin = s * jnp.concatenate([y, y])
+    zero = jnp.zeros_like(bound_half)
+    pos_b = jnp.concatenate([bound_half, zero], axis=1)       # (M, 2n)
+    neg_b = jnp.concatenate([zero, bound_half], axis=1)
+    cap = jnp.sum(bound_half, axis=1)
+    target = jnp.broadcast_to(0.5 * nu * cap, (M,))
+    feasible = target <= cap * (1.0 + 1e-6)
+
+    def project(Zt):
+        return _project_box_sum(Zt, pos_b, target) + \
+            _project_box_sum(Zt, neg_b, target)
+
+    def grad(Z):                       # descent form of the ascent grad
+        beta = (Z * s).reshape(M, 2, n).sum(axis=1)
+        V_half = beta @ K
+        V = jnp.concatenate([V_half, V_half], axis=1)
+        return -(lin - s * V)
+
+    U = _box_fista(grad, project, project(jnp.zeros((M, 2 * n), dtype)),
+                   step, max_iter)
+    beta = (U * s).reshape(M, 2, n).sum(axis=1)
+    # KKT: free a  -> y - f0 - b = +eps  (E estimates b + eps)
+    #      free a* -> y - f0 - b = -eps  (E estimates b - eps)
+    E = y[None, :] - beta @ K
+    a, a_star = U[:, :n], U[:, n:]
+    inb = bound_half > 0
+    t_lo = bound_half * 1e-6
+    t_hi = bound_half * (1.0 - 1e-6)
+    free_a = inb & (a > t_lo) & (a < t_hi)
+    free_as = inb & (a_star > t_lo) & (a_star < t_hi)
+    # bound directions (cf. _svr_intercept's at-bound table): a=0 rows
+    # LOWER-bound b+eps, a=C rows upper-bound it; a*=C rows LOWER-bound
+    # b-eps, a*=0 rows upper-bound it
+    m_a = _masked_mean_or_mid(E, free_a, inb & (a <= t_lo),
+                              inb & (a >= t_hi))
+    m_as = _masked_mean_or_mid(E, free_as, inb & (a_star >= t_hi),
+                               inb & (a_star <= t_lo))
+    b = 0.5 * (m_a + m_as)
+    f = beta @ K + b[:, None]
+    return jnp.where(feasible[:, None], f, jnp.nan)
+
+
 class SVRFamily(Family):
     name = "svr"
     is_classifier = False
     dynamic_params = {"C": np.float32, "gamma": np.float32,
                       "epsilon": np.float32}
+    #: the third per-candidate scalar next to C/gamma (NuSVR swaps in nu)
+    aux_param = "epsilon"
+    aux_default = 0.1
     # task-batched only (like SVC): the keyed fleet and per-task callers
     # skip it via has_per_task_fit(); keyed_compatible stays True so
     # make_pipeline_family composes it as a fold-input final, NOT as a
     # binned-invariant tree final
     task_batched_accepts_fold_inputs = True
+
+    @classmethod
+    def _fold_dual(cls, K, y, C_c, aux_c, w_rows, step, max_iter):
+        """Solve the fold subproblems for one candidate; returns (F, n)
+        full-set regression values.  `aux_c` is epsilon here."""
+        bound = C_c * w_rows
+        beta, b = svr_dual_ascent(K, y, aux_c, bound, step, max_iter)
+        return beta @ K + b[:, None]
 
     @staticmethod
     def max_tasks_hint(n_samples: int, meta) -> int:
@@ -155,12 +220,13 @@ class SVRFamily(Family):
         nc = B // n_folds
 
         gamma_default = _resolve_gamma(static.get("gamma", "scale"), meta)
+        ap = cls.aux_param
         C_task = jnp.broadcast_to(jnp.asarray(
             dynamic.get("C", static.get("C", 1.0)), X.dtype), (B,))
         g_task = jnp.broadcast_to(jnp.asarray(
             dynamic.get("gamma", gamma_default), X.dtype), (B,))
         e_task = jnp.broadcast_to(jnp.asarray(
-            dynamic.get("epsilon", static.get("epsilon", 0.1)),
+            dynamic.get(ap, static.get(ap, cls.aux_default)),
             X.dtype), (B,))
         C_cand = C_task.reshape(nc, n_folds)[:, 0]
         g_cand = g_task.reshape(nc, n_folds)[:, 0]
@@ -176,9 +242,7 @@ class SVRFamily(Family):
             if X_folds is None:
                 K = _kernel(X, X, kind, g_c, degree, coef0)
                 step = 0.5 * _power_step(K, n, X.dtype)   # lam_max doubles
-                bound = C_c * w_f                          # (F, n)
-                beta, b = svr_dual_ascent(K, y, e_c, bound, step, max_iter)
-                f = beta @ K + b[:, None]                  # (F, n)
+                f = cls._fold_dual(K, y, C_c, e_c, w_f, step, max_iter)
             else:
                 def per_fold(Xf, w_row):
                     if gamma_is_scale:
@@ -193,9 +257,9 @@ class SVRFamily(Family):
                         g_f = g_c
                     Kf = _kernel(Xf, Xf, kind, g_f, degree, coef0)
                     step = 0.5 * _power_step(Kf, n, Xf.dtype)
-                    beta, b = svr_dual_ascent(
-                        Kf, y, e_c, (C_c * w_row)[None, :], step, max_iter)
-                    return (beta @ Kf + b[:, None])[0]
+                    return cls._fold_dual(
+                        Kf, y, C_c, e_c, w_row[None, :], step,
+                        max_iter)[0]
 
                 f = jax.vmap(per_fold)(X_folds, w_f)       # (F, n)
             return carry, f
@@ -214,20 +278,36 @@ class SVRFamily(Family):
 
 
 # ----------------------------------------------------------------------------
-# liblinear primal families
+# liblinear primal + dual families
 # ----------------------------------------------------------------------------
 
 def _check_linear_svc_static(static):
     if static.get("penalty", "l2") != "l2":
         raise ValueError("penalty='l1' is not compiled; use backend='host'")
-    if static.get("loss", "squared_hinge") != "squared_hinge":
+    if static.get("loss", "squared_hinge") not in (
+            "squared_hinge", "hinge"):
         raise ValueError(
-            "loss='hinge' (nonsmooth dual) is not compiled; use "
+            f"loss={static.get('loss')!r} is not compiled; use "
             "backend='host'")
     if static.get("multi_class", "ovr") != "ovr":
         raise ValueError(
             "multi_class='crammer_singer' is not compiled; use "
             "backend='host'")
+
+
+def _gram_step(Xa, dtype):
+    """1 / lambda_max(Xa Xa^T) via power iteration through the factored
+    Gram (never materialised: two (n, da) matmuls per step)."""
+    n = Xa.shape[0]
+    v = jnp.ones((n,), dtype) / jnp.sqrt(n)
+
+    def power(i, v):
+        u = Xa @ (v @ Xa)
+        return u / (jnp.linalg.norm(u) + 1e-30)
+
+    v = jax.lax.fori_loop(0, 20, power, v)
+    lam = jnp.dot(v, Xa @ (v @ Xa)) + 1e-6
+    return 1.0 / lam
 
 
 class LinearSVCFamily(Family):
@@ -297,6 +377,39 @@ class LinearSVCFamily(Family):
         else:
             T = 2.0 * data["y1h"] - 1.0                           # (n, k)
         wT = train_w.T                                            # (n, B)
+
+        if static.get("loss", "squared_hinge") == "hinge":
+            # liblinear's l1-loss dual per OvR machine m:
+            #   min_a 0.5 a'Q a - 1'a,  0 <= a_i <= C * w_i,
+            #   Q = diag(t) Xa Xa' diag(t)  (same spectrum as the Gram)
+            # No equality constraint — the intercept is the regularised
+            # appended column, exactly liblinear.  Solved by accelerated
+            # projected gradient; the coordinate-descent answer is the
+            # same optimum (the dual is a strictly convex QP on a box).
+            step = _gram_step(Xa, X.dtype)
+            Tt = T.T[None, :, :]                       # (1, ko, n)
+            bound = (C[:, None, None]
+                     * train_w[:, None, :])            # (B, 1->ko, n)
+
+            def grad(a):                               # a (B, ko, n)
+                v = jnp.einsum("bkn,nd->bkd", a * Tt, Xa)
+                q = jnp.einsum("bkd,nd->bkn", v, Xa) * Tt
+                return q - 1.0
+
+            def project(a):
+                return jnp.clip(a, 0.0, bound)
+
+            a0 = jnp.zeros((B, ko, n), X.dtype)
+            a = _box_fista(grad, project, a0, step, max_iter)
+            W = jnp.einsum("bkn,nd->bkd", a * Tt, Xa)  # (B, ko, da)
+            if fit_intercept:
+                coef, intercept = W[:, :, :d], W[:, :, d] * isc
+            else:
+                coef = W
+                intercept = jnp.zeros((B, ko), X.dtype)
+            return {"coef": coef, "intercept": intercept,
+                    "converged": jnp.ones((B,), bool),
+                    "n_iter": jnp.full((B,), max_iter, jnp.int32)}
 
         def Ax(x):                                    # (B, da*ko) -> Z
             W = x.reshape(B, ko, da)
@@ -388,11 +501,10 @@ class LinearSVRFamily(Family):
     def fit_task_batched(cls, dynamic, static, data, train_w, meta):
         from spark_sklearn_tpu.ops.solvers import glm_lbfgs_batched
 
-        if static.get("loss", "epsilon_insensitive") != \
-                "squared_epsilon_insensitive":
-            raise ValueError(
-                "loss='epsilon_insensitive' (nonsmooth) is not compiled; "
-                "use backend='host' or loss='squared_epsilon_insensitive'")
+        loss = static.get("loss", "epsilon_insensitive")
+        if loss not in ("epsilon_insensitive",
+                        "squared_epsilon_insensitive"):
+            raise ValueError(f"loss={loss!r} is not compiled")
         X, y = data["X"], data["y"]
         n, d = X.shape
         B = train_w.shape[0]
@@ -412,6 +524,36 @@ class LinearSVRFamily(Family):
             else X
         da = Xa.shape[1]
         wT = train_w.T                                  # (n, B)
+
+        if loss == "epsilon_insensitive":
+            # liblinear's l1-loss dual in beta = a - a*: since a_i a*_i = 0
+            # at the optimum, the paired dual collapses to
+            #   min_b 0.5 b'(Xa Xa')b - y'b + eps*|b|_1,  |b_i| <= C*w_i
+            # — a box-constrained lasso QP whose prox is soft-threshold
+            # then clip (the box is symmetric/separable).  The intercept
+            # is the regularised appended column, exactly liblinear.
+            step = _gram_step(Xa, X.dtype)
+            bound = C[:, None] * train_w                # (B, n)
+
+            def grad(b):                                # (B, n)
+                return (b @ Xa) @ Xa.T - y[None, :]
+
+            def project(b):
+                s = jnp.sign(b) * jnp.maximum(
+                    jnp.abs(b) - step * eps_t[:, None], 0.0)
+                return jnp.clip(s, -bound, bound)
+
+            beta = _box_fista(grad, project,
+                              jnp.zeros((B, n), X.dtype), step, max_iter)
+            Wd = beta @ Xa                              # (B, da)
+            if fit_intercept:
+                coef, intercept = Wd[:, :d], Wd[:, d] * isc
+            else:
+                coef = Wd
+                intercept = jnp.zeros((B,), X.dtype)
+            return {"coef": coef, "intercept": intercept,
+                    "converged": jnp.ones((B,), bool),
+                    "n_iter": jnp.full((B,), max_iter, jnp.int32)}
 
         def Ax(x):                                      # (B, da) -> (n, B)
             return Xa @ x.T
@@ -452,10 +594,33 @@ class LinearSVRFamily(Family):
                 "n_features_in_": meta["n_features"]}
 
 
+class NuSVRFamily(SVRFamily):
+    """nu-SVR: SVR's kernel scaffold with libsvm's nu dual — per-sample
+    box C (solve_nu_svr's convention), per-half sum C*nu*l/2, epsilon
+    implicit (recovered with b from the free-SV KKT conditions in
+    `nu_svr_dual_ascent`)."""
+
+    name = "nu_svr"
+    dynamic_params = {"C": np.float32, "gamma": np.float32,
+                      "nu": np.float32}
+    aux_param = "nu"
+    aux_default = 0.5
+
+    @classmethod
+    def _fold_dual(cls, K, y, C_c, aux_c, w_rows, step, max_iter):
+        return nu_svr_dual_ascent(
+            K, y, aux_c, C_c * w_rows, step, max_iter)
+
+
 register_family(
     SVRFamily,
     "sklearn.svm._classes.SVR",
     "sklearn.svm.SVR",
+)
+register_family(
+    NuSVRFamily,
+    "sklearn.svm._classes.NuSVR",
+    "sklearn.svm.NuSVR",
 )
 register_family(
     LinearSVCFamily,

@@ -87,14 +87,50 @@ class TestMLP:
         assert gs.search_report["backend"] == "tpu"
         assert gs.cv_results_["mean_test_score"].max() > 0.95
 
-    def test_early_stopping_falls_back(self, digits):
+    def test_early_stopping_stays_compiled(self, digits):
+        """early_stopping holds out validation rows, restores the best
+        weights, and stays on the compiled tier (round-2: previously a
+        host fallback)."""
         X, y = digits
-        with pytest.warns(UserWarning, match="falling back"):
-            gs = sst.GridSearchCV(
-                MLPClassifier(hidden_layer_sizes=(16,), max_iter=20,
-                              early_stopping=True, random_state=0),
-                {"alpha": [1e-4]}, cv=3).fit(X, y)
+        gs = sst.GridSearchCV(
+            MLPClassifier(hidden_layer_sizes=(16,), max_iter=20,
+                          early_stopping=True, random_state=0),
+            {"alpha": [1e-4]}, cv=3).fit(X, y)
+        assert gs.search_report["backend"] == "tpu"
         assert gs.best_score_ > 0.5
+
+    def test_loss_plateau_stops_before_max_iter(self, digits):
+        """sklearn's tol/n_iter_no_change training-loss plateau rule is
+        compiled: a converged net reports n_iter < max_iter."""
+        from spark_sklearn_tpu.models.base import resolve_family
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:200], y[m][:200]
+        est = MLPClassifier(hidden_layer_sizes=(8,), max_iter=500,
+                            random_state=0, tol=1e-3)
+        fam = resolve_family(est)
+        data, meta = fam.prepare_data(Xs, ys)
+        model = fam.fit({}, fam.extract_params(est), data,
+                        np.ones(len(ys), np.float32), meta)
+        assert int(model["n_iter"]) < 500
+        # and end-to-end through the search it stays compiled
+        gs = sst.GridSearchCV(est, {"alpha": [1e-4]}, cv=3).fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"
+        assert gs.best_score_ > 0.9
+
+    def test_sgd_schedules_stay_compiled(self, digits):
+        X, y = digits
+        m = y < 3
+        for sched in ("invscaling", "adaptive"):
+            # invscaling decays lr by (samples_seen)^-0.5, so it needs a
+            # large lr_init to learn at all (sklearn behaves the same)
+            gs = sst.GridSearchCV(
+                MLPClassifier(hidden_layer_sizes=(16,), max_iter=40,
+                              solver="sgd", learning_rate=sched,
+                              learning_rate_init=0.2, random_state=0),
+                {"alpha": [1e-4]}, cv=3).fit(X[m][:250], y[m][:250])
+            assert gs.search_report["backend"] == "tpu", sched
+            assert gs.best_score_ > 0.8, sched
 
 
 class TestPipeline:

@@ -53,6 +53,41 @@ class TestSVC:
             SVC(), {"C": [1.0, 10.0]}, cv=3, backend="tpu").fit(Xs, ys)
         assert gs.best_score_ > 0.85
 
+    def test_nusvc_close_to_sklearn(self, digits):
+        """round 2: libsvm's nu dual (two per-half sum projections + KKT
+        rescale) runs compiled; infeasible nu -> error_score like the
+        host tier's ValueError."""
+        from sklearn.model_selection import GridSearchCV as SkGS
+        from sklearn.svm import NuSVC
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:160], y[m][:160]
+        grid = {"nu": [0.1, 0.3, 0.5]}
+        gs = sst.GridSearchCV(NuSVC(), grid, cv=3, refit=False).fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(NuSVC(), grid, cv=3, refit=False).fit(Xs, ys)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=0.03)
+
+    def test_nusvc_infeasible_nu_fails_like_sklearn(self, digits):
+        """Imbalanced classes make nu=0.9 infeasible on every fold
+        (libsvm: nu must be <= 2*min(n+, n-)/l); sklearn raises in every
+        fit and the search raises 'All the N fits failed' — the compiled
+        NaN-decision detector reproduces exactly that."""
+        import warnings as _w
+
+        from sklearn.svm import NuSVC
+        X, y = digits
+        idx = np.concatenate([np.where(y == 0)[0][:100],
+                              np.where(y == 1)[0][:25]])
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            with pytest.raises(ValueError, match="fits failed"):
+                sst.GridSearchCV(
+                    NuSVC(), {"nu": [0.9]}, cv=3, refit=False,
+                    error_score=np.nan).fit(X[idx], y[idx])
+
     def test_precomputed_falls_back(self, digits):
         X, y = digits
         Xs = X[:100]

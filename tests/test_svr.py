@@ -82,14 +82,21 @@ class TestLinearSVC:
         assert abs(ours.best_score_ - theirs.best_score_) < 0.03
         assert ours.best_score_ > 0.9
 
-    def test_hinge_loss_falls_back_to_host(self, digits):
+    def test_hinge_loss_compiled_matches_sklearn(self, digits):
+        """round 2: liblinear's l1-loss dual (box QP, no equality) runs
+        compiled via accelerated projected gradient."""
+        from sklearn.model_selection import GridSearchCV as SkGS
         X, y = digits
-        m = y < 2
-        with pytest.warns(UserWarning, match="falling back"):
-            gs = sst.GridSearchCV(
-                LinearSVC(loss="hinge"), {"C": [1.0]},
-                cv=3).fit(X[m][:120], y[m][:120])
-        assert gs.search_report["backend"] == "host"
+        m = y < 3
+        Xs, ys = X[m][:200], y[m][:200]
+        est = LinearSVC(loss="hinge")
+        grid = {"C": [0.1, 1.0]}
+        gs = sst.GridSearchCV(est, grid, cv=3, refit=False).fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(est, grid, cv=3, refit=False).fit(Xs, ys)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=0.03)
 
     def test_keyed_linear_svc_fleet(self):
         import pandas as pd
@@ -122,10 +129,33 @@ class TestLinearSVR:
             ours.cv_results_["mean_test_score"],
             theirs.cv_results_["mean_test_score"], atol=0.05)
 
-    def test_default_nonsmooth_falls_back(self, diabetes):
+    def test_default_nonsmooth_compiled(self, diabetes):
+        """round 2: the epsilon_insensitive default compiles through the
+        collapsed box-lasso dual in beta = a - a*."""
+        from sklearn.model_selection import GridSearchCV as SkGS
         X, y = diabetes
-        with pytest.warns(UserWarning, match="falling back"):
-            gs = sst.GridSearchCV(
-                LinearSVR(max_iter=2000), {"C": [1.0]}, cv=3).fit(
-                X[:150], y[:150])
-        assert gs.search_report["backend"] == "host"
+        yn = ((y - y.mean()) / y.std()).astype(np.float32)
+        est = LinearSVR(max_iter=2000)
+        grid = {"C": [1.0], "epsilon": [0.0, 0.1]}
+        gs = sst.GridSearchCV(est, grid, cv=3, refit=False).fit(X, yn)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(est, grid, cv=3, refit=False).fit(X, yn)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=0.05)
+
+
+class TestNuSVR:
+    def test_nusvr_close_to_sklearn(self, diabetes):
+        from sklearn.model_selection import GridSearchCV as SkGS
+        from sklearn.svm import NuSVR
+        X, y = diabetes
+        yn = ((y - y.mean()) / y.std()).astype(np.float32)
+        est = NuSVR()
+        grid = {"nu": [0.3, 0.5], "C": [1.0]}
+        gs = sst.GridSearchCV(est, grid, cv=3, refit=False).fit(X, yn)
+        assert gs.search_report["backend"] == "tpu"
+        sk = SkGS(est, grid, cv=3, refit=False).fit(X, yn)
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"], atol=0.05)
