@@ -1,0 +1,63 @@
+"""Sample-sharding ("data" mesh axis) correctness on the virtual mesh.
+
+The reference replicates X to every executor (sc.broadcast); the TPU
+rebuild adds `TpuConfig(n_data_shards=k)` for X too large to replicate:
+samples shard over the second mesh axis and the families' sample-axis
+reductions become XLA collectives over ICI (SURVEY §5.8).  These tests
+run the REAL sharded path on the 8-virtual-device CPU mesh (task=4 x
+data=2) and require score parity with the replicated path.
+"""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LogisticRegression, Ridge
+
+import spark_sklearn_tpu as sst
+
+
+class TestDataSharding:
+    def _compare(self, est, grid, X, y, **fit_kw):
+        repl = sst.GridSearchCV(
+            est, grid, cv=3, refit=False, backend="tpu").fit(X, y, **fit_kw)
+        shard = sst.GridSearchCV(
+            est, grid, cv=3, refit=False, backend="tpu",
+            config=sst.TpuConfig(n_data_shards=2)).fit(X, y, **fit_kw)
+        assert shard.search_report["mesh"] == {"task": 4, "data": 2}
+        np.testing.assert_allclose(
+            repl.cv_results_["mean_test_score"],
+            shard.cv_results_["mean_test_score"], atol=2e-3)
+
+    def test_logreg_task_batched_sharded(self, digits):
+        """The wide-matmul GLM path with samples sharded: gradient
+        reductions cross the data axis as psums."""
+        X, y = digits
+        self._compare(LogisticRegression(max_iter=100),
+                      {"C": [0.5, 1.0]}, X[:800], y[:800])
+
+    def test_odd_sample_count_pads(self, digits):
+        """n_samples not divisible by the shard count: zero-weight pad
+        rows must not change any score."""
+        X, y = digits
+        self._compare(LogisticRegression(max_iter=100),
+                      {"C": [1.0]}, X[:801], y[:801])
+
+    def test_sharded_with_sample_weight(self, digits):
+        X, y = digits
+        rng = np.random.RandomState(0)
+        sw = rng.uniform(0.5, 2.0, size=401).astype(np.float32)
+        self._compare(LogisticRegression(max_iter=100),
+                      {"C": [1.0]}, X[:401], y[:401], sample_weight=sw)
+
+    def test_per_task_family_sharded(self, digits):
+        """A per-task (vmap) family — Ridge runs under x64 with closed
+        -form solves — through the same sharded data placement."""
+        X, y = digits
+        yr = (X[:600] @ np.linspace(-1, 1, 64)).astype(np.float32)
+        self._compare(Ridge(), {"alpha": [0.5, 1.0]}, X[:600], yr)
+
+    def test_invalid_shard_count_raises(self, digits):
+        X, y = digits
+        with pytest.raises(ValueError, match="does not divide"):
+            sst.GridSearchCV(
+                LogisticRegression(), {"C": [1.0]}, cv=3, backend="tpu",
+                config=sst.TpuConfig(n_data_shards=3)).fit(X[:300], y[:300])
