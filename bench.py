@@ -214,6 +214,45 @@ def run_child(platform):
                 gs3.cv_results_["mean_test_score"].max()), 4),
         })
 
+    if on_tpu:
+        # breadth legs (guarded: they must never kill the headline) —
+        # BASELINE config #2 shape (SVC CxGamma) and a keyed fleet
+        try:
+            from sklearn.svm import SVC
+            svc_grid = {"C": list(np.logspace(-1, 2, 8)),
+                        "gamma": list(np.logspace(-3, 0, 8))}
+            svc = sst.GridSearchCV(SVC(), svc_grid, cv=3, refit=False,
+                                   backend="tpu", config=cache_cfg)
+            t0 = time.perf_counter()
+            svc.fit(X, y)
+            svc_wall = time.perf_counter() - t0
+            detail["svc_64cand_3fold_wall_s"] = round(svc_wall, 2)
+            detail["svc_fits_per_sec"] = round(64 * 3 / svc_wall, 2)
+            detail["svc_best_score"] = round(float(
+                svc.cv_results_["mean_test_score"].max()), 4)
+        except Exception as exc:  # pragma: no cover - breadth only
+            detail["svc_leg_error"] = repr(exc)[:200]
+        try:
+            import pandas as pd
+            from sklearn.linear_model import LinearRegression
+            rng = np.random.RandomState(0)
+            n_keys, rows = 1000, 20
+            df = pd.DataFrame({
+                "k": np.repeat(np.arange(n_keys), rows),
+                "x": list(rng.randn(n_keys * rows, 8)
+                          .astype(np.float32)),
+                "y": rng.randn(n_keys * rows).astype(np.float32)})
+            t0 = time.perf_counter()
+            km = sst.KeyedEstimator(
+                sklearnEstimator=LinearRegression(), keyCols=["k"],
+                xCol="x", yCol="y").fit(df)
+            keyed_wall = time.perf_counter() - t0
+            detail["keyed_1000models_wall_s"] = round(keyed_wall, 2)
+            detail["keyed_models_per_sec"] = round(n_keys / keyed_wall, 2)
+            detail["keyed_backend"] = km.backend
+        except Exception as exc:  # pragma: no cover - breadth only
+            detail["keyed_leg_error"] = repr(exc)[:200]
+
     # --- baseline side: serial sklearn per-task fits --------------------
     sub = min(20, n_candidates)
     splits = list(cv.split(X, y))

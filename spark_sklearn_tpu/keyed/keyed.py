@@ -430,15 +430,16 @@ class KeyedModel:
         orig_index = df.index
         work = df.reset_index(drop=True)
         out_values: List[Any] = [None] * len(work)
+        fleet_groups = []
         for key, pdf in work.groupby(self.keyCols, sort=False, dropna=False):
             if not isinstance(key, tuple):
                 key = (key,)
             pos = pdf.index.to_numpy()
             if self.fleet is not None and \
                     key in self.fleet["key_index"]:
-                vals = self._fleet_predict(key, pdf)
-                for p, v in zip(pos, vals):
-                    out_values[p] = v
+                # deferred: all fleet keys predict together, bucketed —
+                # one device launch per bucket instead of one per key
+                fleet_groups.append((key, pdf, pos))
                 continue
             est = self.models.get(key) if self.models else None
             if est is None:
@@ -458,29 +459,65 @@ class KeyedModel:
                     vals = list(pred)  # string labels pass through as-is
                 for p, v in zip(pos, vals):
                     out_values[p] = v
+        if fleet_groups:
+            for (key, pdf, pos), vals in zip(
+                    fleet_groups, self._fleet_predict_all(fleet_groups)):
+                for p, v in zip(pos, vals):
+                    out_values[p] = v
         res = df.copy()
         res[self.outputCol] = pd.Series(out_values, index=orig_index)
         return res
 
-    def _fleet_predict(self, key, pdf):
-        """Batched predict/transform from the stacked-pytree fleet (one
-        gather on the key axis + the family's compiled predict or the
-        step's pure apply)."""
+    def _fleet_predict_all(self, fleet_groups):
+        """Bucketed batch predict/transform from the stacked-pytree
+        fleet: groups are padded to bucket lengths, each bucket runs ONE
+        vmapped program over (gathered model, padded rows) — a per-key
+        device dispatch (~ms of tunnel latency each) would dominate
+        transform wall at fleet scale.  Yields one value list per group,
+        in `fleet_groups` order."""
         import jax
         import jax.numpy as jnp
-        idx = self.fleet["key_index"][key]
-        model = jax.tree_util.tree_map(
-            lambda a: a[idx], self.fleet["models"])
-        X = jnp.asarray(_stack_x(pdf[self.xCol]), jnp.float32)
-        if self.fleet["kind"] == "step":
-            out = np.asarray(self.fleet["step"].apply(
-                self.fleet["static"], model, X))
-            return list(out.astype(np.float64))
-        fam = self.fleet["family"]
-        pred = np.asarray(fam.predict(
-            model, self.fleet["static"], X, self.fleet["meta"]))
-        if fam.is_classifier:
-            return list(self.fleet["meta"]["classes"][pred])
-        if self.estimatorType == "clusterer":
-            return list(pred.astype(np.int64))
-        return list(pred.astype(np.float64))
+
+        fleet = self.fleet
+        static = fleet["static"]
+        if fleet["kind"] == "step":
+            step = fleet["step"]
+
+            def predict_one(model, X):
+                return step.apply(static, model, X)
+        else:
+            fam = fleet["family"]
+            meta = fleet["meta"]
+
+            def predict_one(model, X):
+                return fam.predict(model, static, X, meta)
+
+        launch = jax.jit(jax.vmap(predict_one))
+        mats = [_stack_x(pdf[self.xCol]).astype(np.float32)
+                for _, pdf, _ in fleet_groups]
+        midx = np.asarray([fleet["key_index"][key]
+                           for key, _, _ in fleet_groups])
+        buckets: Dict[int, list] = {}
+        for i, m in enumerate(mats):
+            buckets.setdefault(bucket_len(len(m)), []).append(i)
+        outs: List[Any] = [None] * len(mats)
+        d = mats[0].shape[1]
+        for L in sorted(buckets):
+            idxs = buckets[L]
+            Xs = np.zeros((len(idxs), L, d), np.float32)
+            for j, gi in enumerate(idxs):
+                Xs[j, :len(mats[gi])] = mats[gi]
+            models = jax.tree_util.tree_map(
+                lambda a: a[midx[np.asarray(idxs)]], fleet["models"])
+            Y = np.asarray(launch(models, jnp.asarray(Xs)))
+            for j, gi in enumerate(idxs):
+                outs[gi] = Y[j, :len(mats[gi])]
+        for out in outs:
+            if fleet["kind"] == "step":
+                yield list(out.astype(np.float64))
+            elif fleet["family"].is_classifier:
+                yield list(fleet["meta"]["classes"][out.astype(np.int64)])
+            elif self.estimatorType == "clusterer":
+                yield list(out.astype(np.int64))
+            else:
+                yield list(out.astype(np.float64))
