@@ -1,30 +1,41 @@
-"""Headline benchmark — BASELINE.json north star.
+"""Headline benchmark — BASELINE.json north star, with MFU accounting.
 
-Config: 1000-candidate x 5-fold LogisticRegression grid on sklearn digits
-(BASELINE config #1 scaled to the north-star candidate count).  The
-reference published no numbers (BASELINE.md), so both sides are measured
-here:
+Legs (TPU platform):
+  1. headline: 1000-candidate x 5-fold LogisticRegression grid on sklearn
+     digits (BASELINE config #1 at north-star candidate count) — fp32
+     warm/cold + bf16, with achieved GFLOP/s and %-of-bf16-peak derived
+     from the solver's executed iteration counts (the search engine
+     records (iters, lanes) per launch; the GLM family's per-lane
+     per-iteration cost is exactly two wide matmuls = 4*n*d*k FLOPs).
+     digits is latency-bound by design (64 features) — the MFU figure
+     documents that honestly rather than hiding it.
+  2. svc_mxu: BASELINE config #2 shape — SVC(rbf) C x gamma grid on a
+     synthetic MNIST-shaped binary dataset (10k x 784; the real MNIST
+     needs network access this machine doesn't have, and FLOPs/MFU are
+     shape-determined).  Dominated by (10k, 784) @ (784, 10k) kernel
+     builds — real MXU work with analytically exact FLOP counts.
+  3. keyed fleet breadth leg (1000 per-key models).
 
-  - TPU side: spark_sklearn_tpu.GridSearchCV compiled path on the visible
-    chip(s) — one vmapped XLA program over all candidates.
-  - Baseline side: serial sklearn fits (the per-task work the reference
-    fans out to Spark executors), measured on a candidate subsample and
-    scaled linearly; divided by 8 as an *ideal* 8-executor Spark-CPU proxy
-    (zero scheduling/broadcast overhead — strictly favourable to the
-    baseline, unlike real Spark).
+Baseline side: serial sklearn fits (the per-task work the reference fans
+out to Spark executors), measured on a candidate subsample and scaled
+linearly; divided by 8 as an *ideal* 8-executor Spark-CPU proxy (zero
+scheduling/broadcast overhead — strictly favourable to the baseline).
 
 Always prints ONE JSON line:
   {"metric": ..., "value": fits/sec, "unit": "fits/sec",
    "vs_baseline": speedup vs the ideal 8-exec proxy, "platform": ...}
 
 Robustness: the top-level process is an orchestrator that never imports
-jax, so it cannot hang on a wedged TPU backend (the axon tunnel can block
-forever inside backend init when a dead client still holds the chip
-claim — this produced an unparseable BENCH_r01).  It probes the TPU in a
-subprocess with a timeout; on success the full benchmark runs on the
-chip, otherwise a scaled-down CPU-mesh measurement runs instead and the
-JSON line carries "platform": "cpu-fallback".  A JSON line is emitted on
-every path.
+jax, so it cannot hang on a wedged TPU backend (the axon tunnel can
+block forever inside backend init when a dead client still holds the
+chip claim).  The probe runs in a killable subprocess (backend init
+only — safe to kill; wedges come from killing mid-compile) and RETRIES
+WITH BACKOFF across a ~25-minute window, logging every attempt into the
+emitted JSON, because the chip claim has been observed to clear
+spontaneously mid-round.  On success the full benchmark runs on the
+chip; otherwise a scaled-down CPU-mesh smoke measurement runs instead —
+explicitly marked "platform": "cpu-fallback" with a note that it
+measures XLA:CPU overhead, NOT TPU performance.
 """
 
 import json
@@ -40,30 +51,59 @@ ds = jax.devices()
 print(json.dumps({"platform": ds[0].platform, "n_devices": len(ds)}))
 """
 
-# Generous: first TPU compile of the 1000-candidate program can take
-# minutes, and killing a process mid-TPU-compile can wedge the chip claim
-# for every later process.  The probe (backend init only) is the cheap,
-# safe-to-kill step; the full run gets an hour.
-PROBE_TIMEOUT_S = 240
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
+#: sleeps between probe attempts; total window ~25 min of sleeps plus
+#: probe timeouts.  BENCH_PROBE_SLEEPS="" -> single attempt, no retry.
+PROBE_SLEEPS = [int(s) for s in os.environ.get(
+    "BENCH_PROBE_SLEEPS", "60,120,240,480,480").split(",") if s]
 TPU_RUN_TIMEOUT_S = 3600
 CPU_RUN_TIMEOUT_S = 1800
 
+#: TPU v5e (v5 lite) dense peak — the standard MFU denominator.  fp32
+#: matmuls lower to multi-pass bf16 on this hardware, so fp32 legs are
+#: reported against the same bf16 peak (documented, not hidden).
+V5E_PEAK_BF16_FLOPS = 197e12
 
-def _probe_tpu():
-    """Check in a throwaway subprocess whether a non-CPU backend comes up."""
+
+def _probe_tpu_once():
+    """One throwaway-subprocess check whether a non-CPU backend comes up."""
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE], capture_output=True,
             text=True, timeout=PROBE_TIMEOUT_S)
     except subprocess.TimeoutExpired:
-        return None
+        return None, "probe-timeout"
     if r.returncode != 0:
-        return None
+        return None, f"probe-rc-{r.returncode}"
     try:
         info = json.loads(r.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
-        return None
-    return info if info.get("platform") not in (None, "cpu") else None
+        return None, "probe-unparseable"
+    if info.get("platform") in (None, "cpu"):
+        return None, f"probe-platform-{info.get('platform')}"
+    return info, "ok"
+
+
+def _probe_tpu_with_backoff(attempts_log):
+    """Retry the probe across a bounded window — the chip claim has been
+    observed to wedge and clear mid-round; one attempt undercounts.
+    Only the wedge signature (probe hanging until its timeout) retries:
+    a probe that ANSWERS quickly — platform 'cpu' on a TPU-less host, or
+    a deterministic import crash — cannot change on retry, and sleeping
+    ~23 min before the fallback would stall every CPU-only run."""
+    t0 = time.time()
+    for i, sleep_s in enumerate([0] + PROBE_SLEEPS):
+        if sleep_s:
+            time.sleep(sleep_s)
+        info, status = _probe_tpu_once()
+        attempts_log.append(
+            {"attempt": i + 1, "t_offset_s": round(time.time() - t0),
+             "status": status})
+        if info is not None:
+            return info
+        if status != "probe-timeout":
+            return None
+    return None
 
 
 def _emit(payload):
@@ -84,8 +124,9 @@ def _parse_last_json_line(stdout):
 
 
 def orchestrate():
-    probe = _probe_tpu()
-    attempts = []
+    probe_attempts = []
+    probe = _probe_tpu_with_backoff(probe_attempts)
+    attempts = [{"platform": "tpu", "probe_attempts": probe_attempts}]
     if probe is not None:
         try:
             r = subprocess.run(
@@ -94,6 +135,7 @@ def orchestrate():
             sys.stderr.write(r.stderr[-4000:])
             out = _parse_last_json_line(r.stdout)
             if r.returncode == 0 and out is not None:
+                out["tpu_probe_attempts"] = probe_attempts
                 _emit(out)
                 return 0
             attempts.append(
@@ -101,8 +143,6 @@ def orchestrate():
                  "stderr_tail": r.stderr[-500:]})
         except subprocess.TimeoutExpired:
             attempts.append({"platform": "tpu", "rc": "timeout"})
-    else:
-        attempts.append({"platform": "tpu", "rc": "probe-failed-or-hung"})
 
     # CPU fallback: forced-cpu jax in a child, scaled-down grid so the
     # 1-core host finishes in minutes.
@@ -140,6 +180,18 @@ def orchestrate():
     return 0
 
 
+def _glm_fit_flops(report, n, d, k):
+    """Executed fit-phase matmul FLOPs from the engine's per-launch
+    (iters, lanes) record.  One GLM L-BFGS iteration per lane = one
+    forward Ax (2*n*d*k) + one backward AT (2*n*d*k); the +20%-ish
+    line-search/elementwise work is excluded (MFU convention counts
+    useful matmul FLOPs only)."""
+    iters = report.get("solver_iters_per_launch", [])
+    lanes = report.get("lanes_per_launch", [])
+    il = sum(i * l for i, l in zip(iters, lanes))
+    return 4.0 * n * d * max(k, 1) * il, (max(iters) if iters else 0)
+
+
 def run_child(platform):
     import jax
     if platform == "cpu":
@@ -158,6 +210,8 @@ def run_child(platform):
 
     X, y = load_digits(return_X_y=True)
     X = (X / 16.0).astype(np.float32)
+    n_samples, n_feat = X.shape
+    n_classes = 10
 
     # Full-size grid on the chip; 1-core CPU gets a scaled-down grid
     # (the batched solver is ~100x slower there — minutes, not hours).
@@ -196,6 +250,24 @@ def run_child(platform):
             float(gs.cv_results_["mean_test_score"].max()), 4),
     }
 
+    # MFU accounting for the headline leg (honest: digits is
+    # latency-bound — 64 features cannot fill the MXU; the number exists
+    # to quantify that, the svc_mxu leg exists to show filled tiles)
+    rep = getattr(gs2, "_search_report", {}) or {}
+    glm_flops, glm_iters = _glm_fit_flops(rep, n_samples, n_feat, n_classes)
+    if glm_flops and dev_warm > 0:
+        fit_wall = rep.get("fit_wall_s", dev_warm) or dev_warm
+        detail["headline_mfu"] = {
+            "fit_matmul_gflops_total": round(glm_flops / 1e9, 1),
+            "solver_iters_max": glm_iters,
+            "fit_wall_s": round(fit_wall, 2),
+            "achieved_gflops_per_s": round(glm_flops / fit_wall / 1e9, 1),
+            "pct_of_bf16_peak": round(
+                100.0 * glm_flops / fit_wall / V5E_PEAK_BF16_FLOPS, 3),
+            "note": "digits (d=64) is latency/bandwidth-bound by design; "
+                    "see svc_mxu leg for an MXU-bound measurement",
+        }
+
     if on_tpu:
         # bf16 MXU variant (solver state fp32; oracle-tested parity ~1e-2)
         cfg16 = sst.TpuConfig(bf16_matmul=True,
@@ -215,8 +287,47 @@ def run_child(platform):
         })
 
     if on_tpu:
-        # breadth legs (guarded: they must never kill the headline) —
-        # BASELINE config #2 shape (SVC CxGamma) and a keyed fleet
+        # --- MXU leg: BASELINE config #2 shape (SVC rbf, C x gamma) ----
+        # synthetic MNIST-shaped BINARY problem: kernel builds are
+        # (10k, 784) @ (784, 10k) — exactly countable MXU FLOPs.
+        try:
+            from sklearn.svm import SVC
+            rng = np.random.RandomState(0)
+            n_sv, d_sv, folds_sv = 10_000, 784, 3
+            Xs = rng.randn(n_sv, d_sv).astype(np.float32)
+            ys = (Xs[:, :16].sum(axis=1) > 0).astype(np.int32)
+            svc_grid = {"C": [0.1, 1.0, 10.0, 100.0],
+                        "gamma": [1e-3, 1e-2]}
+            n_cand_svc = 8
+            max_iter_svc = 100
+            svc = sst.GridSearchCV(
+                SVC(max_iter=max_iter_svc), svc_grid, cv=folds_sv,
+                refit=False, backend="tpu", config=cache_cfg)
+            t0 = time.perf_counter()
+            svc.fit(Xs, ys)
+            svc_wall = time.perf_counter() - t0
+            # per candidate: kernel 2*n^2*d; power-step 40*n^2; dual
+            # ascent + decision (F*P + tiny) x (n, n) matmuls, P=1 binary
+            per_cand = (2.0 * n_sv * n_sv * d_sv
+                        + 40.0 * n_sv * n_sv
+                        + 2.0 * folds_sv * n_sv * n_sv * (max_iter_svc + 1))
+            svc_flops = per_cand * n_cand_svc
+            detail["svc_mxu"] = {
+                "shape": f"{n_sv}x{d_sv} binary, {n_cand_svc} cand x "
+                         f"{folds_sv} folds, max_iter={max_iter_svc}",
+                "wall_s": round(svc_wall, 2),
+                "fits_per_sec": round(n_cand_svc * folds_sv / svc_wall, 2),
+                "kernel_tflops_total": round(svc_flops / 1e12, 2),
+                "achieved_gflops_per_s": round(
+                    svc_flops / svc_wall / 1e9, 1),
+                "pct_of_bf16_peak": round(
+                    100.0 * svc_flops / svc_wall / V5E_PEAK_BF16_FLOPS, 2),
+                "best_score": round(float(
+                    svc.cv_results_["mean_test_score"].max()), 4),
+            }
+        except Exception as exc:  # pragma: no cover - breadth only
+            detail["svc_mxu_error"] = repr(exc)[:300]
+        # --- digits SVC leg (real-data sanity twin of r2) --------------
         try:
             from sklearn.svm import SVC
             svc_grid = {"C": list(np.logspace(-1, 2, 8)),
@@ -232,6 +343,73 @@ def run_child(platform):
                 svc.cv_results_["mean_test_score"].max()), 4)
         except Exception as exc:  # pragma: no cover - breadth only
             detail["svc_leg_error"] = repr(exc)[:200]
+        # --- BASELINE configs #3-#5, chip-sized (real covtype/California
+        # need network; synthetic stand-ins match their shapes, so walls
+        # and fits/sec are representative) -------------------------------
+        try:
+            from scipy.stats import randint
+            from sklearn.ensemble import RandomForestClassifier
+            rng = np.random.RandomState(1)
+            Xc = rng.randn(20_000, 54).astype(np.float32)
+            yc = rng.randint(0, 7, size=20_000)
+            rs = sst.RandomizedSearchCV(
+                RandomForestClassifier(random_state=0),
+                {"n_estimators": randint(20, 60),
+                 "max_depth": randint(4, 9)},
+                n_iter=8, cv=3, random_state=0, refit=False,
+                backend="tpu", config=cache_cfg)
+            t0 = time.perf_counter()
+            rs.fit(Xc, yc)
+            w = time.perf_counter() - t0
+            detail["config3_rf_randomized"] = {
+                "shape": "20000x54 (covtype-shaped), 8 iter x 3 folds",
+                "wall_s": round(w, 2),
+                "fits_per_sec": round(24 / w, 2),
+                "backend": rs.search_report["backend"]}
+        except Exception as exc:  # pragma: no cover - breadth only
+            detail["config3_error"] = repr(exc)[:200]
+        try:
+            from sklearn.ensemble import GradientBoostingRegressor
+            rng = np.random.RandomState(2)
+            Xh = rng.randn(20_000, 8).astype(np.float32)
+            yh = (Xh[:, 0] * 2 + Xh[:, 1] ** 2
+                  + 0.3 * rng.randn(20_000)).astype(np.float32)
+            gbr = sst.GridSearchCV(
+                GradientBoostingRegressor(max_depth=3, random_state=0),
+                {"learning_rate": [0.05, 0.1],
+                 "n_estimators": [50, 100]}, cv=3, refit=False,
+                backend="tpu", config=cache_cfg)
+            t0 = time.perf_counter()
+            gbr.fit(Xh, yh)
+            w = time.perf_counter() - t0
+            detail["config4_gbr_grid"] = {
+                "shape": "20000x8 (California-shaped), 4 cand x 3 folds",
+                "wall_s": round(w, 2),
+                "fits_per_sec": round(12 / w, 2),
+                "backend": gbr.search_report["backend"]}
+        except Exception as exc:  # pragma: no cover - breadth only
+            detail["config4_error"] = repr(exc)[:200]
+        try:
+            from sklearn.neural_network import MLPClassifier
+            from sklearn.pipeline import Pipeline
+            from sklearn.preprocessing import StandardScaler
+            pipe = Pipeline([
+                ("scale", StandardScaler()),
+                ("mlp", MLPClassifier(hidden_layer_sizes=(64,),
+                                      max_iter=60, random_state=0))])
+            mlp = sst.GridSearchCV(
+                pipe, {"mlp__alpha": [1e-4, 1e-3, 1e-2, 1e-1]}, cv=3,
+                refit=False, backend="tpu", config=cache_cfg)
+            t0 = time.perf_counter()
+            mlp.fit(X, y)
+            w = time.perf_counter() - t0
+            detail["config5_scaler_mlp"] = {
+                "shape": "digits, 4 alpha x 3 folds",
+                "wall_s": round(w, 2),
+                "fits_per_sec": round(12 / w, 2),
+                "backend": mlp.search_report["backend"]}
+        except Exception as exc:  # pragma: no cover - breadth only
+            detail["config5_error"] = repr(exc)[:200]
         try:
             import pandas as pd
             from sklearn.linear_model import LinearRegression
@@ -277,7 +455,7 @@ def run_child(platform):
     vs_baseline = spark8_proxy / dev_warm
 
     label = "TPU" if on_tpu else "CPU-fallback"
-    _emit({
+    payload = {
         "metric": f"GridSearchCV {n_candidates}x{n_folds} LogReg digits — "
                   f"fits/sec on {label} "
                   "(speedup vs ideal 8-exec Spark-CPU proxy)",
@@ -286,7 +464,13 @@ def run_child(platform):
         "vs_baseline": round(vs_baseline, 2),
         "platform": real_platform if on_tpu else "cpu-fallback",
         "detail": detail,
-    })
+    }
+    if not on_tpu:
+        payload["note"] = (
+            "CPU smoke fallback on a scaled-down grid: measures XLA:CPU "
+            "launch overhead on a 1-core host, NOT TPU performance — "
+            "vs_baseline on this platform is not a framework figure")
+    _emit(payload)
     return 0
 
 

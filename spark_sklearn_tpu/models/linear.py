@@ -195,7 +195,7 @@ class LogisticRegressionFamily(Family):
                     [g, jnp.zeros((B, 1), X.dtype)], axis=1)
 
             if use_fista:
-                res = _fista_elasticnet(
+                res, n_exec = _fista_elasticnet(
                     Ax, data_loss, data_grad, AT, inv_C_raw, l1_ratio,
                     B, d + 1, d, X.dtype, max_iter, tol)
             else:
@@ -203,12 +203,14 @@ class LogisticRegressionFamily(Family):
                     Ax, data_loss, data_grad, AT, reg_loss, reg_grad,
                     jnp.zeros((B, d + 1), X.dtype), max_iter=max_iter,
                     tol=tol)
+                n_exec = res.n_iter
             W = res.x[:, :d]
             b = res.x[:, d]
             if not fit_intercept:
                 b = jnp.zeros_like(b)
             return {"coef": W[:, None, :], "intercept": b[:, None],
-                    "converged": res.converged, "n_iter": res.n_iter}
+                    "converged": res.converged, "n_iter": res.n_iter,
+                    "n_iter_exec": n_exec}
 
         y1h = data["y1h"]                                     # (n, k)
         kd = k * d
@@ -245,19 +247,21 @@ class LogisticRegressionFamily(Family):
                 [g, jnp.zeros((B, k), X.dtype)], axis=1)
 
         if use_fista:
-            res = _fista_elasticnet(
+            res, n_exec = _fista_elasticnet(
                 Ax, data_loss, data_grad, AT, inv_C_raw, l1_ratio,
                 B, kd + k, kd, X.dtype, max_iter, tol, curvature=0.5)
         else:
             res = glm_lbfgs_batched(
                 Ax, data_loss, data_grad, AT, reg_loss, reg_grad,
                 jnp.zeros((B, kd + k), X.dtype), max_iter=max_iter, tol=tol)
+            n_exec = res.n_iter
         W = res.x[:, :kd].reshape(B, k, d)
         b = res.x[:, kd:]
         if not fit_intercept:
             b = jnp.zeros_like(b)
         return {"coef": W, "intercept": b,
-                "converged": res.converged, "n_iter": res.n_iter}
+                "converged": res.converged, "n_iter": res.n_iter,
+                "n_iter_exec": n_exec}
 
     @classmethod
     def decision(cls, model, static, X, meta):
@@ -265,6 +269,43 @@ class LogisticRegressionFamily(Family):
         if meta["n_classes"] == 2:
             return Z[:, 0]
         return Z
+
+    @classmethod
+    def views_task_batched(cls, models, static, data, meta, needed):
+        """Scorer views for ALL tasks from ONE wide matmul.
+
+        `models` carries a flat leading task axis T (coef (T, k, d),
+        intercept (T, k)); the logits for every task come from a single
+        `X @ W_all^T` contraction of width T*k — the scoring twin of
+        `fit_task_batched`'s wide-matmul layout (a vmap of per-task
+        matvecs leaves the MXU tiles mostly empty for small k)."""
+        X = data["X"]
+        n = X.shape[0]
+        W = models["coef"]                                 # (T, k, d)
+        b = models["intercept"]                            # (T, k)
+        T, k, d = W.shape
+        Z = jnp.matmul(X, W.reshape(T * k, d).T,           # ONE matmul
+                       preferred_element_type=X.dtype)
+        Z = Z.reshape(n, T, k) + b[None]
+        Z = jnp.moveaxis(Z, 0, 1)                          # (T, n, k)
+        views = {}
+        if meta["n_classes"] == 2:
+            z = Z[:, :, 0]                                 # (T, n)
+            if "decision" in needed:
+                views["decision"] = z
+            if "pred" in needed:
+                views["pred"] = (z > 0).astype(jnp.int32)
+            if "proba" in needed:
+                p1 = jax.nn.sigmoid(z)
+                views["proba"] = jnp.stack([1.0 - p1, p1], axis=-1)
+        else:
+            if "decision" in needed:
+                views["decision"] = Z
+            if "pred" in needed:
+                views["pred"] = jnp.argmax(Z, axis=-1).astype(jnp.int32)
+            if "proba" in needed:
+                views["proba"] = jax.nn.softmax(Z, axis=-1)
+        return views
 
     @classmethod
     def predict(cls, model, static, X, meta):
@@ -319,7 +360,9 @@ def _fista_elasticnet(Ax, data_loss, data_grad, AT, inv_C, l1_ratio,
         max_iter=max(10 * max_iter, 1000), tol=tol, curvature=curvature)
     n_rep = jnp.where(res.converged,
                       jnp.minimum(res.n_iter, max_iter - 1), max_iter)
-    return res._replace(n_iter=n_rep)
+    # (rescaled-for-sklearn, actually-executed): FLOP/MFU accounting must
+    # see the internal budget's true count, not the max_iter-axis rescale
+    return res._replace(n_iter=n_rep), res.n_iter
 
 
 # ----------------------------------------------------------------------------
@@ -378,6 +421,16 @@ class RidgeFamily(Family):
     @classmethod
     def predict(cls, model, static, X, meta):
         return X @ model["coef"] + model["intercept"]
+
+    @classmethod
+    def views_task_batched(cls, models, static, data, meta, needed):
+        """All T tasks' predictions as ONE (n, d) @ (d, T) matmul."""
+        if "pred" not in needed:
+            return {}
+        X = data["X"]
+        pred = jnp.matmul(X, models["coef"].T,
+                          preferred_element_type=X.dtype)   # (n, T)
+        return {"pred": (pred + models["intercept"][None]).T}
 
     @classmethod
     def sklearn_attrs(cls, model, static, meta):
@@ -467,6 +520,7 @@ class ElasticNetFamily(Family):
         return {"coef": w, "intercept": intercept}
 
     predict = RidgeFamily.predict
+    views_task_batched = RidgeFamily.views_task_batched
     sklearn_attrs = RidgeFamily.sklearn_attrs
 
 

@@ -306,9 +306,11 @@ class MLPClassifierFamily(Family):
             no_improve = jnp.where(improved_tol, 0, s["no_improve"] + 1)
             trigger = no_improve > n_iter_no_change
             if solver == "sgd" and lr_schedule == "adaptive":
-                # sklearn: divide lr by 5 and keep going; stop once the
-                # effective lr has decayed below 1e-6
-                can_decay = lr_eff / 5.0 > 1e-6
+                # sklearn SGDOptimizer.trigger_stopping: while the CURRENT
+                # lr is above 1e-6, divide by 5 and keep going; only stop
+                # when the current lr has already decayed to <= 1e-6 (one
+                # more decay round than gating on lr/5)
+                can_decay = lr_eff > 1e-6
                 lr_div = jnp.where(jnp.logical_and(trigger, can_decay),
                                    s["lr_div"] * 5.0, s["lr_div"])
                 stop = jnp.logical_and(trigger,

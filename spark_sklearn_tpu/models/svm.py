@@ -69,25 +69,63 @@ def _power_step(K, n, dtype):
     return 1.0 / (jnp.dot(v, K @ v) + 1e-6)
 
 
-def _box_fista(grad_fn, project, x0, step, max_iter):
+def _box_fista(grad_fn, project, x0, step, max_iter, tol=None):
     """Nesterov-accelerated projected gradient on a constrained QP — the
     ONE loop behind every dual here (SVC pairs, nu-duals, SVR pairs, the
     liblinear hinge/epsilon duals): the TPU answer to libsvm/liblinear's
     sequential working-set and coordinate-descent solvers, where every
     (subproblem, sample) coordinate advances together through wide
-    matmuls.  Minimises; ascent callers negate their gradient."""
+    matmuls.  Minimises; ascent callers negate their gradient.
+
+    With `tol=None` (SVC/NuSVC duals, which check KKT themselves) runs a
+    fixed iteration count and returns `x`.  With a per-lane `tol` array
+    (leading axis of x0 = lanes) it ALSO measures convergence honestly:
+    the per-lane prox-gradient residual max|z - prox(z - step*grad(z))|
+    divided by `step` — the generalized-gradient magnitude, so the
+    criterion is scale-free in the step size (an absolute iterate-shift
+    test would spuriously fire on the first iteration whenever
+    1/lambda_max(Gram) < tol).  Not liblinear's dual-violation bound,
+    but a real measurement rather than an assumed one.  Exits once every
+    lane has converged and returns (x, n_iter, converged)."""
     dtype = x0.dtype
 
-    def body(i, carry):
-        x, z, t = carry
+    if tol is None:
+        def body(i, carry):
+            x, z, t = carry
+            x_new = project(z - step * grad_fn(z))
+            t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+            z_new = x_new + ((t - 1.0) / t_new) * (x_new - x)
+            return x_new, z_new, t_new
+
+        x, _, _ = jax.lax.fori_loop(
+            0, max_iter, body, (x0, x0, jnp.asarray(1.0, dtype)))
+        return x
+
+    lane_axes = tuple(range(1, x0.ndim))
+    B = x0.shape[0]
+
+    def cond(carry):
+        *_, it, _n, done = carry
+        return jnp.logical_and(it < max_iter,
+                               jnp.logical_not(jnp.all(done)))
+
+    def body(carry):
+        x, z, t, it, n_iter, done = carry
         x_new = project(z - step * grad_fn(z))
         t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
         z_new = x_new + ((t - 1.0) / t_new) * (x_new - x)
-        return x_new, z_new, t_new
+        resid = jnp.max(jnp.abs(x_new - z), axis=lane_axes) / step
+        done_new = jnp.logical_or(done, resid <= tol)
+        n_iter = jnp.where(jnp.logical_and(jnp.logical_not(done),
+                                           done_new), it + 1, n_iter)
+        return x_new, z_new, t_new, it + 1, n_iter, done_new
 
-    x, _, _ = jax.lax.fori_loop(
-        0, max_iter, body, (x0, x0, jnp.asarray(1.0, dtype)))
-    return x
+    x, _, _, it, n_iter, done = jax.lax.while_loop(
+        cond, body,
+        (x0, x0, jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32),
+         jnp.full((B,), max_iter, jnp.int32), jnp.zeros((B,), bool)))
+    n_iter = jnp.where(done, n_iter, it)
+    return x, n_iter, done
 
 
 def _project_box_hyperplane(Z, yb, bound, n_bisect=40):
@@ -252,6 +290,43 @@ def fista_dual_ascent(K, yb, bound, step, max_iter):
         grad, lambda Zt: _project_box_hyperplane(Zt, yb, bound),
         jnp.zeros_like(bound), step, max_iter)
     return A, _kkt_intercept(K, A, yb, bound)
+
+
+def _platt_fit(f, t, w, n_iter=50):
+    """Vectorized Platt sigmoid calibration: per task (leading axis),
+    minimise the weighted logloss of P(y=1|f) = sigmoid(-(A*f + B))
+    against Platt's smoothed targets `t` with sample weights `w`, by
+    damped Newton on the 2-parameter convex problem (closed-form 2x2
+    solve per task — libsvm's sigmoid_train, batched).
+
+    Returns (A, B) arrays of shape f.shape[:1]."""
+    B_ = f.shape[0]
+    dtype = f.dtype
+    wsum = jnp.sum(w, axis=1) + 1e-12
+    # libsvm init: A=0, B=log((prior0+1)/(prior1+1)) from the targets
+    np_w = jnp.sum(w * t, axis=1)
+    nn_w = wsum - np_w
+    A0 = jnp.zeros((B_,), dtype)
+    B0 = jnp.log((nn_w + 1.0) / (np_w + 1.0))
+
+    def body(i, carry):
+        A, Bb = carry
+        u = A[:, None] * f + Bb[:, None]
+        s = jax.nn.sigmoid(u)                    # = 1 - p
+        r = w * (s - (1.0 - t))                  # dL/du per sample
+        gA = jnp.sum(r * f, axis=1)
+        gB = jnp.sum(r, axis=1)
+        h = w * s * (1.0 - s)
+        hAA = jnp.sum(h * f * f, axis=1) + 1e-9
+        hAB = jnp.sum(h * f, axis=1)
+        hBB = jnp.sum(h, axis=1) + 1e-9
+        det = hAA * hBB - hAB * hAB
+        dA = (hBB * gA - hAB * gB) / det
+        dB = (hAA * gB - hAB * gA) / det
+        return A - dA, Bb - dB
+
+    A, Bb = jax.lax.fori_loop(0, n_iter, body, (A0, B0))
+    return A, Bb
 
 
 def _resolve_gamma(gamma, meta):
@@ -422,7 +497,25 @@ class SVCFamily(Family):
         _, decs = jax.lax.scan(
             one_candidate, 0.0, (C_cand, g_cand, w_cand))
         # (nc, F, n, P) -> task-major (B, n, P)
-        return {"pair_dec": decs.reshape(B, n, P)}
+        model = {"pair_dec": decs.reshape(B, n, P)}
+        if bool(static.get("probability", False)) and k == 2:
+            # compiled Platt scaling (binary): calibrate a sigmoid on the
+            # TRAIN-fold decision values per task, stored with the model
+            # so predict_proba / neg_log_loss scoring stay compiled.
+            # Approximation vs libsvm: libsvm calibrates on internal
+            # 5-fold CV decisions; these are in-sample train decisions
+            # (slightly overconfident — documented in docs/ROADMAP.md).
+            # Multiclass (pairwise coupling) stays on the host path.
+            fdec = model["pair_dec"][:, :, 0]                 # (B, n)
+            ypos = (y == 1).astype(X.dtype)[None, :]          # classes_[1]
+            np_w = jnp.sum(train_w * ypos, axis=1)
+            nn_w = jnp.sum(train_w * (1.0 - ypos), axis=1)
+            t_pos = (np_w + 1.0) / (np_w + 2.0)
+            t_neg = 1.0 / (nn_w + 2.0)
+            t = jnp.where(ypos > 0, t_pos[:, None], t_neg[:, None])
+            A, Bb = _platt_fit(fdec, t, train_w)
+            model["platt"] = jnp.stack([A, Bb], axis=1)       # (B, 2)
+        return model
 
     # -- prediction from cached decisions (search-internal) ---------------
     @classmethod
@@ -452,6 +545,22 @@ class SVCFamily(Family):
         if meta["n_classes"] == 2:
             return model["pair_dec"][:, 0]
         return cls._votes(model["pair_dec"], meta)
+
+    @classmethod
+    def predict_proba(cls, model, static, X, meta):
+        """Compiled Platt probabilities (binary, probability=True —
+        calibration fitted alongside the duals in fit_task_batched).
+        Multiclass pairwise coupling is not compiled: raising here sends
+        proba-scoring searches to the host tier, and user-facing
+        predict_proba comes from the sklearn refit best_estimator_."""
+        if "platt" not in model:
+            raise NotImplementedError(
+                "predict_proba is compiled only for binary "
+                "SVC(probability=True)")
+        f = model["pair_dec"][:, 0]
+        A, B = model["platt"][0], model["platt"][1]
+        p1 = jax.nn.sigmoid(-(A * f + B))
+        return jnp.stack([1.0 - p1, p1], axis=1)
 
     @classmethod
     def sklearn_attrs(cls, model, static, meta):

@@ -400,7 +400,8 @@ class LinearSVCFamily(Family):
                 return jnp.clip(a, 0.0, bound)
 
             a0 = jnp.zeros((B, ko, n), X.dtype)
-            a = _box_fista(grad, project, a0, step, max_iter)
+            a, n_iter, converged = _box_fista(
+                grad, project, a0, step, max_iter, tol=tol)
             W = jnp.einsum("bkn,nd->bkd", a * Tt, Xa)  # (B, ko, da)
             if fit_intercept:
                 coef, intercept = W[:, :, :d], W[:, :, d] * isc
@@ -408,8 +409,7 @@ class LinearSVCFamily(Family):
                 coef = W
                 intercept = jnp.zeros((B, ko), X.dtype)
             return {"coef": coef, "intercept": intercept,
-                    "converged": jnp.ones((B,), bool),
-                    "n_iter": jnp.full((B,), max_iter, jnp.int32)}
+                    "converged": converged, "n_iter": n_iter}
 
         def Ax(x):                                    # (B, da*ko) -> Z
             W = x.reshape(B, ko, da)
@@ -459,6 +459,29 @@ class LinearSVCFamily(Family):
         if meta["n_classes"] == 2:
             return (Z > 0).astype(jnp.int32)
         return jnp.argmax(Z, axis=-1).astype(jnp.int32)
+
+    @classmethod
+    def views_task_batched(cls, models, static, data, meta, needed):
+        """Scorer views for all T tasks from one wide `X @ W_all^T`
+        matmul (coef (T, ko, d) — the ovr/binary twin of the GLM
+        family's wide scoring layout)."""
+        X = data["X"]
+        n = X.shape[0]
+        W = models["coef"]                                 # (T, ko, d)
+        b = models["intercept"]                            # (T, ko)
+        T, ko, d = W.shape
+        Z = jnp.matmul(X, W.reshape(T * ko, d).T,
+                       preferred_element_type=X.dtype)
+        Z = jnp.moveaxis(Z.reshape(n, T, ko) + b[None], 0, 1)  # (T, n, ko)
+        z = Z[:, :, 0] if meta["n_classes"] == 2 else Z
+        views = {}
+        if "decision" in needed:
+            views["decision"] = z
+        if "pred" in needed:
+            views["pred"] = (z > 0).astype(jnp.int32) \
+                if meta["n_classes"] == 2 \
+                else jnp.argmax(Z, axis=-1).astype(jnp.int32)
+        return views
 
     @classmethod
     def sklearn_attrs(cls, model, static, meta):
@@ -543,8 +566,9 @@ class LinearSVRFamily(Family):
                     jnp.abs(b) - step * eps_t[:, None], 0.0)
                 return jnp.clip(s, -bound, bound)
 
-            beta = _box_fista(grad, project,
-                              jnp.zeros((B, n), X.dtype), step, max_iter)
+            beta, n_iter, converged = _box_fista(
+                grad, project, jnp.zeros((B, n), X.dtype), step, max_iter,
+                tol=tol)
             Wd = beta @ Xa                              # (B, da)
             if fit_intercept:
                 coef, intercept = Wd[:, :d], Wd[:, d] * isc
@@ -552,8 +576,7 @@ class LinearSVRFamily(Family):
                 coef = Wd
                 intercept = jnp.zeros((B,), X.dtype)
             return {"coef": coef, "intercept": intercept,
-                    "converged": jnp.ones((B,), bool),
-                    "n_iter": jnp.full((B,), max_iter, jnp.int32)}
+                    "converged": converged, "n_iter": n_iter}
 
         def Ax(x):                                      # (B, da) -> (n, B)
             return Xa @ x.T
@@ -586,6 +609,16 @@ class LinearSVRFamily(Family):
     @classmethod
     def predict(cls, model, static, X, meta):
         return X @ model["coef"] + model["intercept"]
+
+    @classmethod
+    def views_task_batched(cls, models, static, data, meta, needed):
+        """All T tasks' predictions as ONE (n, d) @ (d, T) matmul."""
+        if "pred" not in needed:
+            return {}
+        X = data["X"]
+        pred = jnp.matmul(X, models["coef"].T,
+                          preferred_element_type=X.dtype)   # (n, T)
+        return {"pred": (pred + models["intercept"][None]).T}
 
     @classmethod
     def sklearn_attrs(cls, model, static, meta):

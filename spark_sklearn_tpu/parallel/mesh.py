@@ -120,3 +120,26 @@ def task_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def device_get_tree(x):
+    """`jax.device_get` that also works under multi-controller JAX.
+
+    In a multi-process cluster (jax.distributed, SURVEY §5.8) the
+    engine's launch outputs are globally sharded over a mesh spanning
+    processes, so a plain device_get would raise on the non-addressable
+    shards; process_allgather replicates them across hosts first (one
+    XLA all-gather over the cluster's transport — the analog of Spark's
+    collect() back to the driver, except every host gets the result).
+    Single-process: plain device_get, zero overhead."""
+    if jax.process_count() == 1:
+        return jax.device_get(x)
+    from jax.experimental import multihost_utils
+
+    def one(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            return np.asarray(
+                multihost_utils.process_allgather(a, tiled=True))
+        return jax.device_get(a)
+
+    return jax.tree_util.tree_map(one, x)

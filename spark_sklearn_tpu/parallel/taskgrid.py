@@ -113,7 +113,9 @@ def freeze(v: Any, strict: bool = False):
     them is raw PyObject pointers, and a recycled address would alias two
     different values."""
     if isinstance(v, dict):
-        return tuple(sorted((str(k), freeze(x, strict))
+        # key by (type, str) so {1: v} and {"1": v} freeze differently —
+        # a str(k) collision would alias two distinct cache keys
+        return tuple(sorted((type(k).__name__, str(k), freeze(x, strict))
                             for k, x in v.items()))
     if isinstance(v, (list, tuple)):
         return ("__seq__",) + tuple(freeze(x, strict) for x in v)

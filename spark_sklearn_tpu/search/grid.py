@@ -59,6 +59,7 @@ from spark_sklearn_tpu.parallel.taskgrid import build_compile_groups
 from spark_sklearn_tpu.search.scorers import (
     BINARY_ONLY_SCORERS,
     CLASSIFICATION_SCORERS,
+    build_view,
     resolve_scoring,
 )
 from spark_sklearn_tpu.utils.native import fold_masks
@@ -1161,8 +1162,67 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     return jax.vmap(one_fold)(train_m)
                 return jax.vmap(one_cand)(dyn_arrs)
 
-            def score_batch(models, data_d, test_m, train_m, test_u,
-                            train_u, static=static):
+            # score path: every registry scorer decomposes into model
+            # views (pred/decision/proba) + a metric core, so views are
+            # computed ONCE per launch over the flat task axis — for
+            # linear families one wide matmul for ALL (candidate x fold)
+            # tasks (`views_task_batched`) instead of a matvec per task
+            # per scorer — then the cheap reduction cores vmap over
+            # tasks.  Custom scorers without a core (family
+            # default_scorer like KMeans -inertia) keep the nested path.
+            import os as _os
+            all_cores = all(hasattr(fn, "core")
+                            for fn in scorers.values()) \
+                and not _os.environ.get("SST_NESTED_SCORE")
+            needed_views = frozenset(
+                v for fn in scorers.values()
+                for v in getattr(fn, "views", ()))
+
+            def score_batch_wide(models, data_d, test_m, train_m, test_u,
+                                 train_u, static=static):
+                leaf = jax.tree_util.tree_leaves(models)[0]
+                ncb, nf = leaf.shape[0], leaf.shape[1]
+                n_tasks = ncb * nf
+                flat = jax.tree_util.tree_map(
+                    lambda l: l.reshape((n_tasks,) + l.shape[2:]), models)
+                views = {}
+                wide = getattr(family, "views_task_batched", None)
+                if wide is not None:
+                    views = dict(wide(flat, static, data_d, meta,
+                                      needed_views))
+                for name in needed_views:
+                    if name not in views:
+                        views[name] = jax.vmap(
+                            lambda m, name=name: build_view(
+                                name, family, m, static, data_d, meta))(flat)
+
+                y = data_d.get("y")
+                # fold masks are indexed per task (t % n_folds,
+                # candidate-major flattening) instead of tiled to (T, n):
+                # the gather fuses into the reduction cores, where a tile
+                # would materialize ncb copies of every mask buffer
+                fold_idx = jnp.arange(n_tasks, dtype=jnp.int32) % nf
+
+                def one_task(view_t, fi):
+                    wte, wtr = test_m[fi], train_m[fi]
+                    wteu, wtru = test_u[fi], train_u[fi]
+                    te = {s: fn.core(view_t, y,
+                                     wteu if s in sw_blind else wte, meta)
+                          for s, fn in scorers.items()}
+                    tr = ({s: fn.core(view_t, y,
+                                      wtru if s in sw_blind else wtr, meta)
+                           for s, fn in scorers.items()}
+                          if return_train else {})
+                    return te, tr
+
+                te, tr = jax.vmap(one_task)(views, fold_idx)
+                return (jax.tree_util.tree_map(
+                            lambda a: a.reshape(ncb, nf), te),
+                        jax.tree_util.tree_map(
+                            lambda a: a.reshape(ncb, nf), tr))
+
+            def score_batch_nested(models, data_d, test_m, train_m, test_u,
+                                   train_u, static=static):
                 def one_cand(model_c):
                     def one_fold(model, w_test, w_train, w_test_u,
                                  w_train_u):
@@ -1179,13 +1239,17 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         model_c, test_m, train_m, test_u, train_u)
                 return jax.vmap(one_cand)(models)
 
+            score_batch = score_batch_wide if all_cores \
+                else score_batch_nested
+
             if not task_batched:
                 fit_jit = _cached_program(
                     ("fit", family, static, meta, mesh),
                     lambda: jax.jit(fit_batch, out_shardings=task_shard))
             score_jit = _cached_program(
                 ("score", family, static, meta,
-                 tuple(sorted(scorers.items())), return_train, sw_blind),
+                 tuple(sorted(scorers.items())), return_train, sw_blind,
+                 bool(all_cores)),
                 lambda: jax.jit(score_batch))
 
             for lo in range(0, nc, nc_batch):
@@ -1240,13 +1304,31 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 bad = health_jit(models)
                 if bad is not None:
                     fit_failed[idx, :] |= np.asarray(
-                        jax.device_get(bad))[:hi - lo]
+                        mesh_lib.device_get_tree(bad))[:hi - lo]
+
+                # solver-iteration accounting for FLOP/MFU reporting
+                # (bench.py): lockstep batched solvers execute max-over-
+                # lanes iterations, so (iters, lanes) per launch times the
+                # family's per-lane-per-iteration matmul FLOPs is the
+                # executed compute
+                if isinstance(models, dict) and (
+                        "n_iter" in models or "n_iter_exec" in models):
+                    # prefer the solver's true executed count over any
+                    # sklearn-facing rescale (FISTA reports n_iter on the
+                    # caller's max_iter axis but runs a larger internal
+                    # budget)
+                    it_arr = models.get("n_iter_exec", models.get("n_iter"))
+                    report.setdefault("solver_iters_per_launch", []).append(
+                        int(np.max(np.asarray(
+                            mesh_lib.device_get_tree(it_arr)))))
+                    report.setdefault("lanes_per_launch", []).append(
+                        int(nc_batch * n_folds))
 
                 t0 = time.perf_counter()
                 te, tr = score_jit(models, data_dev, test_dev, train_sc_dev,
                                    test_unw_dev, train_unw_dev)
-                te = jax.device_get(te)
-                tr = jax.device_get(tr)
+                te = mesh_lib.device_get_tree(te)
+                tr = mesh_lib.device_get_tree(tr)
                 t_score = time.perf_counter() - t0
                 del models
 
@@ -1266,6 +1348,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 report["n_launches"] += 1
                 report["fit_wall_s"] += t_fit
                 report["score_wall_s"] += t_score
+                # per-compile-group walls: candidates in different groups
+                # (or chunks) carry genuinely different launch timings —
+                # only candidates fused into ONE launch share a
+                # per-launch average (XLA executes them as one program,
+                # so a finer split is not measurable; see ROADMAP)
+                pg = report.setdefault("per_group", {})
+                rec = pg.setdefault(gi, {"static_params": repr(
+                    group.static_params), "n_launches": 0,
+                    "fit_wall_s": 0.0, "score_wall_s": 0.0})
+                rec["n_launches"] += 1
+                rec["fit_wall_s"] += t_fit
+                rec["score_wall_s"] += t_score
                 if self.verbose > 1:
                     self._print_task_end_lines(
                         candidates, idx, n_folds, scorer_names,

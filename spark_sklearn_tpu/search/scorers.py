@@ -9,6 +9,16 @@ tests/test_scorers.py).
 
 Weighted-mask convention: `w` is 1.0 on the fold's samples, 0.0 elsewhere;
 all means are weighted means over `w`.
+
+Every metric is split into a **view requirement** and a **metric core**:
+views are the model's outputs on the dataset ("pred", "decision",
+"proba") and cores are pure reductions `core(views, y, w, meta)`.  The
+split is what lets the search engine compute each view ONCE per launch
+for ALL (candidate x fold) tasks — for linear families a single wide
+matmul (`views_task_batched`) instead of one matvec per task per scorer —
+and share it across every scorer in a multimetric search.  The public
+callables keep the legacy per-task signature
+`(family, model, static, data, meta, w)` for direct use and tests.
 """
 
 from __future__ import annotations
@@ -21,6 +31,10 @@ import numpy as np
 
 EPS = 1e-12
 
+#: view name -> per-task builder (the generic path; families may batch
+#: these over the task axis themselves via `views_task_batched`)
+VIEW_BUILDERS: Dict[str, Callable] = {}
+
 
 def _wsum(w):
     return jnp.sum(w) + EPS
@@ -32,44 +46,74 @@ def _feats(data):
     return data["X"] if "X" in data else data["codes"]
 
 
-def _accuracy(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    return jnp.sum(w * (pred == data["y"])) / _wsum(w)
+def build_view(name, family, model, static, data, meta):
+    return VIEW_BUILDERS[name](family, model, static, data, meta)
 
 
-def _neg_log_loss(family, model, static, data, meta, w):
-    proba = family.predict_proba(model, static, _feats(data), meta)
-    p = jnp.clip(proba[jnp.arange(proba.shape[0]), data["y"]], 1e-15, 1.0)
+VIEW_BUILDERS["pred"] = lambda family, model, static, data, meta: \
+    family.predict(model, static, _feats(data), meta)
+VIEW_BUILDERS["decision"] = lambda family, model, static, data, meta: \
+    family.decision(model, static, _feats(data), meta)
+VIEW_BUILDERS["proba"] = lambda family, model, static, data, meta: \
+    family.predict_proba(model, static, _feats(data), meta)
+
+
+def _scorer(*views):
+    """Wrap a metric core into the legacy per-task scorer callable while
+    exposing `.views` / `.core` for the engine's task-batched path."""
+    def deco(core):
+        def fn(family, model, static, data, meta, w):
+            v = {name: build_view(name, family, model, static, data, meta)
+                 for name in views}
+            return core(v, data["y"], w, meta)
+        fn.views = views
+        fn.core = core
+        fn.__name__ = core.__name__
+        fn.__doc__ = core.__doc__
+        return fn
+    return deco
+
+
+@_scorer("pred")
+def _accuracy(v, y, w, meta):
+    return jnp.sum(w * (v["pred"] == y)) / _wsum(w)
+
+
+@_scorer("proba")
+def _neg_log_loss(v, y, w, meta):
+    proba = v["proba"]
+    p = jnp.clip(proba[jnp.arange(proba.shape[0]), y], 1e-15, 1.0)
     return -(jnp.sum(w * -jnp.log(p)) / _wsum(w))
 
 
-def _binary_counts(family, model, static, data, meta, w, positive=1):
-    pred = family.predict(model, static, _feats(data), meta)
-    y = data["y"]
+def _binary_counts(pred, y, w, positive=1):
     tp = jnp.sum(w * ((pred == positive) & (y == positive)))
     fp = jnp.sum(w * ((pred == positive) & (y != positive)))
     fn = jnp.sum(w * ((pred != positive) & (y == positive)))
     return tp, fp, fn
 
 
-def _f1(family, model, static, data, meta, w):
-    tp, fp, fn = _binary_counts(family, model, static, data, meta, w)
+@_scorer("pred")
+def _f1(v, y, w, meta):
+    tp, fp, fn = _binary_counts(v["pred"], y, w)
     return 2 * tp / jnp.maximum(2 * tp + fp + fn, EPS)
 
 
-def _precision(family, model, static, data, meta, w):
-    tp, fp, fn = _binary_counts(family, model, static, data, meta, w)
+@_scorer("pred")
+def _precision(v, y, w, meta):
+    tp, fp, fn = _binary_counts(v["pred"], y, w)
     return tp / jnp.maximum(tp + fp, EPS)
 
 
-def _recall(family, model, static, data, meta, w):
-    tp, fp, fn = _binary_counts(family, model, static, data, meta, w)
+@_scorer("pred")
+def _recall(v, y, w, meta):
+    tp, fp, fn = _binary_counts(v["pred"], y, w)
     return tp / jnp.maximum(tp + fn, EPS)
 
 
-def _f1_macro(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    y = data["y"]
+@_scorer("pred")
+def _f1_macro(v, y, w, meta):
+    pred = v["pred"]
     k = meta["n_classes"]
 
     def per_class(c):
@@ -81,11 +125,11 @@ def _f1_macro(family, model, static, data, meta, w):
     return jnp.mean(jax.vmap(per_class)(jnp.arange(k)))
 
 
-def _balanced_accuracy(family, model, static, data, meta, w):
+@_scorer("pred")
+def _balanced_accuracy(v, y, w, meta):
     """Macro-average recall over classes present in the fold (sklearn
     semantics: classes absent from y_true drop out of the mean)."""
-    pred = family.predict(model, static, _feats(data), meta)
-    y = data["y"]
+    pred = v["pred"]
     k = meta["n_classes"]
 
     def per_class(c):
@@ -98,10 +142,9 @@ def _balanced_accuracy(family, model, static, data, meta, w):
     return jnp.sum(recalls * present) / jnp.maximum(jnp.sum(present), 1.0)
 
 
-def _explained_variance(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    y = data["y"]
-    err = y - pred
+@_scorer("pred")
+def _explained_variance(v, y, w, meta):
+    err = y - v["pred"]
     ebar = jnp.sum(w * err) / _wsum(w)
     var_err = jnp.sum(w * (err - ebar) ** 2) / _wsum(w)
     ybar = jnp.sum(w * y) / _wsum(w)
@@ -109,12 +152,12 @@ def _explained_variance(family, model, static, data, meta, w):
     return 1.0 - var_err / jnp.maximum(var_y, EPS)
 
 
-def _neg_msle(family, model, static, data, meta, w):
+@_scorer("pred")
+def _neg_msle(v, y, w, meta):
     # sklearn RAISES on negative targets/predictions; inside a compiled
     # program we return NaN instead, which surfaces through the
     # non-finite-score warning rather than silently scoring a clamp
-    pred = family.predict(model, static, _feats(data), meta)
-    y = data["y"]
+    pred = v["pred"]
     invalid = jnp.sum(w * ((y < 0) | (pred < 0)).astype(w.dtype)) > 0
     ly = jnp.log1p(jnp.maximum(y, 0.0))
     lp = jnp.log1p(jnp.maximum(pred, 0.0))
@@ -122,10 +165,11 @@ def _neg_msle(family, model, static, data, meta, w):
     return jnp.where(invalid, jnp.nan, val)
 
 
-def _roc_auc(family, model, static, data, meta, w):
+@_scorer("decision")
+def _roc_auc(v, y, w, meta):
     """Weighted binary AUC via the rank/Mann-Whitney statistic."""
-    s = family.decision(model, static, _feats(data), meta)
-    y = data["y"].astype(s.dtype)
+    s = v["decision"]
+    y = y.astype(s.dtype)
     order = jnp.argsort(s)
     s_s, y_s, w_s = s[order], y[order], w[order]
     # weighted rank = cumulative weight; ties handled approximately (exact
@@ -137,35 +181,38 @@ def _roc_auc(family, model, static, data, meta, w):
     return (rank_pos - 0.5 * pos * pos) / jnp.maximum(pos * neg, EPS)
 
 
-def _r2(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    y = data["y"]
+@_scorer("pred")
+def _r2(v, y, w, meta):
+    pred = v["pred"]
     ybar = jnp.sum(w * y) / _wsum(w)
     ss_res = jnp.sum(w * (y - pred) ** 2)
     ss_tot = jnp.sum(w * (y - ybar) ** 2)
     return 1.0 - ss_res / jnp.maximum(ss_tot, EPS)
 
 
-def _neg_mse(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    return -(jnp.sum(w * (data["y"] - pred) ** 2) / _wsum(w))
+def _neg_mse_core(v, y, w, meta):
+    return -(jnp.sum(w * (y - v["pred"]) ** 2) / _wsum(w))
 
 
-def _neg_rmse(family, model, static, data, meta, w):
-    return -jnp.sqrt(-_neg_mse(family, model, static, data, meta, w))
+_neg_mse = _scorer("pred")(_neg_mse_core)
 
 
-def _neg_mae(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    return -(jnp.sum(w * jnp.abs(data["y"] - pred)) / _wsum(w))
+@_scorer("pred")
+def _neg_rmse(v, y, w, meta):
+    return -jnp.sqrt(-_neg_mse_core(v, y, w, meta))
 
 
-def _neg_median_ae(family, model, static, data, meta, w):
+@_scorer("pred")
+def _neg_mae(v, y, w, meta):
+    return -(jnp.sum(w * jnp.abs(y - v["pred"])) / _wsum(w))
+
+
+@_scorer("pred")
+def _neg_median_ae(v, y, w, meta):
     # weighted median via sorting on |err| with mask-weights; when the
     # cumulative weight hits exactly half (even-sized unweighted folds),
     # average the two middle errors the way np.median does
-    pred = family.predict(model, static, _feats(data), meta)
-    err = jnp.abs(data["y"] - pred)
+    err = jnp.abs(y - v["pred"])
     order = jnp.argsort(err)
     e_s, w_s = err[order], w[order]
     cw = jnp.cumsum(w_s)
@@ -177,9 +224,9 @@ def _neg_median_ae(family, model, static, data, meta, w):
     return -jnp.where(cw[idx_lo] == half, 0.5 * (lo + hi), lo)
 
 
-def _max_error(family, model, static, data, meta, w):
-    pred = family.predict(model, static, _feats(data), meta)
-    return -jnp.max(w * jnp.abs(data["y"] - pred))
+@_scorer("pred")
+def _max_error(v, y, w, meta):
+    return -jnp.max(w * jnp.abs(y - v["pred"]))
 
 
 SCORERS: Dict[str, Callable] = {

@@ -32,6 +32,87 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Fast default subset (VERDICT r2 #6): compile-heavy tests are marked slow
+# centrally from a measured --durations profile (2026-07-29, this 1-core
+# box), so `pytest tests/ -q` stays under ~5 min (pytest.ini addopts
+# deselects them) while the FULL gate is `pytest tests/ -q -m ""`.
+# Every family keeps fast oracle coverage in the default subset; the
+# flagship oracle (test_matches_sklearn_oracle) and one data-sharding
+# test stay default deliberately.
+# ---------------------------------------------------------------------------
+_SLOW_TESTS = {
+    "test_vendored_sklearn.py::test_upstream_search_suite_passes",
+    "test_trees.py::TestRandomForest::test_rfc_randomized_search_config3_shape",
+    "test_components.py::TestMultimetric::test_multimetric_compiled",
+    "test_components.py::TestCheckpointAndSession::test_checkpoint_distinguishes_grids",
+    "test_search_basic.py::TestMoreOracles::test_bf16_matmul_score_parity",
+    "test_trees.py::TestRandomForest::test_rfc_close_to_sklearn",
+    "test_search_basic.py::TestSparseInput::test_scipy_sparse_compiled_matches_dense",
+    "test_search_basic.py::TestCompileGroups::test_mixed_static_dynamic_grid",
+    "test_components.py::TestCheckpointAndSession::test_checkpoint_resume",
+    "test_data_sharding.py::TestDataSharding::test_odd_sample_count_pads",
+    "test_mlp_pipeline.py::TestPipeline::test_pipeline_svc_gamma_scale_oracle",
+    "test_components.py::TestReviewRegressions::test_standard_scaler_with_mean_false_parity",
+    "test_data_sharding.py::TestDataSharding::test_logreg_task_batched_sharded",
+    "test_search_basic.py::TestGridSearchLogReg::test_return_train_score",
+    "test_routing.py::TestCompiledSampleWeight::test_weighted_and_unweighted_differ",
+    "test_mlp_pipeline.py::TestPipeline::test_pipeline_grid_oracle",
+    "test_mlp_pipeline.py::TestPCAPipeline::test_pca_logreg_oracle",
+    "test_search_basic.py::TestSparseInput::test_csrmatrix_container_input",
+    "test_search_basic.py::TestGridSearchLogReg::test_best_estimator_predicts",
+    "test_search_basic.py::TestRandomizedSearch::test_randomized_matches_sampler",
+    "test_svm.py::TestSVC::test_multiclass_grid_close_to_sklearn",
+    "test_components.py::TestCheckpointAndSession::test_search_report_present",
+    "test_routing.py::TestCompiledSampleWeight::test_logreg_weighted_oracle",
+    "test_trees.py::TestGBDT::test_gbc_multiclass",
+    "test_components.py::TestFamilyResolution::test_svc_class_weight_compiled_oracle",
+    "test_components.py::TestFamilyResolution::test_class_weight_balanced_compiled_oracle",
+    "test_mlp_pipeline.py::TestPCAPipeline::test_pca_whiten",
+    "test_mlp_pipeline.py::TestMLP::test_mlp_close_to_sklearn",
+    "test_search_basic.py::TestL1Logistic::test_elasticnet_multinomial_oracle",
+    "test_mlp_pipeline.py::TestMLP::test_sgd_schedules_stay_compiled",
+    "test_mlp_pipeline.py::TestPipeline::test_pipeline_mlp_grid",
+    "test_mlp_pipeline.py::TestMLP::test_loss_plateau_stops_before_max_iter",
+    "test_trees.py::TestCheckpointTrainScores::test_rfc_binary_roc_auc",
+    "test_svm.py::TestSVC::test_linear_kernel",
+    "test_svm.py::TestSVC::test_gamma_scale_static",
+    "test_trees.py::TestGBDT::test_gbr_close_to_sklearn",
+    "test_trees.py::TestRandomForest::test_rfr_regression",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    matched = set()
+    for item in items:
+        nodeid = item.nodeid
+        short = nodeid.split("tests/")[-1] if "tests/" in nodeid else nodeid
+        if short in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+            matched.add(short)
+    # a renamed/moved test must not silently fall out of the slow set
+    # (it would re-enter the fast default subset unmarked); only check
+    # when the whole dir was collected so single-file runs stay quiet
+    if len(items) > 150:
+        stale = _SLOW_TESTS - matched
+        assert not stale, f"stale _SLOW_TESTS entries (renamed?): {stale}"
+
+    # default = fast subset.  Deselect slow tests HERE rather than via
+    # addopts so that (a) an explicit `-m` expression always wins and
+    # (b) naming a slow test by nodeid still runs it directly.
+    inv = list(config.invocation_params.args)
+    if config.option.markexpr or "-m" in inv or \
+            any(str(a).startswith("--markexpr") for a in inv):
+        return   # an explicit -m (including -m "") selects the full gate
+    if any("::" in str(a) for a in inv):
+        return
+    kept, dropped = [], []
+    for item in items:
+        (dropped if "slow" in item.keywords else kept).append(item)
+    if dropped:
+        config.hook.pytest_deselected(items=dropped)
+        items[:] = kept
+
 
 @pytest.fixture(scope="session")
 def digits():

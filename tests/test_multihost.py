@@ -1,0 +1,21 @@
+"""Multi-process jax.distributed dryrun as a regression gate.
+
+Runs the real thing (SURVEY §4 philosophy): two OS processes, a
+localhost jax.distributed coordinator, a 4-device global CPU mesh, one
+sharded GridSearchCV through the public API with the cross-process
+result gather (`parallel.mesh.device_get_tree`).  Skips with a clear
+reason if the sandbox forbids subprocesses or localhost sockets."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_cluster_search():
+    from spark_sklearn_tpu.utils.multihost import dryrun_multihost
+
+    try:
+        dryrun_multihost(n_proc=2, n_dev=2, timeout_s=420)
+    except RuntimeError as exc:
+        if "sandbox" in str(exc):
+            pytest.skip(f"multi-process cluster unavailable: {exc}")
+        raise

@@ -1,0 +1,98 @@
+"""Best-candidate agreement between the compiled SVM tiers and sklearn.
+
+VERDICT r2 weak #7: score-level atol=0.05 alone can mask a compiled tier
+that RANKS candidates differently from sklearn on realistic grids.  These
+tests assert, on three realistic grids, that the compiled tier either
+picks sklearn's best candidate outright or that the two picks' mean
+scores differ by less than the fold-score std of sklearn's best (i.e.
+the disagreement is within CV noise, which reorders sklearn against
+itself under a different seed too)."""
+
+import numpy as np
+import pytest
+from sklearn.svm import SVC, SVR, LinearSVC
+
+import spark_sklearn_tpu as sst
+
+
+def _best_agreement(ours, theirs):
+    """Either identical best_params_ or a best-score gap below the
+    fold-score std of the oracle's best candidate."""
+    if ours.best_params_ == theirs.best_params_:
+        return True, 0.0
+    bi = theirs.best_index_
+    n_splits = theirs.n_splits_
+    folds = np.array([
+        theirs.cv_results_[f"split{i}_test_score"][bi]
+        for i in range(n_splits)])
+    std = float(folds.std())
+    # our pick's score, evaluated on the ORACLE's results (same
+    # candidate order on both sides)
+    our_pick_oracle = float(
+        theirs.cv_results_["mean_test_score"][ours.best_index_])
+    gap = float(theirs.best_score_ - our_pick_oracle)
+    return gap < max(std, 1e-3), gap
+
+
+@pytest.mark.slow
+class TestBestCandidateAgreement:
+    def test_svc_rbf_grid(self, digits):
+        X, y = digits
+        Xs, ys = X[:500], y[:500]
+        grid = {"C": [0.1, 1.0, 10.0, 100.0],
+                "gamma": [0.001, 0.01, 0.1]}
+        ours = sst.GridSearchCV(SVC(), grid, cv=3,
+                                backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(SVC(), grid, cv=3,
+                                  backend="host").fit(Xs, ys)
+        ok, gap = _best_agreement(ours, theirs)
+        assert ok, (ours.best_params_, theirs.best_params_, gap)
+
+    def test_svr_rbf_grid(self, diabetes):
+        X, y = diabetes
+        Xs = X[:250]
+        ys = ((y - y.mean()) / y.std()).astype(np.float32)[:250]
+        grid = {"C": [0.1, 1.0, 10.0], "epsilon": [0.05, 0.1, 0.3]}
+        ours = sst.GridSearchCV(SVR(), grid, cv=3,
+                                backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(SVR(), grid, cv=3,
+                                  backend="host").fit(Xs, ys)
+        ok, gap = _best_agreement(ours, theirs)
+        assert ok, (ours.best_params_, theirs.best_params_, gap)
+
+    def test_binary_svc_platt_logloss_compiled(self, digits):
+        """probability=True binary SVC scores neg_log_loss COMPILED via
+        the in-fit Platt calibration; agreement with sklearn is loose by
+        construction (libsvm calibrates on internal 5-fold CV decisions,
+        ours on train decisions) but the ranking must hold."""
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:300], y[m][:300]
+        grid = {"C": [0.1, 1.0, 10.0]}
+        ours = sst.GridSearchCV(
+            SVC(probability=True), grid, cv=3, scoring="neg_log_loss",
+            backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(
+            SVC(probability=True), grid, cv=3, scoring="neg_log_loss",
+            backend="host").fit(Xs, ys)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=0.15)
+        ok, gap = _best_agreement(ours, theirs)
+        assert ok, (ours.best_params_, theirs.best_params_, gap)
+
+    def test_linear_svc_grid(self, digits):
+        X, y = digits
+        Xs, ys = X[:400], y[:400]
+        grid = {"C": [0.01, 0.1, 1.0, 10.0]}
+        est = LinearSVC()
+        ours = sst.GridSearchCV(est, grid, cv=3,
+                                backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(est, grid, cv=3,
+                                  backend="host").fit(Xs, ys)
+        ok, gap = _best_agreement(ours, theirs)
+        assert ok, (ours.best_params_, theirs.best_params_, gap)

@@ -1,0 +1,54 @@
+"""A/B the wide vs nested score phase on the virtual CPU mesh.
+
+The win is shape-level (one wide matmul + shared views vs per-task
+matvecs per scorer), so the CPU mesh measures the same program
+structure the chip runs.  Usage: python tools/score_ab.py [n_cand]
+"""
+
+import os
+import subprocess
+import sys
+
+CHILD = """
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import spark_sklearn_tpu as sst
+from sklearn.datasets import load_digits
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import StratifiedKFold
+
+n_cand = int(sys.argv[1])
+X, y = load_digits(return_X_y=True)
+X = (X / 16.0).astype(np.float32)
+grid = {"C": list(np.logspace(-4, 3, n_cand))}
+cv = StratifiedKFold(n_splits=5)
+est = LogisticRegression(max_iter=100)
+
+wall = rep = None
+for tag in ("cold", "warm"):
+    gs = sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
+                          scoring=["accuracy", "neg_log_loss"])
+    t0 = time.perf_counter()
+    gs.fit(X, y)
+    wall = time.perf_counter() - t0
+    rep = gs._search_report
+mode = "nested" if os.environ.get("SST_NESTED_SCORE") else "wide"
+print(f"MODE={mode} warm_wall={wall:.2f}s fit={rep['fit_wall_s']:.2f}s "
+      f"score={rep['score_wall_s']:.2f}s")
+"""
+
+
+def main():
+    n_cand = sys.argv[1] if len(sys.argv) > 1 else "200"
+    for env_extra in ({}, {"SST_NESTED_SCORE": "1"}):
+        env = dict(os.environ, **env_extra)
+        r = subprocess.run([sys.executable, "-c", CHILD, n_cand],
+                           capture_output=True, text=True, env=env,
+                           timeout=1800)
+        print(r.stdout.strip() or r.stderr[-400:])
+
+
+if __name__ == "__main__":
+    main()
