@@ -1,0 +1,67 @@
+"""Compiled KNN families vs sklearn oracles.
+
+The TPU-first design point under test: ALL n_neighbors candidates share
+one distance Gram and one per-fold top_k (models/neighbors.py), so the
+whole k-grid forms one compile group per `weights` value."""
+
+import numpy as np
+import pytest
+from sklearn.neighbors import KNeighborsClassifier, KNeighborsRegressor
+
+import spark_sklearn_tpu as sst
+
+
+class TestKNNClassifier:
+    def test_grid_matches_sklearn(self, digits):
+        X, y = digits
+        Xs, ys = X[:500], y[:500]
+        grid = {"n_neighbors": [1, 3, 5, 9],
+                "weights": ["uniform", "distance"]}
+        ours = sst.GridSearchCV(KNeighborsClassifier(), grid, cv=3,
+                                backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        # one compile group per weights value: k batches, weights traces
+        assert ours.search_report["n_compile_groups"] == 2
+        theirs = sst.GridSearchCV(KNeighborsClassifier(), grid, cv=3,
+                                  backend="host").fit(Xs, ys)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=1e-5)
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_binary_predict_proba_scoring(self, digits):
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:250], y[m][:250]
+        ours = sst.GridSearchCV(
+            KNeighborsClassifier(), {"n_neighbors": [3, 7]}, cv=3,
+            scoring="accuracy", backend="tpu").fit(Xs, ys)
+        theirs = sst.GridSearchCV(
+            KNeighborsClassifier(), {"n_neighbors": [3, 7]}, cv=3,
+            scoring="accuracy", backend="host").fit(Xs, ys)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=1e-6)
+
+    def test_unsupported_metric_falls_back(self, digits):
+        X, y = digits
+        gs = sst.GridSearchCV(
+            KNeighborsClassifier(metric="manhattan"),
+            {"n_neighbors": [3]}, cv=3).fit(X[:200], y[:200])
+        assert gs.search_report["backend"] == "host"
+
+
+class TestKNNRegressor:
+    def test_grid_matches_sklearn(self, diabetes):
+        X, y = diabetes
+        grid = {"n_neighbors": [2, 5, 10],
+                "weights": ["uniform", "distance"]}
+        ours = sst.GridSearchCV(KNeighborsRegressor(), grid, cv=3,
+                                backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(KNeighborsRegressor(), grid, cv=3,
+                                  backend="host").fit(X, y)
+        np.testing.assert_allclose(
+            ours.cv_results_["mean_test_score"],
+            theirs.cv_results_["mean_test_score"], atol=1e-5)
+        assert ours.best_params_ == theirs.best_params_
