@@ -1,0 +1,169 @@
+"""Smoke-tests for bench.py: every measurement leg at toy shapes, plus
+the orchestrator's always-emit guarantees.
+
+VERDICT r3 weak #2: the TPU-only bench legs had never executed on any
+platform — their first-ever run would have been inside the rare,
+high-stakes chip-unwedge window.  These tests run each leg at toy size
+on the 8-virtual-device CPU mesh and assert its detail dict carries
+finite numbers, so the unwedge window runs pre-tested code.
+
+VERDICT r3 next #1 done-criterion: a wedged chip (simulated with
+BENCH_FAKE_WEDGE=1, which makes the probe child hang) must still yield
+a parseable JSON line inside the hard budget, including under SIGTERM.
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def _assert_finite(d, keys):
+    for k in keys:
+        assert k in d, f"missing {k} in {sorted(d)}"
+        v = d[k]
+        if isinstance(v, (int, float)):
+            assert math.isfinite(v), f"{k} not finite: {v}"
+
+
+class TestLegsToyShapes:
+    """Each leg runs for real (compile + fit + score) at toy size."""
+
+    def test_headline(self, tmp_path):
+        detail, fps, vs = bench.leg_headline(
+            cache_dir=None, n_candidates=4, n_folds=2, max_iter=10,
+            serial_subsample=2)
+        _assert_finite(detail, ["wall_s_cold", "wall_s_warm", "n_fits",
+                                "best_mean_test_score",
+                                "serial_sklearn_est_s",
+                                "spark8_ideal_proxy_s"])
+        assert detail["n_fits"] == 8
+        assert math.isfinite(fps) and fps > 0
+        assert math.isfinite(vs)
+        # the MFU record exists whenever the engine reported iterations
+        if "headline_mfu" in detail:
+            _assert_finite(detail["headline_mfu"],
+                           ["achieved_gflops_per_s", "pct_of_bf16_peak"])
+            assert "device_kind" in detail["headline_mfu"][
+                "peak_denominator"]
+
+    def test_svc_mxu(self):
+        d = bench.leg_svc_mxu(n=96, d=16, folds=2, max_iter=10,
+                              C_values=(1.0,), gamma_values=(0.01,))
+        _assert_finite(d, ["wall_s", "fits_per_sec",
+                           "kernel_tflops_total",
+                           "achieved_gflops_per_s",
+                           "pct_of_bf16_peak", "best_score"])
+        assert d["kernel_tflops_total"] > 0
+
+    def test_svc_digits(self):
+        d = bench.leg_svc_digits(n_C=2, n_gamma=1, folds=2, n_rows=200)
+        _assert_finite(d, ["wall_s", "fits_per_sec", "best_score"])
+
+    def test_config3_rf(self):
+        d = bench.leg_config3_rf(n=400, d=8, n_classes=3, n_iter=2,
+                                 folds=2, est_lo=5, est_hi=8,
+                                 depth_lo=2, depth_hi=4)
+        _assert_finite(d, ["wall_s", "fits_per_sec"])
+        assert d["backend"]
+
+    def test_config4_gbr(self):
+        d = bench.leg_config4_gbr(n=300, d=4, folds=2,
+                                  learning_rates=(0.1,),
+                                  n_estimators=(10,))
+        _assert_finite(d, ["wall_s", "fits_per_sec"])
+        assert d["backend"]
+
+    def test_config5_mlp(self):
+        d = bench.leg_config5_mlp(hidden=8, max_iter=5, folds=2,
+                                  alphas=(1e-3,))
+        _assert_finite(d, ["wall_s", "fits_per_sec"])
+        assert d["backend"]
+
+    def test_keyed(self):
+        d = bench.leg_keyed(n_keys=8, rows=10, d=3)
+        _assert_finite(d, ["wall_s", "models_per_sec"])
+        assert d["backend"]
+
+
+def _last_json_line(stdout):
+    return bench._parse_last_json_line(stdout)
+
+
+def _wedged_env(**extra):
+    env = dict(os.environ)
+    env.update({
+        "BENCH_FAKE_WEDGE": "1",        # probe child hangs = wedge signature
+        "BENCH_PROBE_TIMEOUT_S": "2",
+        "BENCH_PROBE_RETRY_SLEEP_S": "1",
+    })
+    env.update(extra)
+    return env
+
+
+class TestOrchestratorAlwaysEmits:
+    """The round-3 failure mode (rc=124, empty stdout) must be
+    impossible: wedged chip, harness kill, and hard-budget expiry all
+    still produce a parseable last JSON line."""
+
+    def test_budget_expiry_flushes_fallback_line(self):
+        # budget so small the CPU child cannot finish: SIGALRM fires,
+        # the handler must flush a parseable payload and exit 0
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=60,
+            env=_wedged_env(BENCH_TOTAL_BUDGET_S="8",
+                            BENCH_CPU_CANDIDATES="2"))
+        wall = time.time() - t0
+        assert wall < 45, f"orchestrator overran its 8s budget: {wall:.0f}s"
+        assert r.returncode == 0
+        out = _last_json_line(r.stdout)
+        assert out is not None, f"no parseable line in: {r.stdout!r}"
+        assert "value" in out and "vs_baseline" in out
+
+    def test_sigterm_flushes_line(self):
+        # the driver's `timeout` sends SIGTERM — stdout must already
+        # hold (or immediately receive) a parseable line
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_wedged_env(BENCH_TOTAL_BUDGET_S="600",
+                            BENCH_CPU_CANDIDATES="2"))
+        time.sleep(4.0)  # inside the probe/CPU-smoke phase
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+        payload = _last_json_line(out)
+        assert payload is not None, f"no parseable line in: {out!r}"
+
+    @pytest.mark.slow
+    def test_wedged_chip_yields_cpu_fallback_within_budget(self):
+        # the full done-criterion: fake-wedged probe, real scaled-down
+        # CPU smoke, parseable cpu-fallback line, wall << driver budget
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=540,
+            env=_wedged_env(BENCH_TOTAL_BUDGET_S="480",
+                            BENCH_CPU_CANDIDATES="4"))
+        wall = time.time() - t0
+        assert r.returncode == 0
+        out = _last_json_line(r.stdout)
+        assert out is not None, f"no parseable line in: {r.stdout!r}"
+        assert out["platform"] == "cpu-fallback"
+        assert out["value"] > 0
+        assert out["detail"]["n_fits"] == 20
+        # probes were attempted and recorded the wedge signature
+        assert any(a.get("status") == "probe-timeout"
+                   for a in out["tpu_probe_attempts"])
+        assert wall < 480 + 30
