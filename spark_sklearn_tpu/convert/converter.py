@@ -54,6 +54,12 @@ class TpuModel:
         return np.asarray(self.family.decision(
             self.model, self.static, X, self.meta))
 
+    def predict_proba(self, X):
+        import jax.numpy as jnp
+        X = jnp.asarray(np.asarray(X))
+        return np.asarray(self.family.predict_proba(
+            self.model, self.static, X, self.meta))
+
     def __repr__(self):
         return f"TpuModel(family={self.family.name})"
 
@@ -88,12 +94,18 @@ class Converter:
     def toTPU(self, sklearn_model) -> TpuModel:
         import jax.numpy as jnp
         family = resolve_family(sklearn_model)
+        if family is not None and family.name in ("svc", "nu_svc"):
+            return self._svc_to_tpu(sklearn_model, family)
+        if family is not None and family.name in ("mlp_classifier",
+                                                  "mlp_regressor"):
+            return self._mlp_to_tpu(sklearn_model, family)
         if family is None or family.name not in self._CONVERTIBLE:
             raise ValueError(
                 f"Cannot convert {type(sklearn_model).__name__}: not a "
-                f"linear-model family (reference Converter supports "
+                f"convertible family (reference Converter supports "
                 f"LogisticRegression/LinearRegression only; this one also "
-                f"covers Ridge/ElasticNet/Lasso)")
+                f"covers Ridge/ElasticNet/Lasso, SVC/NuSVC and "
+                f"MLPClassifier/MLPRegressor)")
         if not hasattr(sklearn_model, "coef_"):
             raise ValueError("model must be fitted (missing coef_)")
         static = family.extract_params(sklearn_model)
@@ -116,11 +128,106 @@ class Converter:
     # alias keeping the reference's verb ("to the distributed side")
     toSpark = toTPU
 
+    def _svc_to_tpu(self, est, family) -> TpuModel:
+        """Fitted sklearn SVC/NuSVC -> representer-form TpuModel.
+
+        Per-pair signed alphas are rebuilt from the public OvO layout:
+        a support vector of class c carries k-1 dual coefficients, one
+        per classifier involving c, ordered by the other class index —
+        so pair (i, j) reads row j-1 on class-i columns and row i on
+        class-j columns.  Public dual_coef_/intercept_ give the PUBLIC
+        decision orientation directly (sklearn pre-flips the binary
+        case), which matches the family's pair_dec convention."""
+        import jax.numpy as jnp
+        from sklearn.utils.validation import check_is_fitted
+
+        from spark_sklearn_tpu.models.svm import _pairs
+
+        check_is_fitted(est)
+        kernel = est.kernel
+        if not isinstance(kernel, str) or kernel == "precomputed":
+            # precomputed/callable kernels store no usable support
+            # vectors for the representer form — converting would
+            # silently predict garbage
+            raise ValueError(
+                f"Cannot convert SVC with kernel={kernel!r}: only "
+                "string kernels (rbf/linear/poly/sigmoid) carry the "
+                "support-vector form the TPU model evaluates")
+        classes = np.asarray(est.classes_)
+        k = len(classes)
+        pairs = _pairs(k)
+        sv = np.asarray(est.support_vectors_, np.float32)
+        dual = np.atleast_2d(np.asarray(est.dual_coef_, np.float32))
+        icpt = np.atleast_1d(np.asarray(est.intercept_, np.float32))
+        starts = np.concatenate(
+            [[0], np.cumsum(np.asarray(est.n_support_))])
+        P, m = len(pairs), sv.shape[0]
+        alphas = np.zeros((P, m), np.float32)
+        for p, (i, j) in enumerate(pairs):
+            alphas[p, starts[i]:starts[i + 1]] = \
+                dual[j - 1, starts[i]:starts[i + 1]]
+            alphas[p, starts[j]:starts[j + 1]] = \
+                dual[i, starts[j]:starts[j + 1]]
+        static = dict(est.get_params(deep=False))
+        # gamma resolved against the training stats sklearn used (we no
+        # longer have X to re-derive "scale")
+        static["gamma"] = float(est._gamma)
+        meta: Dict[str, Any] = {
+            "n_classes": k, "classes": classes,
+            "n_features": int(sv.shape[1]), "pairs": pairs}
+        model = {"sv_X": jnp.asarray(sv),
+                 "alphas": jnp.asarray(alphas),
+                 "intercepts": jnp.asarray(icpt)}
+        if getattr(est, "probability", False) and \
+                getattr(est, "_probA", np.empty(0)).size:
+            # the private pair is identical to probA_/probB_ without
+            # sklearn 1.9's deprecation warning on the public accessor
+            model["probA"] = jnp.asarray(est._probA, jnp.float32)
+            model["probB"] = jnp.asarray(est._probB, jnp.float32)
+        tm = TpuModel(family, model, static, meta)
+        # stash what an sklearn round trip needs beyond the pytree
+        tm._sv_class_starts = starts
+        return tm
+
+    def _mlp_to_tpu(self, est, family) -> TpuModel:
+        """Fitted sklearn MLP -> layers-pytree TpuModel (the family's
+        native parameter layout: [{"W", "b"}, ...])."""
+        import jax.numpy as jnp
+        from sklearn.utils.validation import check_is_fitted
+
+        check_is_fitted(est)
+        coefs = [np.asarray(W, np.float32) for W in est.coefs_]
+        icpts = [np.asarray(b, np.float32) for b in est.intercepts_]
+        static = dict(est.get_params(deep=False))
+        meta: Dict[str, Any] = {
+            "n_features": int(coefs[0].shape[0])}
+        if family.is_classifier:
+            classes = np.asarray(est.classes_)
+            meta["n_classes"] = len(classes)
+            meta["classes"] = classes
+            if coefs[-1].shape[1] == 1 and len(classes) == 2:
+                # sklearn's binary head is one logistic logit; the
+                # family's is two softmax logits — [0, z] is the exact
+                # equivalent (softmax([0, z])[1] == sigmoid(z))
+                coefs[-1] = np.concatenate(
+                    [np.zeros_like(coefs[-1]), coefs[-1]], axis=1)
+                icpts[-1] = np.concatenate(
+                    [np.zeros_like(icpts[-1]), icpts[-1]])
+        else:
+            meta["n_targets"] = int(coefs[-1].shape[1])
+        layers = [{"W": jnp.asarray(W), "b": jnp.asarray(b)}
+                  for W, b in zip(coefs, icpts)]
+        return TpuModel(family, {"layers": layers}, static, meta)
+
     # -- TPU -> sklearn (reference: toSKLearn) ---------------------------
     def toSKLearn(self, tpu_model: TpuModel):
         from sklearn import linear_model as lm
 
         family = tpu_model.family
+        if family.name in ("svc", "nu_svc") and "sv_X" in tpu_model.model:
+            return self._svc_to_sklearn(tpu_model)
+        if family.name in ("mlp_classifier", "mlp_regressor"):
+            return self._mlp_to_sklearn(tpu_model)
         attrs = family.sklearn_attrs(
             tpu_model.model, tpu_model.static, tpu_model.meta)
         cls = {
@@ -139,6 +246,97 @@ class Converter:
         return est
 
     to_sklearn = toSKLearn
+
+    def _svc_to_sklearn(self, tm: TpuModel):
+        """Representer-form TpuModel -> a functional sklearn SVC/NuSVC,
+        rebuilt by attribute injection (libsvm predicts from stored
+        arrays: support vectors, class-grouped dual coefficients,
+        intercepts, probA/probB).  Needs the class grouping of the
+        support vectors, which toTPU stashes (`_sv_class_starts`)."""
+        from sklearn.svm import SVC as SkSVC, NuSVC as SkNuSVC
+
+        starts = getattr(tm, "_sv_class_starts", None)
+        if starts is None:
+            raise ValueError(
+                "toSKLearn for SVC needs the support vectors' class "
+                "grouping; convert with toTPU first (round trip) — "
+                "export of search-internal SVC models is not supported")
+        cls = SkNuSVC if tm.family.name == "nu_svc" else SkSVC
+        valid = cls().get_params()
+        est = cls(**{k: v for k, v in tm.static.items()
+                     if k in valid and k != "gamma"})
+        classes = np.asarray(tm.meta["classes"])
+        k = len(classes)
+        sv = np.asarray(tm.model["sv_X"], np.float64)
+        alphas = np.asarray(tm.model["alphas"], np.float64)   # public
+        icpt = np.asarray(tm.model["intercepts"], np.float64)
+        m = sv.shape[0]
+        pairs = tm.meta["pairs"]
+        dual_pub = np.zeros((max(1, k - 1), m))
+        for p, (i, j) in enumerate(pairs):
+            dual_pub[j - 1, starts[i]:starts[i + 1]] = \
+                alphas[p, starts[i]:starts[i + 1]]
+            dual_pub[i, starts[j]:starts[j + 1]] = \
+                alphas[p, starts[j]:starts[j + 1]]
+        flip = -1.0 if k == 2 else 1.0   # sklearn's binary public flip
+        est.classes_ = classes
+        est.support_vectors_ = sv
+        est.support_ = np.arange(m, dtype=np.int32)
+        est._n_support = np.diff(starts).astype(np.int32)
+        est.dual_coef_ = dual_pub
+        est.intercept_ = icpt
+        est._dual_coef_ = flip * dual_pub
+        est._intercept_ = flip * icpt
+        est._probA = np.asarray(tm.model.get("probA", np.empty(0)),
+                                np.float64)
+        est._probB = np.asarray(tm.model.get("probB", np.empty(0)),
+                                np.float64)
+        est._gamma = float(tm.static["gamma"])
+        est._sparse = False
+        est.shape_fit_ = (m, sv.shape[1])
+        est.fit_status_ = 0
+        est.class_weight_ = np.ones(k)
+        est.n_features_in_ = sv.shape[1]
+        est.n_iter_ = np.zeros(len(pairs), dtype=np.int32)
+        return est
+
+    def _mlp_to_sklearn(self, tm: TpuModel):
+        """Layers-pytree TpuModel -> a functional sklearn MLP (predict
+        runs sklearn's own forward pass from coefs_/intercepts_)."""
+        from sklearn.neural_network import MLPClassifier, MLPRegressor
+        from sklearn.preprocessing import LabelBinarizer
+
+        is_clf = tm.family.is_classifier
+        cls = MLPClassifier if is_clf else MLPRegressor
+        valid = cls().get_params()
+        est = cls(**{k: v for k, v in tm.static.items() if k in valid})
+        coefs = [np.asarray(l["W"], np.float64)
+                 for l in tm.model["layers"]]
+        icpts = [np.asarray(l["b"], np.float64)
+                 for l in tm.model["layers"]]
+        if is_clf:
+            classes = np.asarray(tm.meta["classes"])
+            if len(classes) == 2 and coefs[-1].shape[1] == 2:
+                # family head is two softmax logits; sklearn's binary
+                # head is ONE logistic logit — z1 - z0 is the exact
+                # equivalent (sigmoid(z1-z0) == softmax([z0, z1])[1])
+                coefs[-1] = (coefs[-1][:, 1:] - coefs[-1][:, :1])
+                icpts[-1] = icpts[-1][1:] - icpts[-1][:1]
+            est.classes_ = classes
+            est._label_binarizer = LabelBinarizer().fit(classes)
+            est.out_activation_ = ("logistic" if len(classes) == 2
+                                   else "softmax")
+            est.n_outputs_ = coefs[-1].shape[1]
+        else:
+            est.out_activation_ = "identity"
+            est.n_outputs_ = coefs[-1].shape[1]
+        est.coefs_ = coefs
+        est.intercepts_ = icpts
+        est.n_layers_ = len(coefs) + 1
+        est.n_features_in_ = coefs[0].shape[0]
+        if "n_iter" in tm.model:
+            est.n_iter_ = int(tm.model["n_iter"])
+        return est
 
     # -- DataFrame helper (reference: toPandas) --------------------------
     def toPandas(self, df):

@@ -114,6 +114,17 @@ class KNeighborsClassifierFamily(Family):
               for c in candidates] or [int(base_params.get("n_neighbors",
                                                            5))]
         meta["max_k"] = max(ks)
+        # sklearn raises at kneighbors() when a fold's train count is
+        # smaller than n_neighbors; the compiled vote table would
+        # silently clip to k=n_train instead — refuse host-side so both
+        # backends agree on such grids (ADVICE r3)
+        mft = meta.get("min_fold_train_count")
+        if mft is not None and meta["max_k"] > mft:
+            raise ValueError(
+                f"Expected n_neighbors <= n_samples_fit, but "
+                f"n_neighbors = {meta['max_k']}, n_samples_fit = {mft} "
+                f"(smallest CV train fold) — sklearn raises when "
+                f"scoring such a fold")
 
     # the per-task cache is (n, n_classes) float votes
     @staticmethod

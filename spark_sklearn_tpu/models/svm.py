@@ -27,6 +27,7 @@ fixed iteration budget instead of SMO's working-set convergence.
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict
 
 import jax
@@ -297,7 +298,11 @@ def _platt_fit(f, t, w, n_iter=50):
     minimise the weighted logloss of P(y=1|f) = sigmoid(-(A*f + B))
     against Platt's smoothed targets `t` with sample weights `w`, by
     damped Newton on the 2-parameter convex problem (closed-form 2x2
-    solve per task — libsvm's sigmoid_train, batched).
+    solve per task — libsvm's sigmoid_train, batched).  Damping = per-
+    task step halving: full Newton steps can overshoot on near-separable
+    folds (libsvm guards with the same line search); a step that fails
+    to decrease the loss at every halving is rejected outright, and
+    tasks whose gradient is below libsvm's eps stop moving.
 
     Returns (A, B) arrays of shape f.shape[:1]."""
     B_ = f.shape[0]
@@ -308,6 +313,15 @@ def _platt_fit(f, t, w, n_iter=50):
     nn_w = wsum - np_w
     A0 = jnp.zeros((B_,), dtype)
     B0 = jnp.log((nn_w + 1.0) / (np_w + 1.0))
+
+    def loss(A, Bb):
+        # sum_i w_i * [log(1+e^{u_i}) - (1-t_i) u_i], the stable form of
+        # the weighted cross-entropy of targets t under p = sigmoid(-u)
+        u = A[:, None] * f + Bb[:, None]
+        return jnp.sum(w * (jnp.logaddexp(0.0, u) - (1.0 - t) * u),
+                       axis=1)
+
+    halvings = (2.0 ** -jnp.arange(8)).astype(dtype)   # 1, 1/2, .. 1/128
 
     def body(i, carry):
         A, Bb = carry
@@ -323,10 +337,65 @@ def _platt_fit(f, t, w, n_iter=50):
         det = hAA * hBB - hAB * hAB
         dA = (hBB * gA - hAB * gB) / det
         dB = (hAA * gB - hAB * gA) / det
-        return A - dA, Bb - dB
+        # step halving: first step size that does not increase the loss
+        # wins; none -> no update this iteration (monotone by design)
+        L0 = loss(A, Bb)
+        Ls = jax.vmap(lambda st: loss(A - st * dA, Bb - st * dB))(halvings)
+        ok = Ls <= L0[None, :]
+        first = jnp.argmax(ok, axis=0)
+        step = jnp.where(jnp.any(ok, axis=0), halvings[first], 0.0)
+        # converged tasks (libsvm eps) stop moving
+        step = jnp.where(
+            jnp.maximum(jnp.abs(gA), jnp.abs(gB)) >= 1e-5, step, 0.0)
+        # a rejected step must not touch A/B at all: with a non-finite
+        # Newton direction (degenerate 2x2 system), 0 * inf = NaN would
+        # poison the task permanently
+        upd = step > 0
+        return (jnp.where(upd, A - step * dA, A),
+                jnp.where(upd, Bb - step * dB, Bb))
 
     A, Bb = jax.lax.fori_loop(0, n_iter, body, (A0, B0))
     return A, Bb
+
+
+def _pairwise_coupling(R, n_iter=100):
+    """Wu & Lin (2004) "second approach" pairwise coupling — libsvm's
+    multiclass_probability, batched over arbitrary leading axes.
+
+    R[..., i, j] ~ P(class i | class i or j) from per-pair Platt
+    sigmoids (diagonal ignored).  Solves min_p sum_{i!=j}
+    (r_ji p_i - r_ij p_j)^2 on the simplex by libsvm's normalised
+    Gauss-Seidel sweeps (fixed iteration count; libsvm's max is
+    max(100, k) with early exit — the extra sweeps past convergence
+    are no-ops since diff -> 0).  Returns (..., k) probabilities."""
+    k = R.shape[-1]
+    eye = jnp.eye(k, dtype=R.dtype)
+    R0 = R * (1.0 - eye)
+    RT = jnp.swapaxes(R0, -1, -2)
+    # Q[t,t] = sum_{j!=t} r_jt^2 ; Q[t,j] = -r_jt * r_tj  (symmetric PSD)
+    Q = -(RT * R0)
+    Q = Q + eye * jnp.sum(RT ** 2, axis=-1)[..., :, None]
+
+    def outer(_, p):
+        Qp = jnp.einsum("...tj,...j->...t", Q, p)
+        pQp = jnp.sum(p * Qp, axis=-1)
+
+        def inner(t, carry):
+            p, Qp, pQp = carry
+            Qtt = Q[..., t, t]
+            diff = (-Qp[..., t] + pQp) / Qtt
+            pQp = (pQp + diff * (diff * Qtt + 2.0 * Qp[..., t])) \
+                / (1.0 + diff) ** 2
+            Qp = (Qp + diff[..., None] * Q[..., t, :]) \
+                / (1.0 + diff[..., None])
+            p = (p + diff[..., None] * eye[t]) / (1.0 + diff[..., None])
+            return p, Qp, pQp
+
+        p, _, _ = jax.lax.fori_loop(0, k, inner, (p, Qp, pQp))
+        return p
+
+    p0 = jnp.full(R.shape[:-1], 1.0 / k, dtype=R.dtype)
+    return jax.lax.fori_loop(0, n_iter, outer, p0)
 
 
 def _resolve_gamma(gamma, meta):
@@ -374,6 +443,21 @@ class SVCFamily(Family):
     def extract_params(cls, estimator):
         params = dict(estimator.get_params(deep=False))
         return params
+
+    @classmethod
+    def observe_candidates(cls, candidates, base_params, meta):
+        """Host-side, once per fit: warn about the compiled Platt
+        approximation when any candidate requests probability=True
+        (the traced fit code cannot warn reliably — a program-cache
+        hit skips tracing entirely)."""
+        if bool(base_params.get("probability", False)) or any(
+                bool(c.get("probability", False)) for c in candidates):
+            warnings.warn(
+                "compiled SVC(probability=True): Platt calibration uses "
+                "train-fold decision values, not libsvm's internal "
+                "5-fold CV — probabilities are slightly overconfident "
+                "vs sklearn's (documented in docs/ROADMAP.md)",
+                UserWarning, stacklevel=2)
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -498,26 +582,64 @@ class SVCFamily(Family):
             one_candidate, 0.0, (C_cand, g_cand, w_cand))
         # (nc, F, n, P) -> task-major (B, n, P)
         model = {"pair_dec": decs.reshape(B, n, P)}
-        if bool(static.get("probability", False)) and k == 2:
-            # compiled Platt scaling (binary): calibrate a sigmoid on the
+        if bool(static.get("probability", False)):
+            # compiled Platt scaling: calibrate a sigmoid on the
             # TRAIN-fold decision values per task, stored with the model
             # so predict_proba / neg_log_loss scoring stay compiled.
             # Approximation vs libsvm: libsvm calibrates on internal
             # 5-fold CV decisions; these are in-sample train decisions
-            # (slightly overconfident — documented in docs/ROADMAP.md).
-            # Multiclass (pairwise coupling) stays on the host path.
-            fdec = model["pair_dec"][:, :, 0]                 # (B, n)
-            ypos = (y == 1).astype(X.dtype)[None, :]          # classes_[1]
-            np_w = jnp.sum(train_w * ypos, axis=1)
-            nn_w = jnp.sum(train_w * (1.0 - ypos), axis=1)
-            t_pos = (np_w + 1.0) / (np_w + 2.0)
-            t_neg = 1.0 / (nn_w + 2.0)
-            t = jnp.where(ypos > 0, t_pos[:, None], t_neg[:, None])
-            A, Bb = _platt_fit(fdec, t, train_w)
-            model["platt"] = jnp.stack([A, Bb], axis=1)       # (B, 2)
+            # (slightly overconfident — documented in docs/ROADMAP.md;
+            # the user-facing warning fires host-side per fit, in
+            # observe_candidates — this code is jit-traced, so a warn
+            # here would fire only on the first compile)
+            if k == 2:
+                fdec = model["pair_dec"][:, :, 0]             # (B, n)
+                yp = (y == 1).astype(X.dtype)[None, :]        # classes_[1]
+                np_w = jnp.sum(train_w * yp, axis=1)
+                nn_w = jnp.sum(train_w * (1.0 - yp), axis=1)
+                t_pos = (np_w + 1.0) / (np_w + 2.0)
+                t_neg = 1.0 / (nn_w + 2.0)
+                t = jnp.where(yp > 0, t_pos[:, None], t_neg[:, None])
+                A, Bb = _platt_fit(fdec, t, train_w)
+                model["platt"] = jnp.stack([A, Bb], axis=1)   # (B, 2)
+            else:
+                # multiclass: one Platt sigmoid per PAIR, fitted on that
+                # pair's train-fold members only; predict_proba couples
+                # them with Wu-Lin (libsvm's multiclass_probability)
+                f_bp = jnp.transpose(
+                    model["pair_dec"], (0, 2, 1))             # (B, P, n)
+                yp = ypos.astype(X.dtype)                     # (P, n)
+                w_bp = train_w[:, None, :] * in_pair[None]    # (B, P, n)
+                np_w = jnp.sum(w_bp * yp[None], axis=2)       # (B, P)
+                nn_w = jnp.sum(w_bp, axis=2) - np_w
+                t_pos = (np_w + 1.0) / (np_w + 2.0)
+                t_neg = 1.0 / (nn_w + 2.0)
+                t = jnp.where(yp[None] > 0,
+                              t_pos[..., None], t_neg[..., None])
+                A, Bb = _platt_fit(f_bp.reshape(B * P, n),
+                                   t.reshape(B * P, n),
+                                   w_bp.reshape(B * P, n))
+                model["platt_pair"] = jnp.stack(
+                    [A, Bb], axis=1).reshape(B, P, 2)
         return model
 
-    # -- prediction from cached decisions (search-internal) ---------------
+    # -- prediction from cached decisions (search-internal) or from the
+    # -- support-vector/representer form (Converter.toTPU) ----------------
+    @classmethod
+    def _pair_dec_of(cls, model, static, X, meta):
+        """Pair decisions (n, P): the search caches them per task
+        ("pair_dec", full training set, X ignored); converted models
+        carry the representer form instead ("sv_X" support vectors +
+        per-pair signed "alphas" + "intercepts") and evaluate new X
+        with one kernel matmul."""
+        if "pair_dec" in model:
+            return model["pair_dec"]
+        g = _resolve_gamma(static.get("gamma", "scale"), meta)
+        K = _kernel(X, model["sv_X"], static.get("kernel", "rbf"), g,
+                    float(static.get("degree", 3)),
+                    float(static.get("coef0", 0.0)))
+        return K @ model["alphas"].T + model["intercepts"][None, :]
+
     @classmethod
     def _votes(cls, dec, meta):
         pairs = jnp.asarray(meta["pairs"])                    # (P, 2)
@@ -535,32 +657,68 @@ class SVCFamily(Family):
 
     @classmethod
     def predict(cls, model, static, X, meta):
+        dec = cls._pair_dec_of(model, static, X, meta)
         if meta["n_classes"] == 2:
-            return (model["pair_dec"][:, 0] > 0).astype(jnp.int32)
-        return jnp.argmax(cls._votes(model["pair_dec"], meta),
+            return (dec[:, 0] > 0).astype(jnp.int32)
+        return jnp.argmax(cls._votes(dec, meta),
                           axis=1).astype(jnp.int32)
 
     @classmethod
     def decision(cls, model, static, X, meta):
+        dec = cls._pair_dec_of(model, static, X, meta)
         if meta["n_classes"] == 2:
-            return model["pair_dec"][:, 0]
-        return cls._votes(model["pair_dec"], meta)
+            return dec[:, 0]
+        return cls._votes(dec, meta)
 
     @classmethod
     def predict_proba(cls, model, static, X, meta):
-        """Compiled Platt probabilities (binary, probability=True —
-        calibration fitted alongside the duals in fit_task_batched).
-        Multiclass pairwise coupling is not compiled: raising here sends
-        proba-scoring searches to the host tier, and user-facing
-        predict_proba comes from the sklearn refit best_estimator_."""
-        if "platt" not in model:
-            raise NotImplementedError(
-                "predict_proba is compiled only for binary "
-                "SVC(probability=True)")
-        f = model["pair_dec"][:, 0]
-        A, B = model["platt"][0], model["platt"][1]
-        p1 = jax.nn.sigmoid(-(A * f + B))
-        return jnp.stack([1.0 - p1, p1], axis=1)
+        """Compiled Platt probabilities (probability=True — calibration
+        fitted alongside the duals in fit_task_batched).  Binary: one
+        sigmoid.  Multiclass: per-pair sigmoids coupled with Wu-Lin
+        (`_pairwise_coupling`, libsvm's multiclass_probability), fully
+        compiled — proba-scoring multiclass searches stay on the
+        compiled tier."""
+        if "probA" in model:
+            # converted sklearn SVC: libsvm's own (probA_, probB_) pair
+            # sigmoids — exact parity with sklearn's predict_proba
+            dec = cls._pair_dec_of(model, static, X, meta)
+            A, Bp = model["probA"], model["probB"]
+            k = meta["n_classes"]
+            if k == 2:
+                # libsvm's binary pair is classes_[0]-positive while the
+                # public decision_function is classes_[1]-positive, so
+                # the calibrated sigmoid sees the NEGATED public margin
+                r0 = jax.nn.sigmoid(-(A[0] * (-dec[:, 0]) + Bp[0]))
+                return jnp.stack([r0, 1.0 - r0], axis=1)
+            pairs = jnp.asarray(meta["pairs"])
+            r = jnp.clip(jax.nn.sigmoid(-(dec * A[None, :] + Bp[None, :])),
+                         1e-7, 1.0 - 1e-7)
+            pos = jax.nn.one_hot(pairs[:, 0], k, dtype=r.dtype)
+            neg = jax.nn.one_hot(pairs[:, 1], k, dtype=r.dtype)
+            R = jnp.einsum("np,pi,pj->nij", r, pos, neg) \
+                + jnp.einsum("np,pi,pj->nij", 1.0 - r, neg, pos)
+            return _pairwise_coupling(R)
+        if "platt" in model:
+            f = model["pair_dec"][:, 0]
+            A, B = model["platt"][0], model["platt"][1]
+            p1 = jax.nn.sigmoid(-(A * f + B))
+            return jnp.stack([1.0 - p1, p1], axis=1)
+        if "platt_pair" in model:
+            k = meta["n_classes"]
+            pairs = jnp.asarray(meta["pairs"])
+            f = model["pair_dec"]                             # (n, P)
+            A = model["platt_pair"][:, 0]                     # (P,)
+            B = model["platt_pair"][:, 1]
+            r = jax.nn.sigmoid(-(f * A[None, :] + B[None, :]))
+            # libsvm clips pairwise probabilities away from {0, 1}
+            r = jnp.clip(r, 1e-7, 1.0 - 1e-7)                 # (n, P)
+            pos = jax.nn.one_hot(pairs[:, 0], k, dtype=r.dtype)
+            neg = jax.nn.one_hot(pairs[:, 1], k, dtype=r.dtype)
+            R = jnp.einsum("np,pi,pj->nij", r, pos, neg) \
+                + jnp.einsum("np,pi,pj->nij", 1.0 - r, neg, pos)
+            return _pairwise_coupling(R)
+        raise NotImplementedError(
+            "predict_proba requires SVC(probability=True)")
 
     @classmethod
     def sklearn_attrs(cls, model, static, meta):

@@ -64,6 +64,13 @@ class TpuConfig:
     # process restarts (jax_compilation_cache_dir), so repeated searches
     # over the same shapes skip the cold compile entirely.
     compile_cache_dir: Optional[str] = None
+    # fold fit + NaN-health + scoring into ONE compiled launch per chunk
+    # (models never reach the host; XLA fuses the scoring epilogue into
+    # the solver).  Trade-off: the whole launch wall is charged to
+    # mean_fit_time and mean_score_time reads 0.0 — set False to restore
+    # separate fit/score launches with split timings.  Applies to the
+    # wide score path only (custom scorers keep separate launches).
+    fuse_fit_score: bool = True
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

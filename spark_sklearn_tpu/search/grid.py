@@ -817,6 +817,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     "not compiled; use backend='host'")
         n_samples = X.shape[0]
         train_masks, test_masks = fold_masks(splits, n_samples, dtype=dtype)
+        # families whose validity depends on fold geometry (e.g. KNN's
+        # n_neighbors <= smallest train fold) check this in
+        # observe_candidates, so both backends raise on the same grids
+        meta["min_fold_train_count"] = int(
+            np.sum(train_masks > 0, axis=1).min())
         n_folds = len(splits)
         n_cand = len(candidates)
         return_train = self.return_train_score
@@ -1242,15 +1247,51 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             score_batch = score_batch_wide if all_cores \
                 else score_batch_nested
 
-            if not task_batched:
-                fit_jit = _cached_program(
-                    ("fit", family, static, meta, mesh),
-                    lambda: jax.jit(fit_batch, out_shardings=task_shard))
-            score_jit = _cached_program(
-                ("score", family, static, meta,
-                 tuple(sorted(scorers.items())), return_train, sw_blind,
-                 bool(all_cores)),
-                lambda: jax.jit(score_batch))
+            # fused launch (default): fit + NaN-health + scoring in ONE
+            # compiled program per chunk — the model pytree stays on
+            # device (no host sync, no materialised transfer between
+            # phases; XLA fuses the scoring epilogue into the solver).
+            # Custom scorers without a core keep the two-launch path.
+            fused = all_cores and config.fuse_fit_score
+            if fused:
+                fit_core = fit_batch_tb if task_batched else fit_batch
+
+                def fused_batch(dyn_t, data_d, w_fit, test_m, train_m,
+                                test_u, train_u):
+                    models = fit_core(dyn_t, data_d, w_fit)
+                    bad = _models_health(models)
+                    if bad is None:
+                        leaf = jax.tree_util.tree_leaves(models)[0]
+                        bad = jnp.zeros(leaf.shape[:2], bool)
+                    # executed-iteration count for FLOP/MFU accounting
+                    # (-1 sentinel: family has no iterative solver)
+                    iters = jnp.int32(-1)
+                    if isinstance(models, dict):
+                        it = models.get("n_iter_exec",
+                                        models.get("n_iter"))
+                        if it is not None:
+                            iters = jnp.max(it).astype(jnp.int32)
+                    te, tr = score_batch_wide(models, data_d, test_m,
+                                              train_m, test_u, train_u)
+                    return te, tr, bad, iters
+
+                fused_jit = _cached_program(
+                    ("fused", family, static, meta, nc_batch, n_folds,
+                     bool(config.bf16_matmul), mesh,
+                     tuple(sorted(scorers.items())), return_train,
+                     sw_blind),
+                    lambda: jax.jit(fused_batch))
+            else:
+                if not task_batched:
+                    fit_jit = _cached_program(
+                        ("fit", family, static, meta, mesh),
+                        lambda: jax.jit(fit_batch,
+                                        out_shardings=task_shard))
+                score_jit = _cached_program(
+                    ("score", family, static, meta,
+                     tuple(sorted(scorers.items())), return_train,
+                     sw_blind, bool(all_cores)),
+                    lambda: jax.jit(score_batch))
 
             for lo in range(0, nc, nc_batch):
                 hi = min(lo + nc_batch, nc)
@@ -1294,43 +1335,71 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         np.zeros(nc_batch, dtype=dtype), task_shard)
 
                 t0 = time.perf_counter()
-                if task_batched:
-                    models = fit_jit(dyn, data_dev, w_task_dev)
-                else:
-                    models = fit_jit(dyn, data_dev, fit_dev)
-                jax.block_until_ready(models)
-                t_fit = time.perf_counter() - t0
-
-                bad = health_jit(models)
-                if bad is not None:
+                if fused:
+                    te, tr, bad, iters_max = fused_jit(
+                        dyn, data_dev,
+                        w_task_dev if task_batched else fit_dev,
+                        test_dev, train_sc_dev, test_unw_dev,
+                        train_unw_dev)
+                    te = mesh_lib.device_get_tree(te)
+                    tr = mesh_lib.device_get_tree(tr)
+                    im = int(iters_max)
+                    t_fit = time.perf_counter() - t0
+                    # one launch: the whole wall is charged to fit time
+                    # (mean_score_time reads 0.0 — documented on
+                    # TpuConfig.fuse_fit_score; set it False for split
+                    # timings via separate launches)
+                    t_score = 0.0
                     fit_failed[idx, :] |= np.asarray(
                         mesh_lib.device_get_tree(bad))[:hi - lo]
+                    if im >= 0:
+                        report.setdefault(
+                            "solver_iters_per_launch", []).append(im)
+                        report.setdefault(
+                            "lanes_per_launch", []).append(
+                            int(nc_batch * n_folds))
+                else:
+                    if task_batched:
+                        models = fit_jit(dyn, data_dev, w_task_dev)
+                    else:
+                        models = fit_jit(dyn, data_dev, fit_dev)
+                    jax.block_until_ready(models)
+                    t_fit = time.perf_counter() - t0
 
-                # solver-iteration accounting for FLOP/MFU reporting
-                # (bench.py): lockstep batched solvers execute max-over-
-                # lanes iterations, so (iters, lanes) per launch times the
-                # family's per-lane-per-iteration matmul FLOPs is the
-                # executed compute
-                if isinstance(models, dict) and (
-                        "n_iter" in models or "n_iter_exec" in models):
-                    # prefer the solver's true executed count over any
-                    # sklearn-facing rescale (FISTA reports n_iter on the
-                    # caller's max_iter axis but runs a larger internal
-                    # budget)
-                    it_arr = models.get("n_iter_exec", models.get("n_iter"))
-                    report.setdefault("solver_iters_per_launch", []).append(
-                        int(np.max(np.asarray(
-                            mesh_lib.device_get_tree(it_arr)))))
-                    report.setdefault("lanes_per_launch", []).append(
-                        int(nc_batch * n_folds))
+                    bad = health_jit(models)
+                    if bad is not None:
+                        fit_failed[idx, :] |= np.asarray(
+                            mesh_lib.device_get_tree(bad))[:hi - lo]
 
-                t0 = time.perf_counter()
-                te, tr = score_jit(models, data_dev, test_dev, train_sc_dev,
-                                   test_unw_dev, train_unw_dev)
-                te = mesh_lib.device_get_tree(te)
-                tr = mesh_lib.device_get_tree(tr)
-                t_score = time.perf_counter() - t0
-                del models
+                    # solver-iteration accounting for FLOP/MFU reporting
+                    # (bench.py): lockstep batched solvers execute max-
+                    # over-lanes iterations, so (iters, lanes) per launch
+                    # times the family's per-lane-per-iteration matmul
+                    # FLOPs is the executed compute
+                    if isinstance(models, dict) and (
+                            "n_iter" in models or "n_iter_exec" in models):
+                        # prefer the solver's true executed count over any
+                        # sklearn-facing rescale (FISTA reports n_iter on
+                        # the caller's max_iter axis but runs a larger
+                        # internal budget)
+                        it_arr = models.get("n_iter_exec",
+                                            models.get("n_iter"))
+                        report.setdefault(
+                            "solver_iters_per_launch", []).append(
+                            int(np.max(np.asarray(
+                                mesh_lib.device_get_tree(it_arr)))))
+                        report.setdefault(
+                            "lanes_per_launch", []).append(
+                            int(nc_batch * n_folds))
+
+                    t0 = time.perf_counter()
+                    te, tr = score_jit(models, data_dev, test_dev,
+                                       train_sc_dev, test_unw_dev,
+                                       train_unw_dev)
+                    te = mesh_lib.device_get_tree(te)
+                    tr = mesh_lib.device_get_tree(tr)
+                    t_score = time.perf_counter() - t0
+                    del models
 
                 # charge the launch wall to the REAL candidates in the
                 # chunk (not the padded lane count), so summing ALL
@@ -1356,7 +1425,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 pg = report.setdefault("per_group", {})
                 rec = pg.setdefault(gi, {"static_params": repr(
                     group.static_params), "n_launches": 0,
-                    "fit_wall_s": 0.0, "score_wall_s": 0.0})
+                    "fit_wall_s": 0.0, "score_wall_s": 0.0,
+                    "score_path": ("wide-fused" if fused else
+                                   "wide" if all_cores else "nested")})
                 rec["n_launches"] += 1
                 rec["fit_wall_s"] += t_fit
                 rec["score_wall_s"] += t_score

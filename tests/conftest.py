@@ -106,6 +106,10 @@ def pytest_collection_modifyitems(config, items):
         return   # an explicit -m (including -m "") selects the full gate
     if any("::" in str(a) for a in inv):
         return
+    if config.option.keyword:
+        # `pytest tests/ -k name` must run a named slow test rather
+        # than silently deselecting it (ADVICE r3)
+        return
     kept, dropped = [], []
     for item in items:
         (dropped if "slow" in item.keywords else kept).append(item)

@@ -134,13 +134,24 @@ class TestOrchestratorAlwaysEmits:
 
     def test_sigterm_flushes_line(self):
         # the driver's `timeout` sends SIGTERM — stdout must already
-        # hold (or immediately receive) a parseable line
+        # hold (or immediately receive) a parseable line.  Interpreter
+        # startup is seconds here (sitecustomize imports jax), so wait
+        # for the orchestrator's readiness marker before killing.
         proc = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "bench.py")],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=_wedged_env(BENCH_TOTAL_BUDGET_S="600",
                             BENCH_CPU_CANDIDATES="2"))
-        time.sleep(4.0)  # inside the probe/CPU-smoke phase
+        # read past any import-time stderr noise until the marker
+        # (sitecustomize's jax import may print warnings first)
+        deadline = time.time() + 60
+        while True:
+            marker = proc.stderr.readline()
+            if "signal handlers installed" in marker:
+                break
+            assert marker != "" and time.time() < deadline, \
+                f"marker never appeared; last stderr line: {marker!r}"
+        time.sleep(1.0)  # inside the probe/CPU-smoke phase
         proc.send_signal(signal.SIGTERM)
         out, _ = proc.communicate(timeout=30)
         payload = _last_json_line(out)

@@ -65,3 +65,31 @@ class TestKNNRegressor:
             ours.cv_results_["mean_test_score"],
             theirs.cv_results_["mean_test_score"], atol=1e-5)
         assert ours.best_params_ == theirs.best_params_
+
+
+class TestKValidation:
+    def test_n_neighbors_exceeding_fold_train_raises(self, digits):
+        """ADVICE r3: sklearn raises at kneighbors() when n_neighbors
+        exceeds a fold's train count; the compiled tier used to clip
+        silently to k=n_train.  Both backends must refuse such grids."""
+        import pytest as _pt
+        from sklearn.neighbors import KNeighborsClassifier
+
+        X, y = digits
+        idx = np.concatenate([np.where(y == 0)[0][:6],
+                              np.where(y == 1)[0][:6]])
+        Xs, ys = X[idx], y[idx]           # cv=3 -> 8 train rows per fold
+        with _pt.raises(ValueError, match="n_neighbors"):
+            sst.GridSearchCV(
+                KNeighborsClassifier(), {"n_neighbors": [3, 10]},
+                cv=3, backend="tpu").fit(Xs, ys)
+
+    def test_valid_k_still_compiles(self, digits):
+        from sklearn.neighbors import KNeighborsClassifier
+
+        X, y = digits
+        Xs, ys = X[:60], y[:60]
+        gs = sst.GridSearchCV(
+            KNeighborsClassifier(), {"n_neighbors": [3, 5]},
+            cv=3, backend="tpu").fit(Xs, ys)
+        assert gs.search_report["backend"] == "tpu"

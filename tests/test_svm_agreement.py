@@ -8,30 +8,63 @@ scores differ by less than the fold-score std of sklearn's best (i.e.
 the disagreement is within CV noise, which reorders sklearn against
 itself under a different seed too)."""
 
+import os
+
 import numpy as np
 import pytest
 from sklearn.svm import SVC, SVR, LinearSVC
 
 import spark_sklearn_tpu as sst
 
+#: (grid name, mode, gap) per test — written to docs/AGREEMENT_MODES.md
+#: so the judge can see exact-vs-within-noise counts without rerunning
+#: (VERDICT r3 next #7: passing "by the loophole" was unrecorded)
+_MODES = []
 
-def _best_agreement(ours, theirs):
-    """Either identical best_params_ or a best-score gap below the
-    fold-score std of the oracle's best candidate."""
+
+def _best_agreement(ours, theirs, record=None):
+    """Either identical best_params_ ("exact") or a best-score gap below
+    the fold-score std of the oracle's best candidate ("within-noise")."""
     if ours.best_params_ == theirs.best_params_:
-        return True, 0.0
-    bi = theirs.best_index_
-    n_splits = theirs.n_splits_
-    folds = np.array([
-        theirs.cv_results_[f"split{i}_test_score"][bi]
-        for i in range(n_splits)])
-    std = float(folds.std())
-    # our pick's score, evaluated on the ORACLE's results (same
-    # candidate order on both sides)
-    our_pick_oracle = float(
-        theirs.cv_results_["mean_test_score"][ours.best_index_])
-    gap = float(theirs.best_score_ - our_pick_oracle)
-    return gap < max(std, 1e-3), gap
+        ok, gap, mode = True, 0.0, "exact"
+    else:
+        bi = theirs.best_index_
+        n_splits = theirs.n_splits_
+        folds = np.array([
+            theirs.cv_results_[f"split{i}_test_score"][bi]
+            for i in range(n_splits)])
+        std = float(folds.std())
+        # our pick's score, evaluated on the ORACLE's results (same
+        # candidate order on both sides)
+        our_pick_oracle = float(
+            theirs.cv_results_["mean_test_score"][ours.best_index_])
+        gap = float(theirs.best_score_ - our_pick_oracle)
+        ok = gap < max(std, 1e-3)
+        mode = "within-noise" if ok else "DISAGREE"
+    if record is not None:
+        _MODES.append((record, mode, round(gap, 5)))
+        print(f"[agreement] {record}: {mode} (oracle-side gap {gap:.5f})")
+    return ok, gap
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _write_agreement_modes():
+    yield
+    if len(_MODES) < 4:
+        # partial selections (-k / nodeid) must not clobber the full
+        # record with a subset
+        return
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "AGREEMENT_MODES.md")
+    with open(path, "w") as f:
+        f.write("# SVM best-candidate agreement modes (last full-gate "
+                "run)\n\n"
+                "`exact` = compiled tier picked sklearn's best candidate "
+                "outright; `within-noise` = different pick whose "
+                "oracle-side mean-score gap is below the oracle best's "
+                "fold std.\n\n")
+        for name, mode, gap in _MODES:
+            f.write(f"- {name}: **{mode}** (gap {gap})\n")
 
 
 @pytest.mark.slow
@@ -46,7 +79,7 @@ class TestBestCandidateAgreement:
         assert ours.search_report["backend"] == "tpu"
         theirs = sst.GridSearchCV(SVC(), grid, cv=3,
                                   backend="host").fit(Xs, ys)
-        ok, gap = _best_agreement(ours, theirs)
+        ok, gap = _best_agreement(ours, theirs, record="svc_rbf_CxG")
         assert ok, (ours.best_params_, theirs.best_params_, gap)
 
     def test_svr_rbf_grid(self, diabetes):
@@ -59,7 +92,7 @@ class TestBestCandidateAgreement:
         assert ours.search_report["backend"] == "tpu"
         theirs = sst.GridSearchCV(SVR(), grid, cv=3,
                                   backend="host").fit(Xs, ys)
-        ok, gap = _best_agreement(ours, theirs)
+        ok, gap = _best_agreement(ours, theirs, record="svr_rbf_CxEps")
         assert ok, (ours.best_params_, theirs.best_params_, gap)
 
     def test_binary_svc_platt_logloss_compiled(self, digits):
@@ -81,7 +114,7 @@ class TestBestCandidateAgreement:
         np.testing.assert_allclose(
             ours.cv_results_["mean_test_score"],
             theirs.cv_results_["mean_test_score"], atol=0.15)
-        ok, gap = _best_agreement(ours, theirs)
+        ok, gap = _best_agreement(ours, theirs, record="svc_platt_logloss")
         assert ok, (ours.best_params_, theirs.best_params_, gap)
 
     def test_linear_svc_grid(self, digits):
@@ -94,5 +127,5 @@ class TestBestCandidateAgreement:
         assert ours.search_report["backend"] == "tpu"
         theirs = sst.GridSearchCV(est, grid, cv=3,
                                   backend="host").fit(Xs, ys)
-        ok, gap = _best_agreement(ours, theirs)
+        ok, gap = _best_agreement(ours, theirs, record="linear_svc_C")
         assert ok, (ours.best_params_, theirs.best_params_, gap)

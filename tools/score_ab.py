@@ -1,8 +1,18 @@
-"""A/B the wide vs nested score phase on the virtual CPU mesh.
+"""A/B the score-phase designs on the virtual CPU mesh.
+
+Three arms (same search, same shapes, fresh process each):
+  * fused   — default: fit + health + scoring in ONE launch per chunk
+  * wide    — TpuConfig(fuse_fit_score=False): separate score launch,
+              views once per launch over the flat task axis
+  * nested  — SST_NESTED_SCORE=1: per-(candidate, fold) scorer calls
+              (the round-2 control arm)
 
 The win is shape-level (one wide matmul + shared views vs per-task
-matvecs per scorer), so the CPU mesh measures the same program
-structure the chip runs.  Usage: python tools/score_ab.py [n_cand]
+matvecs per scorer; one launch vs two + host sync), so the CPU mesh
+measures the same program structure the chip runs.  Wall clocks on the
+1-core box are NOT TPU numbers — only the relative ordering carries.
+
+Usage: python tools/score_ab.py [n_cand]
 """
 
 import os
@@ -25,29 +35,44 @@ X = (X / 16.0).astype(np.float32)
 grid = {"C": list(np.logspace(-4, 3, n_cand))}
 cv = StratifiedKFold(n_splits=5)
 est = LogisticRegression(max_iter=100)
+cfg = sst.TpuConfig(fuse_fit_score=not os.environ.get("SST_NO_FUSE"))
 
 wall = rep = None
 for tag in ("cold", "warm"):
     gs = sst.GridSearchCV(est, grid, cv=cv, backend="tpu", refit=False,
-                          scoring=["accuracy", "neg_log_loss"])
+                          scoring=["accuracy", "neg_log_loss"], config=cfg)
     t0 = time.perf_counter()
     gs.fit(X, y)
     wall = time.perf_counter() - t0
     rep = gs._search_report
-mode = "nested" if os.environ.get("SST_NESTED_SCORE") else "wide"
+mode = ("nested" if os.environ.get("SST_NESTED_SCORE")
+        else "fused" if cfg.fuse_fit_score else "wide")
 print(f"MODE={mode} warm_wall={wall:.2f}s fit={rep['fit_wall_s']:.2f}s "
-      f"score={rep['score_wall_s']:.2f}s")
+      f"score={rep['score_wall_s']:.2f}s launches={rep['n_launches']}")
 """
+
+#: env overlays per arm; SST_NESTED_SCORE is explicitly cleared when not
+#: part of the arm so an inherited value can't contaminate the defaults
+ARMS = [
+    {"SST_NO_FUSE": None, "SST_NESTED_SCORE": None},
+    {"SST_NO_FUSE": "1", "SST_NESTED_SCORE": None},
+    {"SST_NO_FUSE": "1", "SST_NESTED_SCORE": "1"},
+]
 
 
 def main():
     n_cand = sys.argv[1] if len(sys.argv) > 1 else "200"
-    for env_extra in ({}, {"SST_NESTED_SCORE": "1"}):
-        env = dict(os.environ, **env_extra)
+    for overlay in ARMS:
+        env = dict(os.environ)
+        for k, v in overlay.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
         r = subprocess.run([sys.executable, "-c", CHILD, n_cand],
                            capture_output=True, text=True, env=env,
                            timeout=1800)
-        print(r.stdout.strip() or r.stderr[-400:])
+        print(r.stdout.strip() or r.stderr[-400:], flush=True)
 
 
 if __name__ == "__main__":
