@@ -99,13 +99,19 @@ class Converter:
         if family is not None and family.name in ("mlp_classifier",
                                                   "mlp_regressor"):
             return self._mlp_to_tpu(sklearn_model, family)
+        if family is not None and family.name in (
+                "random_forest_classifier", "random_forest_regressor",
+                "gradient_boosting_classifier",
+                "gradient_boosting_regressor"):
+            return self._tree_ensemble_to_tpu(sklearn_model, family)
         if family is None or family.name not in self._CONVERTIBLE:
             raise ValueError(
                 f"Cannot convert {type(sklearn_model).__name__}: not a "
                 f"convertible family (reference Converter supports "
                 f"LogisticRegression/LinearRegression only; this one also "
-                f"covers Ridge/ElasticNet/Lasso, SVC/NuSVC and "
-                f"MLPClassifier/MLPRegressor)")
+                f"covers Ridge/ElasticNet/Lasso, SVC/NuSVC, "
+                f"MLPClassifier/MLPRegressor and RandomForest/"
+                f"GradientBoosting ensembles)")
         if not hasattr(sklearn_model, "coef_"):
             raise ValueError("model must be fitted (missing coef_)")
         static = family.extract_params(sklearn_model)
@@ -169,12 +175,14 @@ class Converter:
             alphas[p, starts[j]:starts[j + 1]] = \
                 dual[i, starts[j]:starts[j + 1]]
         static = dict(est.get_params(deep=False))
-        # gamma resolved against the training stats sklearn used (we no
-        # longer have X to re-derive "scale")
-        static["gamma"] = float(est._gamma)
         meta: Dict[str, Any] = {
             "n_classes": k, "classes": classes,
-            "n_features": int(sv.shape[1]), "pairs": pairs}
+            "n_features": int(sv.shape[1]), "pairs": pairs,
+            # gamma resolved against the training stats sklearn used (we
+            # no longer have X to re-derive "scale"); static["gamma"]
+            # keeps the USER's hyperparameter so a round-tripped
+            # estimator refits identically
+            "resolved_gamma": float(est._gamma)}
         model = {"sv_X": jnp.asarray(sv),
                  "alphas": jnp.asarray(alphas),
                  "intercepts": jnp.asarray(icpt)}
@@ -219,6 +227,35 @@ class Converter:
                   for W, b in zip(coefs, icpts)]
         return TpuModel(family, {"layers": layers}, static, meta)
 
+    def _tree_ensemble_to_tpu(self, est, family) -> TpuModel:
+        """Fitted sklearn tree ensemble -> packed-arrays TpuModel with a
+        compiled level-by-level traversal (convert/tree_infer.py).  The
+        packed form is exact — same thresholds on the same raw X — so
+        predict/proba parity with sklearn is at float tolerance.  The
+        reverse direction is unsupported: the search-internal histogram
+        families cache fold predictions, not tree structures."""
+        from sklearn.utils.validation import check_is_fitted
+
+        from spark_sklearn_tpu.convert import tree_infer as ti
+
+        check_is_fitted(est)
+        name = family.name
+        if name.startswith("random_forest"):
+            model = ti.forest_to_model(est)
+            shim = (ti.PackedForestClassifier if family.is_classifier
+                    else ti.PackedForestRegressor)
+        else:
+            model = ti.gb_to_model(est)
+            shim = (ti.PackedGBClassifier if family.is_classifier
+                    else ti.PackedGBRegressor)
+        static = dict(est.get_params(deep=False))
+        meta: Dict[str, Any] = {"n_features": int(est.n_features_in_)}
+        if family.is_classifier:
+            classes = np.asarray(est.classes_)
+            meta["n_classes"] = len(classes)
+            meta["classes"] = classes
+        return TpuModel(shim, model, static, meta)
+
     # -- TPU -> sklearn (reference: toSKLearn) ---------------------------
     def toSKLearn(self, tpu_model: TpuModel):
         from sklearn import linear_model as lm
@@ -228,6 +265,11 @@ class Converter:
             return self._svc_to_sklearn(tpu_model)
         if family.name in ("mlp_classifier", "mlp_regressor"):
             return self._mlp_to_sklearn(tpu_model)
+        if family.name == "sk_tree_ensemble":
+            raise ValueError(
+                "tree-ensemble TpuModels are inference-only (packed "
+                "traversal arrays); keep the original sklearn estimator "
+                "for the sklearn side")
         attrs = family.sklearn_attrs(
             tpu_model.model, tpu_model.static, tpu_model.meta)
         cls = {
@@ -263,8 +305,7 @@ class Converter:
                 "export of search-internal SVC models is not supported")
         cls = SkNuSVC if tm.family.name == "nu_svc" else SkSVC
         valid = cls().get_params()
-        est = cls(**{k: v for k, v in tm.static.items()
-                     if k in valid and k != "gamma"})
+        est = cls(**{k: v for k, v in tm.static.items() if k in valid})
         classes = np.asarray(tm.meta["classes"])
         k = len(classes)
         sv = np.asarray(tm.model["sv_X"], np.float64)
@@ -291,7 +332,7 @@ class Converter:
                                 np.float64)
         est._probB = np.asarray(tm.model.get("probB", np.empty(0)),
                                 np.float64)
-        est._gamma = float(tm.static["gamma"])
+        est._gamma = float(tm.meta["resolved_gamma"])
         est._sparse = False
         est.shape_fit_ = (m, sv.shape[1])
         est.fit_status_ = 0

@@ -38,6 +38,17 @@ class LogisticRegressionFamily(Family):
     dynamic_params = {"C": np.float32, "tol": np.float32}
 
     @classmethod
+    def convergence_order(cls, dynamic_params, static):
+        """Difficulty proxy for sorted chunking: larger C = weaker
+        regularisation = slower L-BFGS/FISTA convergence.  Returns an
+        ascending-difficulty permutation, or None when C is not in the
+        grid (nothing to grade by)."""
+        C = dynamic_params.get("C")
+        if C is None or len(C) < 2:
+            return None
+        return np.argsort(np.asarray(C), kind="stable")
+
+    @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
         classes, y_enc = encode_labels(y)
         data = {
@@ -466,6 +477,15 @@ class ElasticNetFamily(Family):
     dynamic_params = {"alpha": np.float32, "l1_ratio": np.float32}
 
     prepare_data = RidgeFamily.prepare_data
+
+    @classmethod
+    def convergence_order(cls, dynamic_params, static):
+        """Smaller alpha = weaker penalty = slower FISTA convergence,
+        so ascending difficulty = DESCENDING alpha."""
+        alpha = dynamic_params.get("alpha")
+        if alpha is None or len(alpha) < 2:
+            return None
+        return np.argsort(-np.asarray(alpha), kind="stable")
 
     @classmethod
     def extract_params(cls, estimator):

@@ -634,7 +634,9 @@ class SVCFamily(Family):
         with one kernel matmul."""
         if "pair_dec" in model:
             return model["pair_dec"]
-        g = _resolve_gamma(static.get("gamma", "scale"), meta)
+        g = meta.get("resolved_gamma")
+        if g is None:
+            g = _resolve_gamma(static.get("gamma", "scale"), meta)
         K = _kernel(X, model["sv_X"], static.get("kernel", "rbf"), g,
                     float(static.get("degree", 3)),
                     float(static.get("coef0", 0.0)))

@@ -64,6 +64,13 @@ class TpuConfig:
     # process restarts (jax_compilation_cache_dir), so repeated searches
     # over the same shapes skip the cold compile entirely.
     compile_cache_dir: Optional[str] = None
+    # convergence-sorted chunking: when a family exposes a difficulty
+    # proxy (GLM: larger C / smaller alpha converges slower), big compile
+    # groups are sorted by it and split into ~8 narrower launches so the
+    # easy launches early-exit instead of paying the slowest candidate's
+    # lockstep iterations.  Same compiled program, same cv_results_
+    # order; False restores single-width unsorted chunking.
+    sort_candidates: bool = True
     # fold fit + NaN-health + scoring into ONE compiled launch per chunk
     # (models never reach the host; XLA fuses the scoring epilogue into
     # the solver).  Trade-off: the whole launch wall is charged to

@@ -385,13 +385,21 @@ class TestReviewRegressions:
             ours.cv_results_["mean_test_score"],
             theirs.cv_results_["mean_test_score"], atol=7e-3)
 
-    def test_converter_rejects_svc(self, digits):
-        """Regression: SVC registration must not open Converter.toTPU to
-        non-linear families with a delayed KeyError."""
+    def test_converter_rejects_unsupported(self, digits):
+        """Regression (round-4 update): family registration must not
+        open Converter.toTPU to unsupported estimators with a delayed
+        KeyError — they fail fast with a clear ValueError.  (SVC itself
+        converts since round 4 — covered in test_converter_breadth.)"""
+        from sklearn.neighbors import KNeighborsClassifier
         from sklearn.svm import SVC
         X, y = digits
-        svc = SVC(kernel="linear").fit(X[:100], y[:100])
+        knn = KNeighborsClassifier().fit(X[:100], y[:100])
         with pytest.raises(ValueError, match="Cannot convert"):
+            sst.Converter().toTPU(knn)
+        # precomputed kernels carry no support vectors: refuse cleanly
+        K = (X[:100] @ X[:100].T)
+        svc = SVC(kernel="precomputed").fit(np.asarray(K), y[:100])
+        with pytest.raises(ValueError, match="precomputed|kernel"):
             sst.Converter().toTPU(svc)
 
 

@@ -63,6 +63,14 @@ class TestSVCConversion:
         np.testing.assert_allclose(
             back.decision_function(Xte), sk.decision_function(Xte),
             atol=1e-6)
+        # the USER's hyperparameters survive the round trip (a refit of
+        # `back` must train the same model — gamma was once silently
+        # reset to 'scale')
+        assert back.get_params()["gamma"] == 0.02
+        sk2 = SVC(gamma="scale").fit(Xtr, ytr)
+        back2 = sst.Converter().toSKLearn(sst.Converter().toTPU(sk2))
+        assert back2.get_params()["gamma"] == "scale"
+        assert (back2.predict(Xte) == sk2.predict(Xte)).all()
 
     def test_binary_svc_round_trip_with_proba(self, digits):
         X, y = digits
@@ -142,3 +150,77 @@ class TestMLPConversion:
         tm = sst.Converter().toTPU(sk)
         assert set(np.unique(tm.predict(Xte))) <= {3, 7, 9}
         assert (tm.predict(Xte) == sk.predict(Xte)).all()
+
+
+class TestTreeEnsembleConversion:
+    """sklearn tree ensembles -> compiled packed-traversal TpuModels
+    (exact: same thresholds on the same raw X)."""
+
+    def test_random_forest_classifier(self, digits):
+        from sklearn.ensemble import RandomForestClassifier
+
+        X, y = digits
+        Xtr, ytr, Xte = X[:300], y[:300], X[300:380]
+        sk = RandomForestClassifier(
+            n_estimators=20, max_depth=6, random_state=0).fit(Xtr, ytr)
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(Xte) == sk.predict(Xte)).all()
+        np.testing.assert_allclose(
+            tm.predict_proba(Xte), sk.predict_proba(Xte), atol=1e-5)
+
+    def test_random_forest_regressor(self, diabetes):
+        from sklearn.ensemble import RandomForestRegressor
+
+        X, y = diabetes
+        Xtr, ytr, Xte = X[:250], y[:250], X[250:300]
+        sk = RandomForestRegressor(
+            n_estimators=15, max_depth=6, random_state=0).fit(Xtr, ytr)
+        tm = sst.Converter().toTPU(sk)
+        np.testing.assert_allclose(
+            tm.predict(Xte), sk.predict(Xte), rtol=1e-5)
+
+    def test_gradient_boosting_classifier_multiclass(self, digits):
+        from sklearn.ensemble import GradientBoostingClassifier
+
+        X, y = digits
+        m = y < 4
+        Xtr, ytr, Xte = X[m][:240], y[m][:240], X[m][240:300]
+        sk = GradientBoostingClassifier(
+            n_estimators=15, max_depth=3, random_state=0).fit(Xtr, ytr)
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(Xte) == sk.predict(Xte)).all()
+        np.testing.assert_allclose(
+            tm.predict_proba(Xte), sk.predict_proba(Xte), atol=1e-5)
+
+    def test_gradient_boosting_binary_and_regressor(self, digits,
+                                                    diabetes):
+        from sklearn.ensemble import (GradientBoostingClassifier,
+                                      GradientBoostingRegressor)
+
+        X, y = digits
+        m = y < 2
+        Xtr, ytr, Xte = X[m][:200], y[m][:200], X[m][200:260]
+        sk = GradientBoostingClassifier(
+            n_estimators=15, random_state=0).fit(Xtr, ytr)
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(Xte) == sk.predict(Xte)).all()
+        np.testing.assert_allclose(
+            tm.predict_proba(Xte), sk.predict_proba(Xte), atol=1e-5)
+
+        Xr, yr = diabetes
+        skr = GradientBoostingRegressor(
+            n_estimators=15, random_state=0).fit(Xr[:250], yr[:250])
+        tmr = sst.Converter().toTPU(skr)
+        np.testing.assert_allclose(
+            tmr.predict(Xr[250:300]), skr.predict(Xr[250:300]),
+            rtol=1e-5)
+
+    def test_export_back_is_refused(self, digits):
+        from sklearn.ensemble import RandomForestClassifier
+
+        X, y = digits
+        sk = RandomForestClassifier(
+            n_estimators=5, random_state=0).fit(X[:150], y[:150])
+        tm = sst.Converter().toTPU(sk)
+        with pytest.raises(ValueError, match="inference-only"):
+            sst.Converter().toSKLearn(tm)

@@ -85,15 +85,9 @@ def main():
             # bench emits one superseding JSON line per milestone; store
             # only the last parseable one so the .json file stays a
             # single valid document (raw stream kept alongside)
-            payload = None
-            for line in reversed(r.stdout.strip().splitlines()):
-                try:
-                    obj = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(obj, dict):
-                    payload = obj
-                    break
+            sys.path.insert(0, REPO)
+            from bench import _parse_last_json_line
+            payload = _parse_last_json_line(r.stdout)
             with open(out_path + "l.raw", "w") as f:
                 f.write(r.stdout)
             with open(out_path, "w") as f:
