@@ -204,6 +204,16 @@ class Converter:
         from sklearn.utils.validation import check_is_fitted
 
         check_is_fitted(est)
+        if family.is_classifier and \
+                getattr(est, "out_activation_", "") == "logistic" and \
+                getattr(est, "n_outputs_", 1) > 1:
+            # multilabel head: sklearn applies an elementwise sigmoid
+            # per label; the family's softmax head would silently
+            # compute different probabilities
+            raise ValueError(
+                "Cannot convert a multilabel MLPClassifier "
+                f"(n_outputs_={est.n_outputs_} with a logistic head); "
+                "only binary/multiclass classifiers are supported")
         coefs = [np.asarray(W, np.float32) for W in est.coefs_]
         icpts = [np.asarray(b, np.float32) for b in est.intercepts_]
         static = dict(est.get_params(deep=False))
@@ -239,6 +249,13 @@ class Converter:
         from spark_sklearn_tpu.convert import tree_infer as ti
 
         check_is_fitted(est)
+        if getattr(est, "n_outputs_", 1) > 1:
+            # pack_trees keeps one output column; silently dropping the
+            # rest would return wrong-shaped predictions
+            raise ValueError(
+                "Cannot convert a multi-output tree ensemble "
+                f"(n_outputs_={est.n_outputs_}); only single-output "
+                "ensembles are supported")
         name = family.name
         if name.startswith("random_forest"):
             model = ti.forest_to_model(est)

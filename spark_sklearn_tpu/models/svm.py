@@ -358,6 +358,19 @@ def _platt_fit(f, t, w, n_iter=50):
     return A, Bb
 
 
+def _pair_probs_to_R(r, pairs, k):
+    """(n, P) per-pair sigmoid probabilities -> the (n, k, k) pairwise
+    matrix Wu-Lin consumes: R[i_p, j_p] = r_p, R[j_p, i_p] = 1 - r_p,
+    with libsvm's clip away from {0, 1}.  Shared by the search-internal
+    (train-fold Platt) and converted-model (libsvm probA/probB) paths so
+    the coupling input can never desynchronize between them."""
+    r = jnp.clip(r, 1e-7, 1.0 - 1e-7)
+    pos = jax.nn.one_hot(pairs[:, 0], k, dtype=r.dtype)
+    neg = jax.nn.one_hot(pairs[:, 1], k, dtype=r.dtype)
+    return jnp.einsum("np,pi,pj->nij", r, pos, neg) \
+        + jnp.einsum("np,pi,pj->nij", 1.0 - r, neg, pos)
+
+
 def _pairwise_coupling(R, n_iter=100):
     """Wu & Lin (2004) "second approach" pairwise coupling — libsvm's
     multiclass_probability, batched over arbitrary leading axes.
@@ -693,13 +706,8 @@ class SVCFamily(Family):
                 r0 = jax.nn.sigmoid(-(A[0] * (-dec[:, 0]) + Bp[0]))
                 return jnp.stack([r0, 1.0 - r0], axis=1)
             pairs = jnp.asarray(meta["pairs"])
-            r = jnp.clip(jax.nn.sigmoid(-(dec * A[None, :] + Bp[None, :])),
-                         1e-7, 1.0 - 1e-7)
-            pos = jax.nn.one_hot(pairs[:, 0], k, dtype=r.dtype)
-            neg = jax.nn.one_hot(pairs[:, 1], k, dtype=r.dtype)
-            R = jnp.einsum("np,pi,pj->nij", r, pos, neg) \
-                + jnp.einsum("np,pi,pj->nij", 1.0 - r, neg, pos)
-            return _pairwise_coupling(R)
+            r = jax.nn.sigmoid(-(dec * A[None, :] + Bp[None, :]))
+            return _pairwise_coupling(_pair_probs_to_R(r, pairs, k))
         if "platt" in model:
             f = model["pair_dec"][:, 0]
             A, B = model["platt"][0], model["platt"][1]
@@ -712,13 +720,7 @@ class SVCFamily(Family):
             A = model["platt_pair"][:, 0]                     # (P,)
             B = model["platt_pair"][:, 1]
             r = jax.nn.sigmoid(-(f * A[None, :] + B[None, :]))
-            # libsvm clips pairwise probabilities away from {0, 1}
-            r = jnp.clip(r, 1e-7, 1.0 - 1e-7)                 # (n, P)
-            pos = jax.nn.one_hot(pairs[:, 0], k, dtype=r.dtype)
-            neg = jax.nn.one_hot(pairs[:, 1], k, dtype=r.dtype)
-            R = jnp.einsum("np,pi,pj->nij", r, pos, neg) \
-                + jnp.einsum("np,pi,pj->nij", 1.0 - r, neg, pos)
-            return _pairwise_coupling(R)
+            return _pairwise_coupling(_pair_probs_to_R(r, pairs, k))
         raise NotImplementedError(
             "predict_proba requires SVC(probability=True)")
 

@@ -324,6 +324,17 @@ class LinearSVCFamily(Family):
     dynamic_params = {"C": np.float32, "tol": np.float32}
 
     @classmethod
+    def convergence_order(cls, dynamic_params, static):
+        """Larger C = weaker regularisation = slower convergence (both
+        the hinge dual's residual exit and the squared-hinge primal's
+        L-BFGS stall exit fire sooner at small C) — sorted chunking
+        lets the easy launches retire early."""
+        C = dynamic_params.get("C")
+        if C is None or len(C) < 2:
+            return None
+        return np.argsort(np.asarray(C), kind="stable")
+
+    @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
         from spark_sklearn_tpu.models.base import encode_labels
         classes, y_enc = encode_labels(y)
@@ -505,6 +516,8 @@ class LinearSVRFamily(Family):
     is_classifier = False
     dynamic_params = {"C": np.float32, "tol": np.float32,
                       "epsilon": np.float32}
+
+    convergence_order = LinearSVCFamily.convergence_order
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):

@@ -224,3 +224,22 @@ class TestTreeEnsembleConversion:
         tm = sst.Converter().toTPU(sk)
         with pytest.raises(ValueError, match="inference-only"):
             sst.Converter().toSKLearn(tm)
+
+    def test_multioutput_and_multilabel_are_refused(self, digits):
+        # silently dropping outputs would return wrong predictions
+        from sklearn.ensemble import RandomForestRegressor
+        from sklearn.neural_network import MLPClassifier as SkMLP
+
+        rng = np.random.RandomState(0)
+        Xr = rng.randn(60, 5).astype(np.float32)
+        Y2 = rng.randn(60, 2).astype(np.float32)
+        rf = RandomForestRegressor(n_estimators=3,
+                                   random_state=0).fit(Xr, Y2)
+        with pytest.raises(ValueError, match="multi-output"):
+            sst.Converter().toTPU(rf)
+
+        Yml = (rng.rand(60, 3) > 0.5).astype(int)
+        mlp = SkMLP(hidden_layer_sizes=(8,), max_iter=20,
+                    random_state=0).fit(Xr, Yml)
+        with pytest.raises(ValueError, match="multilabel"):
+            sst.Converter().toTPU(mlp)
