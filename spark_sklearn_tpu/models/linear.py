@@ -37,16 +37,17 @@ class LogisticRegressionFamily(Family):
     is_classifier = True
     dynamic_params = {"C": np.float32, "tol": np.float32}
 
+    #: sorted chunking needs enough candidates to amortise the extra
+    #: dispatches on the GLM solvers (policy applied by the engine)
+    min_sort_candidates = 32
+
     @classmethod
-    def convergence_order(cls, dynamic_params, static):
-        """Difficulty proxy for sorted chunking: larger C = weaker
-        regularisation = slower L-BFGS/FISTA convergence.  Returns an
-        ascending-difficulty permutation, or None when C is not in the
-        grid (nothing to grade by)."""
-        C = dynamic_params.get("C")
-        if C is None or len(C) < 2:
-            return None
-        return np.argsort(np.asarray(C), kind="stable")
+    def convergence_proxy(cls, dynamic_params, static):
+        """Ascending-difficulty proxy for sorted chunking: larger C =
+        weaker regularisation = slower L-BFGS/FISTA convergence.  None
+        when C is not in the grid (nothing to grade by); the engine
+        applies the size threshold and constant-proxy guard."""
+        return dynamic_params.get("C")
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -478,14 +479,14 @@ class ElasticNetFamily(Family):
 
     prepare_data = RidgeFamily.prepare_data
 
+    min_sort_candidates = 32
+
     @classmethod
-    def convergence_order(cls, dynamic_params, static):
+    def convergence_proxy(cls, dynamic_params, static):
         """Smaller alpha = weaker penalty = slower FISTA convergence,
-        so ascending difficulty = DESCENDING alpha."""
+        so ascending difficulty = DESCENDING alpha (negated proxy)."""
         alpha = dynamic_params.get("alpha")
-        if alpha is None or len(alpha) < 2:
-            return None
-        return np.argsort(-np.asarray(alpha), kind="stable")
+        return None if alpha is None else -np.asarray(alpha)
 
     @classmethod
     def extract_params(cls, estimator):

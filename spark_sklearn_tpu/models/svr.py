@@ -323,16 +323,15 @@ class LinearSVCFamily(Family):
     is_classifier = True
     dynamic_params = {"C": np.float32, "tol": np.float32}
 
+    min_sort_candidates = 32
+
     @classmethod
-    def convergence_order(cls, dynamic_params, static):
+    def convergence_proxy(cls, dynamic_params, static):
         """Larger C = weaker regularisation = slower convergence (both
         the hinge dual's residual exit and the squared-hinge primal's
         L-BFGS stall exit fire sooner at small C) — sorted chunking
         lets the easy launches retire early."""
-        C = dynamic_params.get("C")
-        if C is None or len(C) < 2:
-            return None
-        return np.argsort(np.asarray(C), kind="stable")
+        return dynamic_params.get("C")
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -517,7 +516,8 @@ class LinearSVRFamily(Family):
     dynamic_params = {"C": np.float32, "tol": np.float32,
                       "epsilon": np.float32}
 
-    convergence_order = LinearSVCFamily.convergence_order
+    min_sort_candidates = 32
+    convergence_proxy = LinearSVCFamily.convergence_proxy
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):

@@ -82,6 +82,17 @@ class GradientBoostingRegressorFamily(Family):
             max([v for v in vals + [base]
                  if isinstance(v, (int, np.integer))] or [100]))
 
+    #: per-tree work is large (level histograms over all samples), so
+    #: even small grids amortise the extra dispatches
+    min_sort_candidates = 4
+
+    @classmethod
+    def convergence_proxy(cls, dynamic_params, static):
+        """A launch's while_loop grows max-over-lanes(n_estimators)
+        trees; sorting by n_estimators makes that max tight per
+        launch."""
+        return dynamic_params.get("n_estimators")
+
     @classmethod
     def fit(cls, dynamic, static, data, train_w, meta):
         codes, y = data["codes"], data["y"]
@@ -102,9 +113,16 @@ class GradientBoostingRegressorFamily(Family):
         F0 = jnp.sum(train_w * y) / wsum
         F = jnp.full((n,), F0, jnp.float32)
 
-        def one_tree(carry, inp):
-            F, = carry
-            t, k_t = inp
+        # while_loop with a per-lane trip count: a candidate stops
+        # growing trees past ITS n_estimators (the stacked per-stage
+        # trees were returned but never consumed — dropped, which also
+        # cuts the model pytree by t_max tree buffers per lane)
+        keys = jax.random.split(key, t_max)
+        n_lim = jnp.minimum(n_est, t_max)
+
+        def one_tree(carry):
+            t, F = carry
+            k_t = keys[t]
             g = (F - y)[:, None]                      # d(0.5(F-y)^2)/dF
             h = jnp.ones((n,), jnp.float32)
             w_t = train_w * (
@@ -114,14 +132,13 @@ class GradientBoostingRegressorFamily(Family):
                              min_child_weight=min_leaf, reg_lambda=1e-6)
             delta = predict_tree(tree, codes, depth)[:, 0]
             live = (t < n_est).astype(jnp.float32)
-            F = F + lr * live * delta
-            return (F,), tree
+            return t + 1, F + lr * live * delta
 
-        keys = jax.random.split(key, t_max)
-        (F,), trees = jax.lax.scan(
-            one_tree, (F,), (jnp.arange(t_max), keys))
-        return {"pred": F, "trees": trees, "f0": F0,
-                "lr": lr, "n_est": n_est}
+        _, F = jax.lax.while_loop(
+            lambda c: c[0] < n_lim, one_tree,
+            (jnp.asarray(0, jnp.int32), F))
+        return {"pred": F, "f0": F0, "lr": lr, "n_est": n_est,
+                "n_iter": n_lim}
 
     @classmethod
     def predict(cls, model, static, X, meta):
@@ -172,9 +189,14 @@ class GradientBoostingClassifierFamily(GradientBoostingRegressorFamily):
         F = jnp.broadcast_to(jnp.log(prior)[None, :], (n, k)).astype(
             jnp.float32) + jnp.zeros((n, k), jnp.float32)
 
-        def one_stage(carry, inp):
-            F, = carry
-            t, k_t = inp
+        # per-lane trip count, as in the regressor (stacked stage trees
+        # were never consumed — dropped)
+        keys = jax.random.split(key, t_max)
+        n_lim = jnp.minimum(n_est, t_max)
+
+        def one_stage(carry):
+            t, F = carry
+            k_t = keys[t]
             P = jax.nn.softmax(F, axis=1)
             w_t = train_w * (
                 jax.random.uniform(k_t, (n,)) < subsample).astype(
@@ -192,14 +214,13 @@ class GradientBoostingClassifierFamily(GradientBoostingRegressorFamily):
                 lambda tr: predict_tree(tr, codes, depth)[:, 0],
                 in_axes=0, out_axes=1)(trees_k)        # (n, k)
             live = (t < n_est).astype(jnp.float32)
-            F = F + lr * live * delta
-            return (F,), trees_k
+            return t + 1, F + lr * live * delta
 
-        keys = jax.random.split(key, t_max)
-        (F,), trees = jax.lax.scan(
-            one_stage, (F,), (jnp.arange(t_max), keys))
+        _, F = jax.lax.while_loop(
+            lambda c: c[0] < n_lim, one_stage,
+            (jnp.asarray(0, jnp.int32), F))
         return {"pred": jnp.argmax(F, axis=1).astype(jnp.int32),
-                "logits": F, "trees": trees, "n_est": n_est, "lr": lr}
+                "logits": F, "n_est": n_est, "lr": lr, "n_iter": n_lim}
 
     @classmethod
     def predict(cls, model, static, X, meta):
@@ -237,6 +258,8 @@ class RandomForestClassifierFamily(Family):
         return data, meta
 
     observe_candidates = GradientBoostingRegressorFamily.observe_candidates
+    min_sort_candidates = 4
+    convergence_proxy = GradientBoostingRegressorFamily.convergence_proxy
 
     @classmethod
     def _max_features(cls, static, d):
@@ -271,10 +294,19 @@ class RandomForestClassifierFamily(Family):
         mf = cls._max_features(static, d)
         key = jax.random.PRNGKey(_seed(static))
 
-        # scan (not vmap) over trees: level histograms are the memory hot
-        # spot and scanning keeps exactly one tree's workspace live
-        def one_tree(acc, inp):
-            ti, k_t = inp
+        # while_loop (not scan/vmap) over trees: level histograms are the
+        # memory hot spot, one tree's workspace stays live — and the
+        # per-lane trip count `i < n_est` means a candidate stops paying
+        # for trees past ITS n_estimators (under vmap, jax's while
+        # batching freezes finished lanes' carries; the launch runs the
+        # max over its lanes, which convergence-sorted chunking makes
+        # tight per launch instead of the grid maximum)
+        keys = jax.random.split(key, t_max)
+        n_lim = jnp.minimum(n_est, t_max)
+
+        def one_tree(carry):
+            ti, acc = carry
+            k_t = keys[ti]
             if bootstrap:
                 w_t = train_w * jax.random.poisson(
                     k_t, 1.0, (n,)).astype(jnp.float32)
@@ -289,15 +321,16 @@ class RandomForestClassifierFamily(Family):
                              max_features=mf, n_out=n_out)
             pred = predict_tree(tree, codes, depth)     # (n, n_out)
             live = (ti < n_est).astype(jnp.float32)
-            return acc + live * pred, None
+            return ti + 1, acc + live * pred
 
         acc0 = jnp.zeros((n, n_out), jnp.float32)
-        acc, _ = jax.lax.scan(
-            one_tree, acc0,
-            (jnp.arange(t_max), jax.random.split(key, t_max)))
-        avg = acc / jnp.maximum(
-            jnp.minimum(n_est, t_max).astype(jnp.float32), 1.0)
-        return cls._finalize(avg)
+        _, acc = jax.lax.while_loop(
+            lambda c: c[0] < n_lim, one_tree,
+            (jnp.asarray(0, jnp.int32), acc0))
+        avg = acc / jnp.maximum(n_lim.astype(jnp.float32), 1.0)
+        out = cls._finalize(avg)
+        out["n_iter"] = n_lim   # executed trees, for launch accounting
+        return out
 
     @classmethod
     def _finalize(cls, avg):

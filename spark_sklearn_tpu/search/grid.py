@@ -1151,19 +1151,29 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # and easy launches early-exit at their own iteration count.
             # cv_results_ order is unaffected (cells are written through
             # candidate_indices).
+            # The family supplies only the ascending-difficulty PROXY
+            # array; the split policy lives here in one place: the
+            # per-family minimum grid size (`min_sort_candidates` —
+            # GLM solvers need ~32 candidates to amortise the extra
+            # dispatches, tree ensembles win from ~4) and the
+            # constant-proxy guard (a grid varying only in other params
+            # would pay the launch split for zero benefit).
             sorted_chunks = False
-            order_hook = getattr(family, "convergence_order", None)
-            if order_hook is not None and config.sort_candidates \
-                    and nc >= 32:
-                order = order_hook(group.dynamic_params, static)
-                if order is not None:
-                    order = np.asarray(order)
-                    group.candidate_indices = np.asarray(
-                        group.candidate_indices)[order]
-                    group.dynamic_params = {
-                        k: np.asarray(v)[order]
-                        for k, v in group.dynamic_params.items()}
-                    sorted_chunks = True
+            proxy_hook = getattr(family, "convergence_proxy", None)
+            if proxy_hook is not None and config.sort_candidates:
+                proxy = proxy_hook(group.dynamic_params, static)
+                if proxy is not None:
+                    proxy = np.asarray(proxy)
+                    if len(proxy) >= getattr(
+                            family, "min_sort_candidates", 32) \
+                            and np.unique(proxy).size > 1:
+                        order = np.argsort(proxy, kind="stable")
+                        group.candidate_indices = np.asarray(
+                            group.candidate_indices)[order]
+                        group.dynamic_params = {
+                            k: np.asarray(v)[order]
+                            for k, v in group.dynamic_params.items()}
+                        sorted_chunks = True
 
             nc_batch = min(mesh_lib.pad_to_multiple(nc, n_task_shards),
                            max_cand_per_batch)

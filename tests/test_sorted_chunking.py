@@ -67,3 +67,54 @@ class TestSortedChunking:
         # below the sorting threshold nothing changes
         gs = _run(digits, sort=True, n_cand=8)
         assert gs.search_report["n_launches"] == 1
+
+
+class TestTreeSortedChunking:
+    def test_forest_launches_grow_their_own_tree_counts(self):
+        """Round 4: tree fits are lane-bounded while_loops — a launch
+        grows max-over-lanes(n_estimators) trees, and sorting by
+        n_estimators makes that max tight per launch instead of the
+        grid maximum's (measured 2.4x on the config-3 shape)."""
+        from sklearn.ensemble import RandomForestClassifier
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(300, 8).astype(np.float32)
+        y = rng.randint(0, 3, size=300)
+        # 32 candidates: launches pad to the task-shard multiple (8 on
+        # the virtual test mesh), so sorting yields 4 launches of 8
+        # whose tree counts are each block's own maximum
+        grid = {"n_estimators": list(range(5, 37))}
+
+        runs = {}
+        for sort in (True, False):
+            cfg = sst.TpuConfig(sort_candidates=sort)
+            gs = sst.GridSearchCV(
+                RandomForestClassifier(max_depth=4, random_state=0),
+                grid, cv=2, refit=False, backend="tpu",
+                config=cfg).fit(X, y)
+            runs[sort] = gs
+
+        rs = runs[True].search_report
+        ru = runs[False].search_report
+        assert rs["solver_iters_per_launch"] == [12, 20, 28, 36]
+        assert ru["solver_iters_per_launch"] == [36]
+        # identical results either way (masked lanes are frozen)
+        np.testing.assert_allclose(
+            runs[True].cv_results_["mean_test_score"],
+            runs[False].cv_results_["mean_test_score"], atol=1e-6)
+
+    def test_constant_proxy_stays_single_launch(self):
+        # a grid varying only in OTHER params must not pay the launch
+        # split: the proxy is constant, sorting is skipped
+        from sklearn.ensemble import GradientBoostingRegressor
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(200, 5).astype(np.float32)
+        y = (X[:, 0] + 0.1 * rng.randn(200)).astype(np.float32)
+        gs = sst.GridSearchCV(
+            GradientBoostingRegressor(n_estimators=15, max_depth=2,
+                                      random_state=0),
+            {"learning_rate": [0.02, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5,
+                               0.8]},
+            cv=2, refit=False, backend="tpu").fit(X, y)
+        assert gs.search_report["n_launches"] == 1
