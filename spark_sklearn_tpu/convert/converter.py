@@ -186,7 +186,8 @@ class Converter:
         model = {"sv_X": jnp.asarray(sv),
                  "alphas": jnp.asarray(alphas),
                  "intercepts": jnp.asarray(icpt)}
-        if getattr(est, "probability", False) and \
+        from spark_sklearn_tpu.models.svm import _probability_value_on
+        if _probability_value_on(getattr(est, "probability", False)) and \
                 getattr(est, "_probA", np.empty(0)).size:
             # the private pair is identical to probA_/probB_ without
             # sklearn 1.9's deprecation warning on the public accessor

@@ -90,8 +90,10 @@ class SVC(ClassifierMixin, BaseEstimator):
             jnp.ones((n,), jnp.float32), jnp.asarray(self._y),
             self._meta, self._static.get("class_weight"))
         bound = C * box if cw is None else C * box * cw[None, :]
-        A, b = fista_dual_ascent(K, yb, bound,
-                                 _power_step(K, n, jnp.float32), max_iter)
+        from spark_sklearn_tpu.models.svm import _tol_or_default
+        A, b, _ = fista_dual_ascent(
+            K, yb, bound, _power_step(K, n, jnp.float32), max_iter,
+            tol=_tol_or_default(self._static))
         return np.asarray(A * yb), np.asarray(b)      # signed alphas + b
 
     def decision_function(self, X):

@@ -191,7 +191,38 @@ def _masked_mean_or_mid(vals, free, at_hi, at_lo):
     return jnp.where(nfree > 0, mean_free, mid)
 
 
-def nu_dual_ascent(K, yb, bound, nu, step, max_iter):
+def _run_dual(grad, project, x0, step, max_iter, tol, dtype):
+    """Shared tol dispatch for the kernel duals: `tol=None` runs the
+    fixed count; otherwise `_box_fista`'s per-lane residual exit (the
+    batched analog of libsvm's eps rule) with the executed-iteration
+    max reported for accounting."""
+    if tol is None:
+        x = _box_fista(grad, project, x0, step, max_iter)
+        return x, jnp.asarray(max_iter, jnp.int32)
+    x, n_it, _ = _box_fista(grad, project, x0, step, max_iter,
+                            tol=jnp.full((x0.shape[0],), tol, dtype))
+    return x, jnp.max(n_it).astype(jnp.int32)
+
+
+def _tol_or_default(static):
+    """sklearn's SVC tol (libsvm eps), defaulting to libsvm's 1e-3."""
+    tol = static.get("tol", 1e-3)
+    return 1e-3 if tol is None else float(tol)
+
+
+def _probability_value_on(value):
+    """sklearn 1.9 deprecated SVC's `probability` and made its DEFAULT
+    the string "deprecated" — which is truthy, so a naive bool() turns
+    every plain SVC search into one that computes Platt calibration.
+    Only an explicit boolean True (python or numpy) counts."""
+    return isinstance(value, (bool, np.bool_)) and bool(value)
+
+
+def _probability_on(params):
+    return _probability_value_on(params.get("probability", False))
+
+
+def nu_dual_ascent(K, yb, bound, nu, step, max_iter, tol=None):
     """libsvm's nu-SVC dual (Solver_NU), batched over M subproblems:
 
         min_a 0.5 a'Q a,   0 <= a_i <= bound_i,
@@ -222,8 +253,8 @@ def nu_dual_ascent(K, yb, bound, nu, step, max_iter):
     def grad(Z):
         return yb * ((Z * yb) @ K)
 
-    A = _box_fista(grad, project, project(jnp.zeros_like(bound)),
-                   step, max_iter)
+    A, n_it = _run_dual(grad, project, project(jnp.zeros_like(bound)),
+                        step, max_iter, tol, K.dtype)
 
     V = (A * yb) @ K
     G = yb * V                         # gradient of 0.5 a'Qa
@@ -240,7 +271,7 @@ def nu_dual_ascent(K, yb, bound, nu, step, max_iter):
     rho = 0.5 * (r1 - r2)              # lambda_y
     ok = jnp.logical_and(feasible, r > 1e-12)
     dec = (V - rho[:, None]) / r[:, None]
-    return jnp.where(ok[:, None], dec, jnp.nan)
+    return jnp.where(ok[:, None], dec, jnp.nan), n_it
 
 
 def _kkt_intercept(K, A, yb, bound):
@@ -269,7 +300,7 @@ def _kkt_intercept(K, A, yb, bound):
     return jnp.where(nfree > 0, b_free, b_mid)
 
 
-def fista_dual_ascent(K, yb, bound, step, max_iter):
+def fista_dual_ascent(K, yb, bound, step, max_iter, tol=None):
     """Nesterov-accelerated projected gradient ascent on the SVM dual
 
         max_a  1'a - 0.5 a' Q a,   0 <= a_i <= bound_i,
@@ -279,18 +310,22 @@ def fista_dual_ascent(K, yb, bound, step, max_iter):
     bounds carry both the subproblem box mask and class_weight-scaled C).
     K: (n, n) kernel; yb/bound: (M, n) signed labels and box bounds for M
     subproblems advanced together — every iteration is ONE (M, n) @ (n, n)
-    matmul plus a vectorized hyperplane projection.  Returns (A, b):
-    alphas and the KKT intercept per subproblem.  Shared by the search's
-    task-batched fit and the standalone SVC so the numerics live once.
-    """
+    matmul plus a vectorized hyperplane projection.  Returns
+    (A, b, n_iter): alphas, the KKT intercept per subproblem, and the
+    executed iteration count (== max_iter when tol is None; with `tol`,
+    the per-lane prox-gradient-residual exit stops when every subproblem
+    is below it — the batched analog of libsvm's eps stopping rule,
+    which defaults to the same 1e-3 the sklearn `tol` parameter
+    carries).  Shared by the search's task-batched fit and the
+    standalone SVC so the numerics live once."""
 
     def grad(Z):                       # descent form of the ascent grad
         return -(1.0 - yb * ((Z * yb) @ K))
 
-    A = _box_fista(
+    A, n_it = _run_dual(
         grad, lambda Zt: _project_box_hyperplane(Zt, yb, bound),
-        jnp.zeros_like(bound), step, max_iter)
-    return A, _kkt_intercept(K, A, yb, bound)
+        jnp.zeros_like(bound), step, max_iter, tol, K.dtype)
+    return A, _kkt_intercept(K, A, yb, bound), n_it
 
 
 def _platt_fit(f, t, w, n_iter=50):
@@ -434,14 +469,15 @@ class SVCFamily(Family):
     task_batched_accepts_fold_inputs = True
 
     @classmethod
-    def _pair_dec(cls, K, p_c, base_bound, yb, step, max_iter):
+    def _pair_dec(cls, K, p_c, base_bound, yb, step, max_iter, tol=None):
         """Solve the M stacked pair subproblems and return their (M, n)
-        full-set decision rows.  `p_c` is the candidate's primary scalar
-        (C here: scales the box), `base_bound` the fold/weight/pair box
-        mask."""
+        full-set decision rows plus the executed iteration count.  `p_c`
+        is the candidate's primary scalar (C here: scales the box),
+        `base_bound` the fold/weight/pair box mask; `tol` enables the
+        per-lane residual exit (libsvm's eps stopping rule)."""
         bound = p_c * base_bound
-        A, b = fista_dual_ascent(K, yb, bound, step, max_iter)
-        return (A * yb) @ K + b[:, None]
+        A, b, n_it = fista_dual_ascent(K, yb, bound, step, max_iter, tol)
+        return (A * yb) @ K + b[:, None], n_it
 
     # kernel matrices + per-task decision caches are the memory hot spot;
     # tell the search to keep task batches small
@@ -463,8 +499,8 @@ class SVCFamily(Family):
         approximation when any candidate requests probability=True
         (the traced fit code cannot warn reliably — a program-cache
         hit skips tracing entirely)."""
-        if bool(base_params.get("probability", False)) or any(
-                bool(c.get("probability", False)) for c in candidates):
+        if _probability_on(base_params) or any(
+                _probability_on(c) for c in candidates):
             warnings.warn(
                 "compiled SVC(probability=True): Platt calibration uses "
                 "train-fold decision values, not libsvm's internal "
@@ -510,6 +546,11 @@ class SVCFamily(Family):
         max_iter = int(static.get("max_iter", -1))
         if max_iter in (-1, 0):
             max_iter = 300
+        # libsvm's eps stopping rule (sklearn tol, default 1e-3): each
+        # candidate's dual solve exits at ITS convergence inside the
+        # per-candidate scan — easy (small-C) candidates stop in tens of
+        # iterations instead of paying max_iter
+        tol_exit = _tol_or_default(static)
         # tasks are candidate-major with a fixed fold count injected by the
         # engine; the candidate count is B // n_folds
         n_folds = int(static.get("__n_folds__", 0))
@@ -561,9 +602,9 @@ class SVCFamily(Family):
                         * in_pair[None, :, :]).reshape(-1, n)
                 yb = jnp.broadcast_to(
                     ybin[None], (n_folds, P, n)).reshape(-1, n)
-                dec = cls._pair_dec(
-                    K, C_c, base, yb, step, max_iter).reshape(
-                    n_folds, P, n)
+                dec, it = cls._pair_dec(
+                    K, C_c, base, yb, step, max_iter, tol_exit)
+                dec = dec.reshape(n_folds, P, n)
             else:
                 # pipeline mode: each fold has its own transformed X, so
                 # kernels are per (candidate, fold); the P pair
@@ -586,16 +627,22 @@ class SVCFamily(Family):
                     step = _power_step(Kf, n, Xf.dtype)
                     base = (w_row * cw_row)[None, :] * in_pair
                     return cls._pair_dec(
-                        Kf, C_c, base, ybin, step, max_iter)  # (P, n)
+                        Kf, C_c, base, ybin, step, max_iter,
+                        tol_exit)                         # (P, n), it
 
-                dec = jax.vmap(per_fold)(X_folds, w_f, cw_fold)  # (F,P,n)
-            return carry, jnp.transpose(dec, (0, 2, 1))       # (F, n, P)
+                dec, its = jax.vmap(per_fold)(
+                    X_folds, w_f, cw_fold)                # (F,P,n), (F,)
+                it = jnp.max(its)
+            return carry, (jnp.transpose(dec, (0, 2, 1)), it)  # (F,n,P)
 
-        _, decs = jax.lax.scan(
+        _, (decs, its) = jax.lax.scan(
             one_candidate, 0.0, (C_cand, g_cand, w_cand))
-        # (nc, F, n, P) -> task-major (B, n, P)
-        model = {"pair_dec": decs.reshape(B, n, P)}
-        if bool(static.get("probability", False)):
+        # (nc, F, n, P) -> task-major (B, n, P); per-candidate executed
+        # dual iterations repeat across the fold axis for the engine's
+        # per-launch accounting
+        model = {"pair_dec": decs.reshape(B, n, P),
+                 "n_iter": jnp.repeat(its, n_folds)}
+        if _probability_on(static):
             # compiled Platt scaling: calibrate a sigmoid on the
             # TRAIN-fold decision values per task, stored with the model
             # so predict_proba / neg_log_loss scoring stay compiled.
@@ -746,8 +793,8 @@ class NuSVCFamily(SVCFamily):
     primary_default = 0.5
 
     @classmethod
-    def _pair_dec(cls, K, p_c, base_bound, yb, step, max_iter):
-        return nu_dual_ascent(K, yb, base_bound, p_c, step, max_iter)
+    def _pair_dec(cls, K, p_c, base_bound, yb, step, max_iter, tol=None):
+        return nu_dual_ascent(K, yb, base_bound, p_c, step, max_iter, tol)
 
 
 register_family(

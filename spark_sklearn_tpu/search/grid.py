@@ -1310,17 +1310,24 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     if bad is None:
                         leaf = jax.tree_util.tree_leaves(models)[0]
                         bad = jnp.zeros(leaf.shape[:2], bool)
-                    # executed-iteration count for FLOP/MFU accounting
-                    # (-1 sentinel: family has no iterative solver)
+                    # executed-iteration counts for FLOP/MFU accounting
+                    # (-1 sentinel: family has no iterative solver).
+                    # max = lockstep meaning (a launch executes the max
+                    # over its lanes); sum = per-lane meaning (scan-
+                    # sequential families like SVC execute each lane's
+                    # own count) — consumers pick the one that matches
+                    # the family's execution model.
                     iters = jnp.int32(-1)
+                    iters_sum = jnp.int32(-1)
                     if isinstance(models, dict):
                         it = models.get("n_iter_exec",
                                         models.get("n_iter"))
                         if it is not None:
                             iters = jnp.max(it).astype(jnp.int32)
+                            iters_sum = jnp.sum(it).astype(jnp.int32)
                     te, tr = score_batch_wide(models, data_d, test_m,
                                               train_m, test_u, train_u)
-                    return te, tr, bad, iters
+                    return te, tr, bad, iters, iters_sum
 
                 fused_jit = _cached_program(
                     ("fused", family, static, meta, nc_batch, n_folds,
@@ -1387,7 +1394,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
                 t0 = time.perf_counter()
                 if fused:
-                    te, tr, bad, iters_max = fused_jit(
+                    te, tr, bad, iters_max, iters_sum = fused_jit(
                         dyn, data_dev,
                         w_task_dev if task_batched else fit_dev,
                         test_dev, train_sc_dev, test_unw_dev,
@@ -1406,6 +1413,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     if im >= 0:
                         report.setdefault(
                             "solver_iters_per_launch", []).append(im)
+                        report.setdefault(
+                            "solver_iters_sum_per_launch", []).append(
+                            int(iters_sum))
                         report.setdefault(
                             "lanes_per_launch", []).append(
                             int(nc_batch * n_folds))
@@ -1435,10 +1445,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         # internal budget)
                         it_arr = models.get("n_iter_exec",
                                             models.get("n_iter"))
+                        it_host = np.asarray(
+                            mesh_lib.device_get_tree(it_arr))
                         report.setdefault(
                             "solver_iters_per_launch", []).append(
-                            int(np.max(np.asarray(
-                                mesh_lib.device_get_tree(it_arr)))))
+                            int(np.max(it_host)))
+                        report.setdefault(
+                            "solver_iters_sum_per_launch", []).append(
+                            int(np.sum(it_host)))
                         report.setdefault(
                             "lanes_per_launch", []).append(
                             int(nc_batch * n_folds))
