@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Full CI gate — the analog of the reference's [R .travis.yml] / [R dev/run-tests]
+# matrix (SURVEY §2.1), collapsed to the one platform that matters here.
+#
+# Runs, in order:
+#   1. the FULL own-test gate (slow marks included: `-m ""`),
+#   2. the vendored upstream sklearn search suite (conformance oracle),
+#   3. the multichip dryrun on a virtual 8-device CPU mesh.
+#
+# Usage: dev/run-tests.sh [--fast]
+#   --fast  run only the fast gate (slow-marked tests deselected), for the
+#           quick inner loop on constrained boxes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MARK=(-m "")
+if [[ "${1:-}" == "--fast" ]]; then
+    MARK=()
+fi
+
+echo "== own tests (${1:---full}) =="
+python -m pytest tests/ -q "${MARK[@]}"
+
+echo "== vendored upstream sklearn suite =="
+python -m pytest vendored_tests/ -q
+
+echo "== multichip dryrun (virtual 8-device CPU mesh) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+echo "ALL GATES GREEN"

@@ -17,7 +17,7 @@ Public API (mirrors the reference's __init__.py exports):
   - CSRMatrix                          (reference: udt.py CSRVectorUDT)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 import spark_sklearn_tpu.models  # noqa: F401 — registers Tier-A families
 from spark_sklearn_tpu.search.grid import GridSearchCV, RandomizedSearchCV
