@@ -60,8 +60,86 @@ class TpuModel:
         return np.asarray(self.family.predict_proba(
             self.model, self.static, X, self.meta))
 
+    def transform(self, X):
+        import jax.numpy as jnp
+        X = jnp.asarray(np.asarray(X))
+        return np.asarray(self.family.transform(
+            self.model, self.static, X, self.meta))
+
     def __repr__(self):
         return f"TpuModel(family={self.family.name})"
+
+
+class _BruteKNNShim:
+    """Standalone device inference for converted KNeighbors models.
+
+    The search-internal KNN families cache per-fold vote tables (their
+    `predict` ignores X), so a converted model instead stores the fitted
+    data itself — sklearn's own fitted state for KNN — and evaluates
+    brute-force euclidean k-NN as one (q, n) distance matmul per query
+    batch, the same MXU identity the search family uses."""
+
+    is_classifier = False
+    name = "knn_brute_regressor"
+
+    @staticmethod
+    def _neighbor_votes(model, static, X):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        from spark_sklearn_tpu.models.cluster import _sq_dists
+        from spark_sklearn_tpu.models.neighbors import _EPS_DIST
+
+        k = int(static.get("n_neighbors", 5))
+        negv, idx = lax.top_k(-_sq_dists(X, model["X"]), k)
+        if static.get("weights", "uniform") == "distance":
+            w = 1.0 / jnp.maximum(jnp.sqrt(-negv), _EPS_DIST)
+        else:
+            w = jnp.ones_like(negv)
+        return idx, w
+
+    @classmethod
+    def predict(cls, model, static, X, meta):
+        import jax.numpy as jnp
+        idx, w = cls._neighbor_votes(model, static, X)
+        vals = model["y"][idx]                       # (q, k)
+        return jnp.sum(vals * w, axis=1) / jnp.sum(w, axis=1)
+
+
+class _BruteKNNClassifierShim(_BruteKNNShim):
+    is_classifier = True
+    name = "knn_brute_classifier"
+
+    @classmethod
+    def predict_proba(cls, model, static, X, meta):
+        import jax
+        import jax.numpy as jnp
+        idx, w = cls._neighbor_votes(model, static, X)
+        oh = jax.nn.one_hot(model["y"][idx], meta["n_classes"],
+                            dtype=w.dtype)           # (q, k, C)
+        votes = jnp.sum(oh * w[:, :, None], axis=1)
+        return votes / jnp.sum(votes, axis=1, keepdims=True)
+
+    @classmethod
+    def predict(cls, model, static, X, meta):
+        import jax.numpy as jnp
+        return jnp.argmax(
+            cls.predict_proba(model, static, X, meta), axis=1)
+
+
+class _PCATransformShim:
+    """Transformer-side TpuModel for converted sklearn PCA (the search
+    uses PCA only inside compiled pipelines — models/preprocessing.py
+    PCAStep — so the converter carries its own shim reusing the step's
+    apply)."""
+
+    is_classifier = False
+    name = "pca_transform"
+
+    @staticmethod
+    def transform(model, static, X, meta):
+        from spark_sklearn_tpu.models.preprocessing import PCAStep
+        return PCAStep.apply(static, model, X)
 
 
 class Converter:
@@ -93,9 +171,18 @@ class Converter:
 
     def toTPU(self, sklearn_model) -> TpuModel:
         import jax.numpy as jnp
+        from spark_sklearn_tpu.models.preprocessing import (PCAStep,
+                                                            resolve_step)
+        if resolve_step(sklearn_model) is PCAStep:
+            return self._pca_to_tpu(sklearn_model)
         family = resolve_family(sklearn_model)
         if family is not None and family.name in ("svc", "nu_svc"):
             return self._svc_to_tpu(sklearn_model, family)
+        if family is not None and family.name == "kmeans":
+            return self._kmeans_to_tpu(sklearn_model, family)
+        if family is not None and family.name in ("kneighbors_classifier",
+                                                  "kneighbors_regressor"):
+            return self._knn_to_tpu(sklearn_model, family)
         if family is not None and family.name in ("mlp_classifier",
                                                   "mlp_regressor"):
             return self._mlp_to_tpu(sklearn_model, family)
@@ -110,8 +197,8 @@ class Converter:
                 f"convertible family (reference Converter supports "
                 f"LogisticRegression/LinearRegression only; this one also "
                 f"covers Ridge/ElasticNet/Lasso, SVC/NuSVC, "
-                f"MLPClassifier/MLPRegressor and RandomForest/"
-                f"GradientBoosting ensembles)")
+                f"MLPClassifier/MLPRegressor, RandomForest/"
+                f"GradientBoosting ensembles, KMeans, KNeighbors and PCA)")
         if not hasattr(sklearn_model, "coef_"):
             raise ValueError("model must be fitted (missing coef_)")
         static = family.extract_params(sklearn_model)
@@ -238,6 +325,88 @@ class Converter:
                   for W, b in zip(coefs, icpts)]
         return TpuModel(family, {"layers": layers}, static, meta)
 
+    def _kmeans_to_tpu(self, est, family) -> TpuModel:
+        """Fitted sklearn KMeans -> centers-pytree TpuModel: the fitted
+        state is just `cluster_centers_` (plus inertia/n_iter bookkeeping),
+        and the family's own predict/decision evaluate argmin squared
+        distance from the stored centers (models/cluster.py)."""
+        import jax.numpy as jnp
+        from sklearn.utils.validation import check_is_fitted
+
+        check_is_fitted(est)
+        static = dict(est.get_params(deep=False))
+        centers = np.asarray(est.cluster_centers_, np.float32)
+        model = {"centers": jnp.asarray(centers),
+                 "inertia": jnp.asarray(float(est.inertia_), jnp.float32),
+                 "n_iter": jnp.asarray(int(est.n_iter_), jnp.int32)}
+        meta: Dict[str, Any] = {"n_features": int(centers.shape[1])}
+        return TpuModel(family, model, static, meta)
+
+    def _knn_to_tpu(self, est, family) -> TpuModel:
+        """Fitted sklearn KNeighbors{Classifier,Regressor} -> a TpuModel
+        holding the fit data itself (k-NN's entire fitted state) with a
+        brute-euclidean device evaluator (_BruteKNNShim).  The guard
+        mirrors the search family's compiled-metric envelope."""
+        import jax.numpy as jnp
+        from sklearn.utils.validation import check_is_fitted
+
+        from spark_sklearn_tpu.models.neighbors import _check_metric
+
+        check_is_fitted(est)
+        if np.asarray(est._y).ndim > 1 or getattr(est, "outputs_2d_",
+                                                  False):
+            # ravel()ing (n, n_outputs) targets would interleave columns
+            # into the vote table and predict garbage
+            raise ValueError(
+                "Cannot convert a multi-output KNeighbors model; only "
+                "single-output estimators are supported")
+        static = dict(est.get_params(deep=False))
+        _check_metric(static)
+        fit_X = np.asarray(est._fit_X, np.float32)
+        meta: Dict[str, Any] = {"n_features": int(fit_X.shape[1])}
+        if family.is_classifier:
+            classes = np.asarray(est.classes_)
+            meta["n_classes"] = len(classes)
+            meta["classes"] = classes
+            # sklearn stores _y already encoded against classes_
+            y = jnp.asarray(np.asarray(est._y).ravel(), jnp.int32)
+            shim = _BruteKNNClassifierShim
+        else:
+            y = jnp.asarray(np.asarray(est._y).ravel(), jnp.float32)
+            shim = _BruteKNNShim
+        model = {"X": jnp.asarray(fit_X), "y": y}
+        return TpuModel(shim, model, static, meta)
+
+    def _pca_to_tpu(self, est) -> TpuModel:
+        """Fitted sklearn PCA -> TpuModel over PCAStep's state pytree
+        ({mean, components, var}); transform reuses the compiled step
+        (models/preprocessing.py PCAStep.apply), so whitening matches.
+        The sklearn-only fitted attributes ride along in meta so a round
+        trip restores them exactly."""
+        import jax.numpy as jnp
+        from sklearn.utils.validation import check_is_fitted
+
+        check_is_fitted(est)
+        static = dict(est.get_params(deep=False))
+        static["n_components"] = int(est.n_components_)
+        model = {"mean": jnp.asarray(est.mean_, jnp.float32),
+                 "components": jnp.asarray(est.components_, jnp.float32),
+                 "var": jnp.asarray(est.explained_variance_, jnp.float32)}
+        meta: Dict[str, Any] = {
+            "n_features": int(est.n_features_in_),
+            "n_samples": int(est.n_samples_),
+            "explained_variance_ratio": np.asarray(
+                est.explained_variance_ratio_, np.float64),
+            "singular_values": np.asarray(est.singular_values_, np.float64),
+            "noise_variance": float(est.noise_variance_),
+            # float64 originals so toSKLearn round-trips exactly
+            "mean64": np.asarray(est.mean_, np.float64),
+            "components64": np.asarray(est.components_, np.float64),
+            "explained_variance64": np.asarray(
+                est.explained_variance_, np.float64),
+        }
+        return TpuModel(_PCATransformShim, model, static, meta)
+
     def _tree_ensemble_to_tpu(self, est, family) -> TpuModel:
         """Fitted sklearn tree ensemble -> packed-arrays TpuModel with a
         compiled level-by-level traversal (convert/tree_infer.py).  The
@@ -283,6 +452,10 @@ class Converter:
             return self._svc_to_sklearn(tpu_model)
         if family.name in ("mlp_classifier", "mlp_regressor"):
             return self._mlp_to_sklearn(tpu_model)
+        if family.name in ("knn_brute_classifier", "knn_brute_regressor"):
+            return self._knn_to_sklearn(tpu_model)
+        if family.name == "pca_transform":
+            return self._pca_to_sklearn(tpu_model)
         if family.name == "sk_tree_ensemble":
             raise ValueError(
                 "tree-ensemble TpuModels are inference-only (packed "
@@ -296,6 +469,9 @@ class Converter:
             "linear_regression": lm.LinearRegression,
             "elastic_net": lm.ElasticNet,
         }.get(family.name)
+        if cls is None and family.name == "kmeans":
+            from sklearn.cluster import KMeans
+            cls = KMeans
         if cls is None:
             raise ValueError(f"no sklearn counterpart for {family.name}")
         valid = cls().get_params()
@@ -303,6 +479,9 @@ class Converter:
                      if k in valid})
         for k, v in attrs.items():
             setattr(est, k, v)
+        if family.name == "kmeans":
+            # sklearn's KMeans.predict reads the fitted thread plan
+            est._n_threads = 1
         return est
 
     to_sklearn = toSKLearn
@@ -357,6 +536,52 @@ class Converter:
         est.class_weight_ = np.ones(k)
         est.n_features_in_ = sv.shape[1]
         est.n_iter_ = np.zeros(len(pairs), dtype=np.int32)
+        return est
+
+    def _knn_to_sklearn(self, tm: TpuModel):
+        """Brute-KNN TpuModel -> sklearn KNeighbors estimator by refitting
+        on the stored data — for k-NN, fit() IS storing the data, so this
+        is an exact reconstruction, not an approximation."""
+        from sklearn.neighbors import (KNeighborsClassifier,
+                                       KNeighborsRegressor)
+
+        is_clf = tm.family.is_classifier
+        cls = KNeighborsClassifier if is_clf else KNeighborsRegressor
+        valid = cls().get_params()
+        est = cls(**{k: v for k, v in tm.static.items() if k in valid})
+        X = np.asarray(tm.model["X"], np.float64)
+        y = np.asarray(tm.model["y"])
+        if is_clf:
+            y = np.asarray(tm.meta["classes"])[y]
+        return est.fit(X, y)
+
+    def _pca_to_sklearn(self, tm: TpuModel):
+        """PCA TpuModel -> a functional sklearn PCA by attribute
+        injection (transform reads components_/mean_/explained_variance_);
+        exact when the model came from toTPU (float64 originals ride in
+        meta), float32-cast otherwise."""
+        from sklearn.decomposition import PCA
+
+        valid = PCA().get_params()
+        est = PCA(**{k: v for k, v in tm.static.items() if k in valid})
+        meta = tm.meta
+        est.components_ = np.asarray(
+            meta.get("components64", np.asarray(tm.model["components"])),
+            np.float64)
+        est.mean_ = np.asarray(
+            meta.get("mean64", np.asarray(tm.model["mean"])), np.float64)
+        est.explained_variance_ = np.asarray(
+            meta.get("explained_variance64",
+                     np.asarray(tm.model["var"])), np.float64)
+        n_comp, n_feat = est.components_.shape
+        est.n_components_ = n_comp
+        est.n_features_in_ = n_feat
+        est.n_samples_ = int(meta.get("n_samples", 0))
+        est.explained_variance_ratio_ = np.asarray(meta.get(
+            "explained_variance_ratio", np.full(n_comp, np.nan)))
+        est.singular_values_ = np.asarray(meta.get(
+            "singular_values", np.full(n_comp, np.nan)))
+        est.noise_variance_ = float(meta.get("noise_variance", 0.0))
         return est
 
     def _mlp_to_sklearn(self, tm: TpuModel):

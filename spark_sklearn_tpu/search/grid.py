@@ -32,7 +32,7 @@ from __future__ import annotations
 import numbers
 import time
 import warnings
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -79,8 +79,29 @@ def _freeze(obj):
     return freeze(obj, strict=True)
 
 
-_PROGRAM_CACHE: Dict[Any, Any] = {}
+#: cross-search cache of jitted callables, LRU-ordered (oldest first).
+#: Values are (callable, family_tag); jitted callables pin XLA executables
+#: and device constants, so the bound is per-family as well as global — a
+#: long-lived process cycling many shapes of ONE family can at worst evict
+#: its own older programs, never another family's entire working set.
+_PROGRAM_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _PROGRAM_CACHE_MAX = 128
+_PROGRAM_CACHE_MAX_PER_FAMILY = 32
+_PROGRAM_CACHE_FAMILY_COUNTS: Dict[Any, int] = defaultdict(int)
+
+
+def _cache_evict(fam=None):
+    """Drop the least-recently-used entry (of `fam` if given, else global)."""
+    victim = None
+    if fam is not None:
+        victim = next((k for k, (_, f) in _PROGRAM_CACHE.items() if f == fam),
+                      None)
+    if victim is None:
+        victim = next(iter(_PROGRAM_CACHE))
+    _, vfam = _PROGRAM_CACHE.pop(victim)
+    _PROGRAM_CACHE_FAMILY_COUNTS[vfam] -= 1
+    if _PROGRAM_CACHE_FAMILY_COUNTS[vfam] <= 0:
+        del _PROGRAM_CACHE_FAMILY_COUNTS[vfam]
 #: launches per compile group under convergence-sorted chunking — enough
 #: grading that easy launches early-exit well below max_iter, few enough
 #: that each launch stays matmul-wide
@@ -97,17 +118,27 @@ def _cached_program(key, build):
     is not).  Keyed by everything the closures capture; jax.jit's own
     cache below handles shapes/dtypes.  Unkeyable captures (e.g. a fresh
     user lambda) just skip the cache.
+
+    Eviction is LRU with per-family program accounting (keys are
+    ("fit"|"score"|..., family, ...) tuples): a family at its cap evicts
+    its own LRU entry, the global cap evicts the overall LRU entry.
     """
     try:
         k = _freeze(key)
     except TypeError:
         return build()
-    fn = _PROGRAM_CACHE.get(k)
-    if fn is None:
-        if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
-            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-        fn = build()
-        _PROGRAM_CACHE[k] = fn
+    hit = _PROGRAM_CACHE.get(k)
+    if hit is not None:
+        _PROGRAM_CACHE.move_to_end(k)
+        return hit[0]
+    fam = key[1] if isinstance(key, tuple) and len(key) > 1 else None
+    if _PROGRAM_CACHE_FAMILY_COUNTS.get(fam, 0) >= _PROGRAM_CACHE_MAX_PER_FAMILY:
+        _cache_evict(fam)
+    elif len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+        _cache_evict()
+    fn = build()
+    _PROGRAM_CACHE[k] = (fn, fam)
+    _PROGRAM_CACHE_FAMILY_COUNTS[fam] += 1
     return fn
 
 
