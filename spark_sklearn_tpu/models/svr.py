@@ -40,10 +40,12 @@ from spark_sklearn_tpu.models.svm import (
     _project_box_hyperplane,
     _project_box_sum,
     _resolve_gamma,
+    _run_dual,
+    _tol_or_default,
 )
 
 
-def svr_dual_ascent(K, y, eps, bound_half, step, max_iter):
+def svr_dual_ascent(K, y, eps, bound_half, step, max_iter, tol=None):
     """Nesterov-accelerated projected ascent on the epsilon-SVR dual
 
         max_{a,a*}  -0.5 (a-a*)' K (a-a*) - eps 1'(a+a*) + y'(a-a*)
@@ -53,8 +55,11 @@ def svr_dual_ascent(K, y, eps, bound_half, step, max_iter):
     equality is sum(s*u) = 0 (SVC's hyperplane with s for labels) and the
     quadratic acts through beta = a - a* so each iteration is one
     (M, n) @ (n, n) matmul.  bound_half: (M, n) per-sample C (fold-masked,
-    sample-weight-scaled); applies to both halves.  Returns (beta, b).
-    """
+    sample-weight-scaled); applies to both halves.  `tol` enables the
+    per-lane prox-residual exit on the stacked (a, a*) iterate — the
+    batched analog of libsvm's eps rule for epsilon-SVR (sklearn SVR
+    tol, default 1e-3), same machinery SVC's duals got in round 4.
+    Returns (beta, b, n_iter)."""
     M, n = bound_half.shape
     dtype = K.dtype
     s = jnp.concatenate([jnp.ones((n,), dtype), -jnp.ones((n,), dtype)])
@@ -67,11 +72,11 @@ def svr_dual_ascent(K, y, eps, bound_half, step, max_iter):
         V = jnp.concatenate([V_half, V_half], axis=1)  # (M, 2n)
         return -(lin - s * V)
 
-    U = _box_fista(
+    U, n_it = _run_dual(
         grad, lambda Zt: _project_box_hyperplane(Zt, s[None, :], bound),
-        jnp.zeros_like(bound), step, max_iter)
+        jnp.zeros_like(bound), step, max_iter, tol, dtype)
     beta = (U * s).reshape(M, 2, n).sum(axis=1)
-    return beta, _svr_intercept(K, U, beta, y, eps, bound_half)
+    return beta, _svr_intercept(K, U, beta, y, eps, bound_half), n_it
 
 
 def _svr_intercept(K, U, beta, y, eps, bound_half):
@@ -109,14 +114,16 @@ def _svr_intercept(K, U, beta, y, eps, bound_half):
     return jnp.where(nfree > 0, b_free, b_mid)
 
 
-def nu_svr_dual_ascent(K, y, nu, bound_half, step, max_iter):
+def nu_svr_dual_ascent(K, y, nu, bound_half, step, max_iter, tol=None):
     """libsvm's nu-SVR dual (solve_nu_svr): stacked u = (a, a*) with
     per-element box C (already folded into `bound_half` by the caller,
     fold/sample-weight-scaled), sum over EACH half = C*nu*l/2 — i.e.
     nu/2 of the half's total box capacity, which keeps the libsvm value
     under fold masks and sample weights — and no epsilon in the
     objective: the tube width is implicit, recovered from the KKT
-    conditions together with b.  Always feasible for nu in (0, 1]."""
+    conditions together with b.  Always feasible for nu in (0, 1].
+    `tol` enables the per-lane residual exit (libsvm eps rule); returns
+    (f, n_iter)."""
     M, n = bound_half.shape
     dtype = K.dtype
     s = jnp.concatenate([jnp.ones((n,), dtype), -jnp.ones((n,), dtype)])
@@ -138,8 +145,9 @@ def nu_svr_dual_ascent(K, y, nu, bound_half, step, max_iter):
         V = jnp.concatenate([V_half, V_half], axis=1)
         return -(lin - s * V)
 
-    U = _box_fista(grad, project, project(jnp.zeros((M, 2 * n), dtype)),
-                   step, max_iter)
+    U, n_it = _run_dual(grad, project,
+                        project(jnp.zeros((M, 2 * n), dtype)),
+                        step, max_iter, tol, dtype)
     beta = (U * s).reshape(M, 2, n).sum(axis=1)
     # KKT: free a  -> y - f0 - b = +eps  (E estimates b + eps)
     #      free a* -> y - f0 - b = -eps  (E estimates b - eps)
@@ -159,7 +167,7 @@ def nu_svr_dual_ascent(K, y, nu, bound_half, step, max_iter):
                                inb & (a_star <= t_lo))
     b = 0.5 * (m_a + m_as)
     f = beta @ K + b[:, None]
-    return jnp.where(feasible[:, None], f, jnp.nan)
+    return jnp.where(feasible[:, None], f, jnp.nan), n_it
 
 
 class SVRFamily(Family):
@@ -177,12 +185,15 @@ class SVRFamily(Family):
     task_batched_accepts_fold_inputs = True
 
     @classmethod
-    def _fold_dual(cls, K, y, C_c, aux_c, w_rows, step, max_iter):
-        """Solve the fold subproblems for one candidate; returns (F, n)
-        full-set regression values.  `aux_c` is epsilon here."""
+    def _fold_dual(cls, K, y, C_c, aux_c, w_rows, step, max_iter,
+                   tol=None):
+        """Solve the fold subproblems for one candidate; returns ((F, n)
+        full-set regression values, executed iterations).  `aux_c` is
+        epsilon here; `tol` enables the per-candidate residual exit."""
         bound = C_c * w_rows
-        beta, b = svr_dual_ascent(K, y, aux_c, bound, step, max_iter)
-        return beta @ K + b[:, None]
+        beta, b, n_it = svr_dual_ascent(
+            K, y, aux_c, bound, step, max_iter, tol)
+        return beta @ K + b[:, None], n_it
 
     @staticmethod
     def max_tasks_hint(n_samples: int, meta) -> int:
@@ -214,6 +225,11 @@ class SVRFamily(Family):
         max_iter = int(static.get("max_iter", -1))
         if max_iter in (-1, 0):
             max_iter = 300
+        # libsvm's eps stopping rule (sklearn SVR tol, default 1e-3):
+        # each candidate's paired (a, a*) dual exits at ITS convergence
+        # inside the per-candidate scan — the same per-candidate tol
+        # exit SVC's pair duals got in round 4 (VERDICT r4 next #2)
+        tol_exit = _tol_or_default(static)
         n_folds = int(static.get("__n_folds__", 0))
         if n_folds <= 0:
             raise ValueError("engine must pass __n_folds__ for SVR")
@@ -242,7 +258,8 @@ class SVRFamily(Family):
             if X_folds is None:
                 K = _kernel(X, X, kind, g_c, degree, coef0)
                 step = 0.5 * _power_step(K, n, X.dtype)   # lam_max doubles
-                f = cls._fold_dual(K, y, C_c, e_c, w_f, step, max_iter)
+                f, it = cls._fold_dual(
+                    K, y, C_c, e_c, w_f, step, max_iter, tol_exit)
             else:
                 def per_fold(Xf, w_row):
                     if gamma_is_scale:
@@ -257,16 +274,21 @@ class SVRFamily(Family):
                         g_f = g_c
                     Kf = _kernel(Xf, Xf, kind, g_f, degree, coef0)
                     step = 0.5 * _power_step(Kf, n, Xf.dtype)
-                    return cls._fold_dual(
+                    ff, itf = cls._fold_dual(
                         Kf, y, C_c, e_c, w_row[None, :], step,
-                        max_iter)[0]
+                        max_iter, tol_exit)
+                    return ff[0], itf
 
-                f = jax.vmap(per_fold)(X_folds, w_f)       # (F, n)
-            return carry, f
+                f, its = jax.vmap(per_fold)(X_folds, w_f)  # (F, n), (F,)
+                it = jnp.max(its)
+            return carry, (f, it)
 
-        _, fs = jax.lax.scan(
+        _, (fs, its) = jax.lax.scan(
             one_candidate, 0.0, (C_cand, g_cand, e_cand, w_cand))
-        return {"f": fs.reshape(B, n)}
+        # per-candidate executed dual iterations repeat across the fold
+        # axis for the engine's per-launch accounting (same layout as SVC)
+        return {"f": fs.reshape(B, n),
+                "n_iter": jnp.repeat(its, n_folds)}
 
     @classmethod
     def predict(cls, model, static, X, meta):
@@ -653,9 +675,10 @@ class NuSVRFamily(SVRFamily):
     aux_default = 0.5
 
     @classmethod
-    def _fold_dual(cls, K, y, C_c, aux_c, w_rows, step, max_iter):
+    def _fold_dual(cls, K, y, C_c, aux_c, w_rows, step, max_iter,
+                   tol=None):
         return nu_svr_dual_ascent(
-            K, y, aux_c, C_c * w_rows, step, max_iter)
+            K, y, aux_c, C_c * w_rows, step, max_iter, tol)
 
 
 register_family(
