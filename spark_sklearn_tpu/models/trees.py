@@ -24,6 +24,7 @@ Known deviations from sklearn (accuracy-level parity, tested):
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict
 
 import jax
@@ -34,6 +35,8 @@ from spark_sklearn_tpu.models.base import Family, encode_labels, register_family
 from spark_sklearn_tpu.ops.trees import Tree, grow_tree, predict_tree
 
 N_BINS = 256
+#: fixed-shape compiled growers need a static depth bound
+MAX_COMPILED_DEPTH = 10
 
 
 def _prep_codes(X, dtype):
@@ -47,9 +50,53 @@ def _seed(static):
     return 0 if rs is None else int(rs)
 
 
+def _observe_tree_candidates(cls, candidates, base_params, meta):
+    """Engine hook body, host-side once per search (shared by the GBDT
+    and forest families — they don't share a base class, so the hook is
+    a free function that takes the concrete family).
+
+    1. The compiled program always grows the grid's MAX tree count
+       (contributions masked per candidate), so the static bound must be
+       known before tracing.
+    2. The once-per-search depth-fidelity warning (VERDICT r4 next #3):
+       a `max_depth` of None or > MAX_COMPILED_DEPTH is truncated by the
+       fixed-shape grower (None maps to the family's default bound),
+       which can change the model on deep data — that must never happen
+       without a visible signal.
+    """
+    # the base estimator's value only matters where a candidate does not
+    # override it — unconditionally including it would grow (and warn
+    # about) models the search never fits (e.g. the default
+    # n_estimators=100 under a {"n_estimators": [5, 8]} grid)
+    base = base_params.get("n_estimators", 100)
+    vals = [c.get("n_estimators", base) for c in candidates] or [base]
+    meta["max_estimators"] = int(
+        max([v for v in vals
+             if isinstance(v, (int, np.integer))] or [100]))
+    base_md = base_params.get("max_depth", cls._sklearn_default_depth)
+    depths = ({c.get("max_depth", base_md) for c in candidates}
+              or {base_md})
+    truncated = sorted(
+        (d for d in depths
+         if d is None or (isinstance(d, (int, np.integer))
+                          and int(d) > MAX_COMPILED_DEPTH)),
+        key=lambda d: (d is not None, d if d is not None else 0))
+    if truncated:
+        warnings.warn(
+            f"compiled {cls.name}: max_depth values {truncated} exceed "
+            f"the histogram grower's static bound — integers are capped "
+            f"at {MAX_COMPILED_DEPTH} and None (sklearn: unbounded) "
+            f"maps to the family default of {cls._default_depth}. The "
+            f"fitted model can differ from sklearn's on deep data; "
+            f"pass max_depth <= {MAX_COMPILED_DEPTH} for a faithful "
+            f"compiled fit, or backend='host' for sklearn's exact "
+            f"unbounded CART.",
+            UserWarning, stacklevel=2)
+
+
 def _depth(static, default):
     md = static.get("max_depth", default)
-    return default if md is None else min(int(md), 10)
+    return default if md is None else min(int(md), MAX_COMPILED_DEPTH)
 
 
 class GradientBoostingRegressorFamily(Family):
@@ -61,6 +108,8 @@ class GradientBoostingRegressorFamily(Family):
                       "subsample": np.float32}
     #: max_depth=None caps deeper than GBDT's usual 3
     _default_depth = 3
+    #: sklearn's own ctor default (GradientBoosting*: max_depth=3)
+    _sklearn_default_depth = 3
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -73,14 +122,7 @@ class GradientBoostingRegressorFamily(Family):
 
     @classmethod
     def observe_candidates(cls, candidates, base_params, meta):
-        """Engine hook: the compiled program always grows the grid's MAX
-        tree count (contributions masked per candidate), so the static
-        bound must be known before tracing."""
-        base = base_params.get("n_estimators", 100)
-        vals = [c.get("n_estimators", base) for c in candidates]
-        meta["max_estimators"] = int(
-            max([v for v in vals + [base]
-                 if isinstance(v, (int, np.integer))] or [100]))
+        _observe_tree_candidates(cls, candidates, base_params, meta)
 
     #: per-tree work is large (level histograms over all samples), so
     #: even small grids amortise the extra dispatches
@@ -244,6 +286,10 @@ class RandomForestClassifierFamily(Family):
     keyed_compatible = False   # consumes binned "codes", not raw "X"
     dynamic_params = {"n_estimators": np.int32}
     _default_depth = 10
+    #: sklearn's own ctor default (RandomForest*: max_depth=None,
+    #: i.e. unbounded — the compiled cap always applies, so a default
+    #: forest search gets the fidelity warning)
+    _sklearn_default_depth = None
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -257,9 +303,12 @@ class RandomForestClassifierFamily(Family):
                 "max_estimators": None}
         return data, meta
 
-    observe_candidates = GradientBoostingRegressorFamily.observe_candidates
     min_sort_candidates = 4
     convergence_proxy = GradientBoostingRegressorFamily.convergence_proxy
+
+    @classmethod
+    def observe_candidates(cls, candidates, base_params, meta):
+        _observe_tree_candidates(cls, candidates, base_params, meta)
 
     @classmethod
     def _max_features(cls, static, d):
