@@ -73,10 +73,16 @@ class TpuConfig:
     sort_candidates: bool = True
     # fold fit + NaN-health + scoring into ONE compiled launch per chunk
     # (models never reach the host; XLA fuses the scoring epilogue into
-    # the solver).  Trade-off: the whole launch wall is charged to
-    # mean_fit_time and mean_score_time reads 0.0 — set False to restore
-    # separate fit/score launches with split timings.  Applies to the
-    # wide score path only (custom scorers keep separate launches).
+    # the solver).  Timing contract (sklearn _search.py fit/score time
+    # columns): the FIRST chunk of each compile group runs as separate
+    # fit/score launches, plus one extra WARM score launch that measures
+    # the steady-state score cost per task; later fused chunks attribute
+    # that measured cost out of their single-launch wall, so
+    # mean_score_time is an estimate calibrated per compile group, never
+    # a silent 0.0 (single-chunk groups simply run unfused and report
+    # exact split timings).  Set False to restore separate launches
+    # everywhere.  Applies to the wide score path only (custom scorers
+    # keep separate launches).
     fuse_fit_score: bool = True
 
     def resolve_devices(self):
