@@ -633,6 +633,26 @@ _BREADTH_LEGS = [
     ("keyed_1000models", leg_keyed, {}),
 ]
 
+#: scaled-down per-leg kwargs for the BENCH_FORCE_BREADTH=1 rehearsal
+#: (VERDICT r4 next #1): the EXACT child code path the chip-unwedge
+#: window will execute — headline then every breadth leg in sequence,
+#: shared persistent compile cache, superseding milestone emissions —
+#: at CPU-feasible shapes, so the rare TPU window runs pre-rehearsed
+#: code end-to-end and spends its wall on the chip, not on surprises.
+_BREADTH_TOY_KWARGS = {
+    "svc_mxu": dict(n=96, d=16, folds=2, max_iter=10,
+                    C_values=(1.0,), gamma_values=(0.01,)),
+    "svc_digits": dict(n_C=2, n_gamma=1, folds=2, n_rows=200),
+    "config3_rf_randomized": dict(n=400, d=8, n_classes=3, n_iter=2,
+                                  folds=2, est_lo=5, est_hi=8,
+                                  depth_lo=2, depth_hi=4),
+    "config4_gbr_grid": dict(n=300, d=4, folds=2,
+                             learning_rates=(0.1,), n_estimators=(10,)),
+    "config5_scaler_mlp": dict(hidden=8, max_iter=5, folds=2,
+                               alphas=(1e-3,)),
+    "keyed_1000models": dict(n_keys=8, rows=10, d=3),
+}
+
 
 def run_child(platform):
     import jax
@@ -649,12 +669,24 @@ def run_child(platform):
 
     import tempfile
     # fresh cache dir per run so the cold number really includes compile;
-    # the warm rerun then measures steady state WITH the persistent cache
-    cache_dir = tempfile.mkdtemp(prefix="sst_jax_cache_")
+    # the warm rerun then measures steady state WITH the persistent
+    # cache.  BENCH_CACHE_DIR overrides with a STABLE path (chip_watch
+    # sets it): if a chip window closes mid-bench, the next attempt
+    # reuses every compile already done — the labeled trade-off is that
+    # a reused cache makes the "cold" wall exclude compilation.
+    cache_dir = os.environ.get("BENCH_CACHE_DIR")
+    cache_reused = bool(cache_dir) and os.path.isdir(cache_dir) \
+        and bool(os.listdir(cache_dir))
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+    else:
+        cache_dir = tempfile.mkdtemp(prefix="sst_jax_cache_")
 
     detail, fits_per_sec, vs_baseline = leg_headline(
         cache_dir=cache_dir, n_candidates=n_candidates,
         measure_bf16=on_tpu)
+    if cache_reused:
+        detail["compile_cache_reused"] = True  # cold wall excludes compile
 
     label = "TPU" if on_tpu else "CPU-fallback"
     payload = {
@@ -675,8 +707,12 @@ def run_child(platform):
     # milestone 1: the headline number exists even if a later leg hangs
     _emit(payload)
 
-    if on_tpu:
+    force_breadth = os.environ.get("BENCH_FORCE_BREADTH") == "1"
+    if on_tpu or force_breadth:
         for key, fn, kwargs in _BREADTH_LEGS:
+            if not on_tpu:
+                # rehearsal mode: same sequence, CPU-feasible shapes
+                kwargs = {**kwargs, **_BREADTH_TOY_KWARGS.get(key, {})}
             try:
                 detail[key] = fn(cache_dir=cache_dir, **kwargs)
             except Exception as exc:  # noqa: BLE001 — breadth only

@@ -178,3 +178,33 @@ class TestOrchestratorAlwaysEmits:
         assert any(a.get("status") == "probe-timeout"
                    for a in out["tpu_probe_attempts"])
         assert wall < 480 + 30
+
+
+@pytest.mark.slow
+class TestFullSequenceRehearsal:
+    """VERDICT r4 next #1: the chip-unwedge window must run pre-rehearsed
+    code end-to-end.  BENCH_FORCE_BREADTH=1 makes the CPU child execute
+    the EXACT TPU sequence — headline, then every breadth leg, shared
+    compile cache, a superseding milestone emission per leg — at scaled
+    shapes; the final JSON line must carry every leg's numbers and no
+    per-leg error."""
+
+    def test_cpu_child_runs_all_breadth_legs(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=1500,
+            env=_wedged_env(BENCH_TOTAL_BUDGET_S="1320",
+                            BENCH_CPU_CANDIDATES="4",
+                            BENCH_FORCE_BREADTH="1"))
+        assert r.returncode == 0
+        out = _last_json_line(r.stdout)
+        assert out is not None, f"no parseable line in: {r.stdout!r}"
+        detail = out["detail"]
+        for key, _fn, _kw in bench._BREADTH_LEGS:
+            assert f"{key}_error" not in detail, detail[f"{key}_error"]
+            assert key in detail, f"{key} missing: breadth never ran"
+        # every leg produced a real throughput figure
+        for key, _fn, _kw in bench._BREADTH_LEGS:
+            leg = detail[key]
+            rate = leg.get("fits_per_sec", leg.get("models_per_sec"))
+            assert rate and math.isfinite(rate) and rate > 0, (key, leg)

@@ -79,6 +79,10 @@ def main():
             # TPU child use (almost) the whole watcher window
             env["BENCH_SKIP_CPU_SMOKE"] = "1"
             env["BENCH_TOTAL_BUDGET_S"] = "6900"
+            # stable compile cache: a window that closes mid-bench leaves
+            # its compiles for the next attempt (bench labels the reuse)
+            env["BENCH_CACHE_DIR"] = os.path.join(
+                REPO, ".bench_jax_cache")
             r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                                capture_output=True, text=True, timeout=7200,
                                env=env)
