@@ -31,8 +31,10 @@ _PINNED_GAP = {
     # half-ulp so a rounded-equal rerun can't trip the pin
     "svc_rbf_CxG": 0.00401,
     "svr_rbf_CxEps": 0.0,
-    # r5 SVR tol-exit rerun measured this mode exact (was 0.00011)
-    "svc_platt_logloss": 0.0,
+    # measured 0.00008 with the oracle's internal Platt CV seeded
+    # (random_state=0); the train-fold-vs-internal-CV calibration
+    # deviation keeps this mode within-noise, not exact
+    "svc_platt_logloss": 0.00008,
     "linear_svc_C": 0.0,
 }
 _PIN_SLACK = 1e-6   # float round-off on a deterministic rerun
@@ -124,13 +126,17 @@ class TestBestCandidateAgreement:
         m = y < 2
         Xs, ys = X[m][:300], y[m][:300]
         grid = {"C": [0.1, 1.0, 10.0]}
+        # random_state seeds libsvm's INTERNAL 5-fold Platt CV on the
+        # host side — without it the oracle's probabilities (and this
+        # mode's gap) vary with global RNG state, so the pinned gap
+        # flapped between in-suite and standalone runs (r5 full gate)
         ours = sst.GridSearchCV(
-            SVC(probability=True), grid, cv=3, scoring="neg_log_loss",
-            backend="tpu").fit(Xs, ys)
+            SVC(probability=True, random_state=0), grid, cv=3,
+            scoring="neg_log_loss", backend="tpu").fit(Xs, ys)
         assert ours.search_report["backend"] == "tpu"
         theirs = sst.GridSearchCV(
-            SVC(probability=True), grid, cv=3, scoring="neg_log_loss",
-            backend="host").fit(Xs, ys)
+            SVC(probability=True, random_state=0), grid, cv=3,
+            scoring="neg_log_loss", backend="host").fit(Xs, ys)
         np.testing.assert_allclose(
             ours.cv_results_["mean_test_score"],
             theirs.cv_results_["mean_test_score"], atol=0.15)
