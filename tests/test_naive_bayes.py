@@ -1,0 +1,157 @@
+"""Naive Bayes family tests vs sklearn oracles.
+
+Closed-form fits, so parity is at float tolerance (scores typically
+IDENTICAL), not the accuracy-level parity the iterative families get.
+"""
+
+import numpy as np
+import pytest
+from sklearn.model_selection import GridSearchCV as SkGS
+from sklearn.naive_bayes import BernoulliNB, GaussianNB, MultinomialNB
+
+import spark_sklearn_tpu as sst
+
+
+def _mad(ours, theirs):
+    return float(np.max(np.abs(ours.cv_results_["mean_test_score"]
+                               - theirs.cv_results_["mean_test_score"])))
+
+
+class TestGaussianNB:
+    def test_var_smoothing_grid_oracle(self, digits):
+        X, y = digits
+        grid = {"var_smoothing": [1e-9, 1e-7, 1e-5, 1e-3]}
+        ours = sst.GridSearchCV(GaussianNB(), grid, cv=3,
+                                backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(GaussianNB(), grid, cv=3).fit(X, y)
+        assert _mad(ours, theirs) < 1e-6
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_proba_scoring_and_priors(self, digits):
+        X, y = digits
+        m = y < 3
+        Xs, ys = X[m][:240], y[m][:240]
+        grid = {"var_smoothing": [1e-9, 1e-6]}
+        est = GaussianNB(priors=[0.5, 0.3, 0.2])
+        ours = sst.GridSearchCV(est, grid, cv=3, scoring="neg_log_loss",
+                                backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(est, grid, cv=3, scoring="neg_log_loss").fit(Xs, ys)
+        assert _mad(ours, theirs) < 1e-4
+
+    def test_sample_weight_oracle(self, digits):
+        X, y = digits
+        rng = np.random.default_rng(0)
+        sw = rng.uniform(0.2, 2.0, len(y))
+        grid = {"var_smoothing": [1e-9, 1e-6]}
+        ours = sst.GridSearchCV(GaussianNB(), grid, cv=3,
+                                backend="tpu").fit(X, y, sample_weight=sw)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = sst.GridSearchCV(GaussianNB(), grid, cv=3,
+                                  backend="host").fit(X, y,
+                                                      sample_weight=sw)
+        assert _mad(ours, theirs) < 1e-6
+
+    def test_unscaled_features_no_cancellation(self):
+        """Regression (r5 review): E[x^2]-E[x]^2 on raw X cancels
+        catastrophically in f32 when |mean| >> std; the fit shifts by
+        the fold grand mean first, so unscaled inputs match sklearn."""
+        rng = np.random.default_rng(0)
+        X = (1000.0 + 0.1 * rng.normal(size=(300, 6))).astype(np.float32)
+        y = (X[:, 0] + 0.05 * rng.normal(size=300) > 1000.0).astype(int)
+        grid = {"var_smoothing": [1e-9]}
+        ours = sst.GridSearchCV(GaussianNB(), grid, cv=3,
+                                backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(GaussianNB(), grid, cv=3).fit(X, y)
+        assert _mad(ours, theirs) < 5e-3
+        assert abs(ours.best_score_ - theirs.best_score_) < 5e-3
+
+    def test_bad_priors_raise_sklearn_messages(self, digits):
+        X, y = digits
+        m = y < 3
+        Xs, ys = X[m][:150], y[m][:150]
+        with pytest.raises(ValueError, match="Number of priors"):
+            sst.GridSearchCV(GaussianNB(priors=[0.5, 0.5]),
+                             {"var_smoothing": [1e-9]}, cv=3,
+                             backend="tpu").fit(Xs, ys)
+        with pytest.raises(ValueError, match="sum of the priors"):
+            sst.GridSearchCV(GaussianNB(priors=[0.5, 0.4, 0.3]),
+                             {"var_smoothing": [1e-9]}, cv=3,
+                             backend="tpu").fit(Xs, ys)
+
+
+class TestDiscreteNB:
+    def test_multinomial_alpha_grid_oracle(self, digits):
+        X, y = digits      # scaled [0,1] counts still valid (nonneg)
+        grid = {"alpha": [0.01, 0.1, 1.0, 10.0]}
+        ours = sst.GridSearchCV(MultinomialNB(), grid, cv=3,
+                                backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(MultinomialNB(), grid, cv=3).fit(X, y)
+        assert _mad(ours, theirs) < 1e-6
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_multinomial_fit_prior_false(self, digits):
+        X, y = digits
+        est = MultinomialNB(fit_prior=False)
+        grid = {"alpha": [0.5, 2.0]}
+        ours = sst.GridSearchCV(est, grid, cv=3, backend="tpu").fit(X, y)
+        theirs = SkGS(est, grid, cv=3).fit(X, y)
+        assert _mad(ours, theirs) < 1e-6
+
+    def test_multinomial_negative_x_matches_sklearn(self, digits):
+        X, y = digits
+        with pytest.raises(ValueError, match="Negative values"):
+            sst.GridSearchCV(MultinomialNB(), {"alpha": [1.0]}, cv=3,
+                             backend="tpu").fit(X - 0.5, y)
+
+    def test_bernoulli_binarize_oracle(self, digits):
+        X, y = digits
+        for est in (BernoulliNB(binarize=0.3), BernoulliNB()):
+            grid = {"alpha": [0.1, 1.0, 10.0]}
+            ours = sst.GridSearchCV(est, grid, cv=3,
+                                    backend="tpu").fit(X, y)
+            assert ours.search_report["backend"] == "tpu"
+            theirs = SkGS(est, grid, cv=3).fit(X, y)
+            assert _mad(ours, theirs) < 1e-6
+
+    def test_bernoulli_proba_parity(self, digits):
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:200], y[m][:200]
+        grid = {"alpha": [1.0]}
+        ours = sst.GridSearchCV(BernoulliNB(), grid, cv=3,
+                                scoring="roc_auc", backend="tpu").fit(Xs, ys)
+        theirs = SkGS(BernoulliNB(), grid, cv=3,
+                      scoring="roc_auc").fit(Xs, ys)
+        assert _mad(ours, theirs) < 1e-5
+
+
+class TestKeyedNB:
+    def test_keyed_gaussian_nb_fleet(self, digits):
+        """NB slots into the keyed per-key fleet (closed-form fits vmap
+        perfectly)."""
+        import pandas as pd
+        X, y = digits
+        df = pd.DataFrame({
+            "k": np.repeat(["a", "b", "c"], 100),
+            "x": [row for row in X[:300]],
+            "y": y[:300],
+        })
+        ke = sst.KeyedEstimator(sklearnEstimator=GaussianNB(),
+                                keyCols=["k"], xCol="x", yCol="y")
+        km = ke.fit(df)
+        out = km.transform(df)
+        assert len(km.keyedModels) == 3
+        # per-key models predict their own training data well
+        acc = float(np.mean(out["output"].values == df["y"].values))
+        assert acc > 0.8
+
+    def test_bad_class_prior_raises_sklearn_message(self, digits):
+        X, y = digits
+        with pytest.raises(ValueError, match="Number of priors"):
+            sst.GridSearchCV(MultinomialNB(class_prior=[0.5, 0.5]),
+                             {"alpha": [1.0]}, cv=3,
+                             backend="tpu").fit(X, y)
