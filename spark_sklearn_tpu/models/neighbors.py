@@ -91,6 +91,8 @@ class KNeighborsClassifierFamily(Family):
     name = "kneighbors_classifier"
     is_classifier = True
     dynamic_params = {"n_neighbors": np.int32}
+    #: sklearn's vote tables are float64 regardless of X
+    proba_dtype_rule = "float64"
     #: sklearn's KNeighbors fit has no sample_weight parameter
     accepts_sample_weight = False
     keyed_compatible = False

@@ -461,6 +461,9 @@ class SVCFamily(Family):
     name = "svc"
     is_classifier = True
     dynamic_params = {"C": np.float32, "gamma": np.float32}
+    #: libsvm computes probabilities in f64 whatever the input dtype, so
+    #: sklearn's log_loss clips them at f64 eps (engine: logloss_clip_eps)
+    proba_dtype_rule = "float64"
     #: the per-candidate scalar the dual consumes (NuSVC swaps in "nu")
     primary_param = "C"
     primary_default = 1.0

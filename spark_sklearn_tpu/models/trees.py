@@ -195,6 +195,8 @@ class GradientBoostingRegressorFamily(Family):
 class GradientBoostingClassifierFamily(GradientBoostingRegressorFamily):
     name = "gradient_boosting_classifier"
     is_classifier = True
+    #: sklearn's staged decision/proba arrays are float64 regardless of X
+    proba_dtype_rule = "float64"
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -284,6 +286,8 @@ class RandomForestClassifierFamily(Family):
     name = "random_forest_classifier"
     is_classifier = True
     keyed_compatible = False   # consumes binned "codes", not raw "X"
+    #: sklearn's vote-averaged probas are float64 regardless of X
+    proba_dtype_rule = "float64"
     dynamic_params = {"n_estimators": np.int32}
     _default_depth = 10
     #: sklearn's own ctor default (RandomForest*: max_depth=None,

@@ -813,8 +813,20 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
 
+        # sklearn's log_loss clips probas at THEIR dtype's machine eps
+        # (_classification.py _log_loss), and the sklearn twin's proba
+        # dtype is a per-family fact: libsvm/forests/KNN always produce
+        # f64 probas, while LogReg/MLP/NB preserve the user's X dtype —
+        # the compiled scorer must clip where the oracle clips, not
+        # where the engine's compute dtype lands (see scorers.py
+        # _neg_log_loss)
+        proba_rule = getattr(family, "proba_dtype_rule", "input")
+        oracle_proba_dt = np.float64 if (
+            proba_rule == "float64"
+            or np.asarray(X).dtype == np.float64) else np.float32
         X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
+        meta["logloss_clip_eps"] = float(np.finfo(oracle_proba_dt).eps)
         if self.scoring is not None:
             if "y" not in data:
                 raise ValueError(

@@ -82,7 +82,16 @@ def _accuracy(v, y, w, meta):
 @_scorer("proba")
 def _neg_log_loss(v, y, w, meta):
     proba = v["proba"]
-    p = jnp.clip(proba[jnp.arange(proba.shape[0]), y], 1e-15, 1.0)
+    # sklearn's log_loss clips to [eps, 1-eps] at the PROBA DTYPE's
+    # machine eps (_classification.py _log_loss) — and the dtype that
+    # matters is the ORACLE's (libsvm/forest/KNN probas are always f64;
+    # LogReg/MLP/NB preserve the user's X dtype), which the engine
+    # resolves per family into meta["logloss_clip_eps"].  An f32-proba
+    # oracle charges a confidently-wrong sample -log(1.19e-7) ~ 15.9
+    # where an f64 one charges ~36; with saturating families (NB) that
+    # difference dominated the whole score.
+    eps = meta.get("logloss_clip_eps") or float(np.finfo(np.float32).eps)
+    p = jnp.clip(proba[jnp.arange(proba.shape[0]), y], eps, 1.0 - eps)
     return -(jnp.sum(w * -jnp.log(p)) / _wsum(w))
 
 
