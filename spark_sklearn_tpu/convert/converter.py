@@ -183,6 +183,9 @@ class Converter:
         if family is not None and family.name in ("kneighbors_classifier",
                                                   "kneighbors_regressor"):
             return self._knn_to_tpu(sklearn_model, family)
+        if family is not None and family.name in (
+                "gaussian_nb", "multinomial_nb", "bernoulli_nb"):
+            return self._nb_to_tpu(sklearn_model, family)
         if family is not None and family.name in ("mlp_classifier",
                                                   "mlp_regressor"):
             return self._mlp_to_tpu(sklearn_model, family)
@@ -198,7 +201,8 @@ class Converter:
                 f"LogisticRegression/LinearRegression only; this one also "
                 f"covers Ridge/ElasticNet/Lasso, SVC/NuSVC, "
                 f"MLPClassifier/MLPRegressor, RandomForest/"
-                f"GradientBoosting ensembles, KMeans, KNeighbors and PCA)")
+                f"GradientBoosting ensembles, KMeans, KNeighbors, PCA "
+                f"and the naive Bayes families)")
         if not hasattr(sklearn_model, "coef_"):
             raise ValueError("model must be fitted (missing coef_)")
         static = family.extract_params(sklearn_model)
@@ -377,6 +381,44 @@ class Converter:
         model = {"X": jnp.asarray(fit_X), "y": y}
         return TpuModel(shim, model, static, meta)
 
+    def _nb_to_tpu(self, est, family) -> TpuModel:
+        """Fitted sklearn naive-Bayes -> TpuModel over the family's own
+        pytree layout (models/naive_bayes.py): Gaussian carries
+        theta/var/log-prior, the discrete families their smoothed
+        feature log-probabilities — the complete fitted state, so
+        device predict/proba match sklearn at float tolerance."""
+        import jax.numpy as jnp
+        from sklearn.utils.validation import check_is_fitted
+
+        check_is_fitted(est)
+        static = dict(est.get_params(deep=False))
+        classes = np.asarray(est.classes_)
+        meta: Dict[str, Any] = {
+            "n_classes": len(classes), "classes": classes,
+            "n_features": int(est.n_features_in_)}
+        if family.name == "gaussian_nb":
+            model = {
+                "theta": jnp.asarray(est.theta_, jnp.float32),
+                "var": jnp.asarray(est.var_, jnp.float32),
+                "log_prior": jnp.asarray(
+                    np.log(np.maximum(est.class_prior_, 0.0)),
+                    jnp.float32)}
+        else:
+            model = {
+                "feature_log_prob": jnp.asarray(
+                    est.feature_log_prob_, jnp.float32),
+                "class_log_prior": jnp.asarray(
+                    est.class_log_prior_, jnp.float32),
+                "class_count": jnp.asarray(
+                    est.class_count_, jnp.float32)}
+            if family.name == "bernoulli_nb":
+                # the family's jll needs log(1-p); rebuild it from the
+                # stored log p (exact: both came from the same counts)
+                log_p = np.asarray(est.feature_log_prob_, np.float64)
+                model["log_neg_prob"] = jnp.asarray(
+                    np.log1p(-np.exp(log_p)), jnp.float32)
+        return TpuModel(family, model, static, meta)
+
     def _pca_to_tpu(self, est) -> TpuModel:
         """Fitted sklearn PCA -> TpuModel over PCAStep's state pytree
         ({mean, components, var}); transform reuses the compiled step
@@ -472,6 +514,12 @@ class Converter:
         if cls is None and family.name == "kmeans":
             from sklearn.cluster import KMeans
             cls = KMeans
+        if cls is None and family.name in (
+                "gaussian_nb", "multinomial_nb", "bernoulli_nb"):
+            from sklearn import naive_bayes as nb
+            cls = {"gaussian_nb": nb.GaussianNB,
+                   "multinomial_nb": nb.MultinomialNB,
+                   "bernoulli_nb": nb.BernoulliNB}[family.name]
         if cls is None:
             raise ValueError(f"no sklearn counterpart for {family.name}")
         valid = cls().get_params()

@@ -821,9 +821,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # where the engine's compute dtype lands (see scorers.py
         # _neg_log_loss)
         proba_rule = getattr(family, "proba_dtype_rule", "input")
+        # getattr, not np.asarray: sparse X would become a 0-d object
+        # array (and lists would pay a full copy just to read a dtype)
         oracle_proba_dt = np.float64 if (
             proba_rule == "float64"
-            or np.asarray(X).dtype == np.float64) else np.float32
+            or getattr(X, "dtype", None) == np.float64) else np.float32
         X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
         meta["logloss_clip_eps"] = float(np.finfo(oracle_proba_dt).eps)

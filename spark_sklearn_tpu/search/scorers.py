@@ -90,7 +90,10 @@ def _neg_log_loss(v, y, w, meta):
     # oracle charges a confidently-wrong sample -log(1.19e-7) ~ 15.9
     # where an f64 one charges ~36; with saturating families (NB) that
     # difference dominated the whole score.
-    eps = meta.get("logloss_clip_eps") or float(np.finfo(np.float32).eps)
+    # fallback for direct/legacy callers whose meta came straight from
+    # prepare_data: f64 eps, the pre-round-5 behavior (the engine path
+    # always sets the per-family key)
+    eps = meta.get("logloss_clip_eps") or float(np.finfo(np.float64).eps)
     p = jnp.clip(proba[jnp.arange(proba.shape[0]), y], eps, 1.0 - eps)
     return -(jnp.sum(w * -jnp.log(p)) / _wsum(w))
 

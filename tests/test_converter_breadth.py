@@ -353,3 +353,46 @@ class TestPCAConversion:
         sk = KNeighborsRegressor().fit(X[:100], Y2[:100])
         with pytest.raises(ValueError, match="multi-output"):
             sst.Converter().toTPU(sk)
+
+
+class TestNaiveBayesConversion:
+    """NB fitted-state round trips (round 5 — every compiled family
+    converts)."""
+
+    def test_gaussian_nb_round_trip(self, digits):
+        from sklearn.naive_bayes import GaussianNB
+        X, y = digits
+        sk = GaussianNB().fit(X[:300], y[:300])
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(X[300:400]) == sk.predict(X[300:400])).all()
+        np.testing.assert_allclose(
+            tm.predict_proba(X[300:400]), sk.predict_proba(X[300:400]),
+            atol=1e-4)
+        back = sst.Converter().toSKLearn(tm)
+        assert isinstance(back, GaussianNB)
+        assert (back.predict(X[300:400]) == sk.predict(X[300:400])).all()
+        np.testing.assert_allclose(back.theta_, sk.theta_, atol=1e-6)
+
+    def test_multinomial_nb_round_trip(self, digits):
+        from sklearn.naive_bayes import MultinomialNB
+        X, y = digits
+        sk = MultinomialNB(alpha=0.5).fit(X[:300], y[:300])
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(X[300:400]) == sk.predict(X[300:400])).all()
+        back = sst.Converter().toSKLearn(tm)
+        assert isinstance(back, MultinomialNB)
+        assert back.get_params()["alpha"] == 0.5
+        agree = np.mean(back.predict(X[300:400]) == sk.predict(X[300:400]))
+        assert agree >= 0.99   # f32-quantized log-probs may flip a tie
+
+    def test_bernoulli_nb_round_trip(self, digits):
+        from sklearn.naive_bayes import BernoulliNB
+        X, y = digits
+        sk = BernoulliNB(binarize=0.3).fit(X[:300], y[:300])
+        tm = sst.Converter().toTPU(sk)
+        agree = np.mean(tm.predict(X[300:400]) == sk.predict(X[300:400]))
+        assert agree >= 0.99   # f32 log-prob ties may flip a sample
+        back = sst.Converter().toSKLearn(tm)
+        assert isinstance(back, BernoulliNB)
+        agree = np.mean(back.predict(X[300:400]) == sk.predict(X[300:400]))
+        assert agree >= 0.99   # same tie exposure as the forward half
