@@ -184,7 +184,8 @@ class Converter:
                                                   "kneighbors_regressor"):
             return self._knn_to_tpu(sklearn_model, family)
         if family is not None and family.name in (
-                "gaussian_nb", "multinomial_nb", "bernoulli_nb"):
+                "gaussian_nb", "multinomial_nb", "bernoulli_nb",
+                "complement_nb"):
             return self._nb_to_tpu(sklearn_model, family)
         if family is not None and family.name in ("mlp_classifier",
                                                   "mlp_regressor"):
@@ -515,11 +516,13 @@ class Converter:
             from sklearn.cluster import KMeans
             cls = KMeans
         if cls is None and family.name in (
-                "gaussian_nb", "multinomial_nb", "bernoulli_nb"):
+                "gaussian_nb", "multinomial_nb", "bernoulli_nb",
+                "complement_nb"):
             from sklearn import naive_bayes as nb
             cls = {"gaussian_nb": nb.GaussianNB,
                    "multinomial_nb": nb.MultinomialNB,
-                   "bernoulli_nb": nb.BernoulliNB}[family.name]
+                   "bernoulli_nb": nb.BernoulliNB,
+                   "complement_nb": nb.ComplementNB}[family.name]
         if cls is None:
             raise ValueError(f"no sklearn counterpart for {family.name}")
         valid = cls().get_params()

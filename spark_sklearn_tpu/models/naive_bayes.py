@@ -13,7 +13,10 @@ parity with sklearn is at float tolerance, not accuracy level:
   - MultinomialNB: smoothed per-class feature count ratios
     (feature_log_prob = log(N_cf + a) - log(N_c + a*d));
   - BernoulliNB: binarized count ratios with the two-sided smoothing
-    (p = (N_cf + a) / (N_c + 2a)) and the log(1-p) offset term.
+    (p = (N_cf + a) / (N_c + 2a)) and the log(1-p) offset term;
+  - ComplementNB: each class weighted by every OTHER class's counts
+    (comp_count = feature_all + a - N_cf, negated log ratios, optional
+    weight normalisation), prior only in the single-class case.
 
 The per-class sums are one (k, n) @ (n, d) matmul per task; XLA batches
 tasks on the vmap axis.  sample_weight and class priors follow sklearn's
@@ -201,6 +204,9 @@ class MultinomialNBFamily(Family):
                 raise ValueError(
                     "Number of priors must match number of classes.")
 
+    #: sklearn's check_non_negative names the concrete class
+    _sklearn_display = "MultinomialNB"
+
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
         if np.min(X) < 0:
@@ -208,8 +214,8 @@ class MultinomialNBFamily(Family):
             # launch (the engine's designed fallback runs sklearn, which
             # raises the same for every candidate)
             raise ValueError(
-                "Negative values in data passed to MultinomialNB "
-                "(input X)")
+                f"Negative values in data passed to "
+                f"{cls._sklearn_display} (input X)")
         return _prep_classifier_data(X, y, dtype)
 
     @classmethod
@@ -250,6 +256,41 @@ class MultinomialNBFamily(Family):
                 "class_count_": np.asarray(model["class_count"]),
                 "classes_": meta["classes"],
                 "n_features_in_": meta["n_features"]}
+
+
+class ComplementNBFamily(MultinomialNBFamily):
+    """Complement NB (Rennie et al. 2003, sklearn ComplementNB): each
+    class's weights come from the counts of every OTHER class —
+    comp_count = feature_all + alpha - feature_count — so imbalanced
+    text corpora don't drown minority classes.  The class prior only
+    enters the degenerate single-class case, exactly like sklearn."""
+
+    name = "complement_nb"
+    _sklearn_display = "ComplementNB"
+
+    @classmethod
+    def fit(cls, dynamic, static, data, train_w, meta):
+        X, y1h = data["X"], data["y1h"]
+        k = meta["n_classes"]
+        a = cls._alpha(dynamic, static, X.dtype)
+        counts, _wy, fc = _class_sums(y1h, train_w, X)
+        comp = jnp.sum(fc, axis=0)[None, :] + a - fc          # (k, d)
+        logged = jnp.log(comp / jnp.sum(comp, axis=1, keepdims=True))
+        if static.get("norm", False):
+            flp = logged / jnp.sum(logged, axis=1, keepdims=True)
+        else:
+            flp = -logged
+        return {"feature_log_prob": flp,
+                "class_log_prior": _log_prior(counts, static, k, X.dtype),
+                "class_count": counts}
+
+    @classmethod
+    def _jll(cls, model, X):
+        jll = X @ model["feature_log_prob"].T
+        # sklearn adds the prior only in the single-class degenerate case
+        if model["class_log_prior"].shape[0] == 1:
+            jll = jll + model["class_log_prior"][None, :]
+        return jll
 
 
 class BernoulliNBFamily(MultinomialNBFamily):
@@ -318,6 +359,10 @@ register_family(
 register_family(
     MultinomialNBFamily,
     "sklearn.naive_bayes.MultinomialNB",
+)
+register_family(
+    ComplementNBFamily,
+    "sklearn.naive_bayes.ComplementNB",
 )
 register_family(
     BernoulliNBFamily,

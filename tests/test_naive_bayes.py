@@ -155,3 +155,34 @@ class TestKeyedNB:
             sst.GridSearchCV(MultinomialNB(class_prior=[0.5, 0.5]),
                              {"alpha": [1.0]}, cv=3,
                              backend="tpu").fit(X, y)
+
+
+class TestComplementNB:
+    def test_alpha_grid_oracle(self, digits):
+        from sklearn.naive_bayes import ComplementNB
+        X, y = digits
+        grid = {"alpha": [0.1, 1.0, 10.0]}
+        for est in (ComplementNB(), ComplementNB(norm=True)):
+            ours = sst.GridSearchCV(est, grid, cv=3,
+                                    backend="tpu").fit(X, y)
+            assert ours.search_report["backend"] == "tpu"
+            theirs = SkGS(est, grid, cv=3).fit(X, y)
+            assert _mad(ours, theirs) < 1e-6, est
+
+    def test_negative_x_names_complement(self, digits):
+        from sklearn.naive_bayes import ComplementNB
+        X, y = digits
+        with pytest.raises(ValueError, match="ComplementNB"):
+            sst.GridSearchCV(ComplementNB(), {"alpha": [1.0]}, cv=3,
+                             backend="tpu").fit(X - 0.5, y)
+
+    def test_round_trip(self, digits):
+        from sklearn.naive_bayes import ComplementNB
+        X, y = digits
+        sk = ComplementNB(alpha=0.5).fit(X[:300], y[:300])
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(X[300:400]) == sk.predict(X[300:400])).all()
+        back = sst.Converter().toSKLearn(tm)
+        assert isinstance(back, ComplementNB)
+        agree = np.mean(back.predict(X[300:400]) == sk.predict(X[300:400]))
+        assert agree >= 0.99
