@@ -39,9 +39,19 @@ class TpuModel:
         self.static = static
         self.meta = meta
 
-    def predict(self, X):
+    def _device_X(self, X):
         import jax.numpy as jnp
-        X = jnp.asarray(np.asarray(X))
+        Xh = np.asarray(X)
+        check = getattr(self.family, "check_predict_X", None)
+        if check is not None:
+            # families with input constraints sklearn enforces at
+            # predict (e.g. CategoricalNB's category range) raise the
+            # same errors host-side instead of silently masking
+            check(Xh, self.meta)
+        return jnp.asarray(Xh)
+
+    def predict(self, X):
+        X = self._device_X(X)
         pred = self.family.predict(self.model, self.static, X, self.meta)
         pred = np.asarray(pred)
         if self.family.is_classifier:
@@ -49,14 +59,12 @@ class TpuModel:
         return pred
 
     def decision_function(self, X):
-        import jax.numpy as jnp
-        X = jnp.asarray(np.asarray(X))
+        X = self._device_X(X)
         return np.asarray(self.family.decision(
             self.model, self.static, X, self.meta))
 
     def predict_proba(self, X):
-        import jax.numpy as jnp
-        X = jnp.asarray(np.asarray(X))
+        X = self._device_X(X)
         return np.asarray(self.family.predict_proba(
             self.model, self.static, X, self.meta))
 
@@ -185,7 +193,7 @@ class Converter:
             return self._knn_to_tpu(sklearn_model, family)
         if family is not None and family.name in (
                 "gaussian_nb", "multinomial_nb", "bernoulli_nb",
-                "complement_nb"):
+                "complement_nb", "categorical_nb"):
             return self._nb_to_tpu(sklearn_model, family)
         if family is not None and family.name in ("mlp_classifier",
                                                   "mlp_regressor"):
@@ -404,6 +412,25 @@ class Converter:
                 "log_prior": jnp.asarray(
                     np.log(np.maximum(est.class_prior_, 0.0)),
                     jnp.float32)}
+        elif family.name == "categorical_nb":
+            # sklearn keeps a ragged per-feature list; pad to the max
+            # category count (padded cells are never gathered — codes
+            # stay below each feature's own n_categories_)
+            ncat = np.asarray(est.n_categories_, np.int64)
+            k, d, C = len(classes), len(ncat), int(ncat.max())
+            # zero-pad (NOT -inf): the jll einsum multiplies the one-hot
+            # by flp, and 0 * -inf would poison it with NaN; padded
+            # cells contribute 0 because the one-hot never lights them
+            flp = np.zeros((k, d, C), np.float32)
+            for i, f in enumerate(est.feature_log_prob_):
+                flp[:, i, :f.shape[1]] = f
+            model = {
+                "feature_log_prob": jnp.asarray(flp),
+                "class_log_prior": jnp.asarray(
+                    est.class_log_prior_, jnp.float32),
+                "class_count": jnp.asarray(
+                    est.class_count_, jnp.float32)}
+            meta["n_categories"] = ncat
         else:
             model = {
                 "feature_log_prob": jnp.asarray(
@@ -517,12 +544,13 @@ class Converter:
             cls = KMeans
         if cls is None and family.name in (
                 "gaussian_nb", "multinomial_nb", "bernoulli_nb",
-                "complement_nb"):
+                "complement_nb", "categorical_nb"):
             from sklearn import naive_bayes as nb
             cls = {"gaussian_nb": nb.GaussianNB,
                    "multinomial_nb": nb.MultinomialNB,
                    "bernoulli_nb": nb.BernoulliNB,
-                   "complement_nb": nb.ComplementNB}[family.name]
+                   "complement_nb": nb.ComplementNB,
+                   "categorical_nb": nb.CategoricalNB}[family.name]
         if cls is None:
             raise ValueError(f"no sklearn counterpart for {family.name}")
         valid = cls().get_params()

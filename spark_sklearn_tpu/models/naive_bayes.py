@@ -16,7 +16,10 @@ parity with sklearn is at float tolerance, not accuracy level:
     (p = (N_cf + a) / (N_c + 2a)) and the log(1-p) offset term;
   - ComplementNB: each class weighted by every OTHER class's counts
     (comp_count = feature_all + a - N_cf, negated log ratios, optional
-    weight normalisation), prior only in the single-class case.
+    weight normalisation), prior only in the single-class case;
+  - CategoricalNB: per-(feature, category) counts padded to the global
+    max category count — one one-hot einsum to count, one to score
+    (sklearn's ragged per-feature lists rebuilt on conversion).
 
 The per-class sums are one (k, n) @ (n, d) matmul per task; XLA batches
 tasks on the vmap axis.  sample_weight and class priors follow sklearn's
@@ -36,12 +39,15 @@ from spark_sklearn_tpu.models.base import Family, encode_labels, register_family
 _EPS = 1e-10
 
 
-def _prep_classifier_data(X, y, dtype):
-    """Shared prepare_data body: encoded labels + one-hot + meta (the
-    three families differ only in Multinomial's negativity check)."""
+def _prep_classifier_data(X, y, dtype, x_override=None):
+    """Shared prepare_data body: encoded labels + one-hot + meta.
+    `x_override` supplies a pre-built device array for data["X"]
+    (CategoricalNB's int codes) so no dead float copy of X is made."""
     classes, y_enc = encode_labels(y)
     k = len(classes)
-    data = {"X": np.ascontiguousarray(X, dtype=dtype), "y": y_enc,
+    data = {"X": (np.ascontiguousarray(X, dtype=dtype)
+                  if x_override is None else x_override),
+            "y": y_enc,
             "y1h": np.eye(k, dtype=dtype)[y_enc]}
     meta = {"n_classes": int(k), "classes": classes,
             "n_features": int(X.shape[1])}
@@ -352,6 +358,134 @@ class BernoulliNBFamily(MultinomialNBFamily):
         return jll
 
 
+class CategoricalNBFamily(MultinomialNBFamily):
+    """Categorical NB: per-(feature, category) counts.  sklearn keeps a
+    ragged list of (k, n_categories_i) arrays; the compiled form pads to
+    the global max category count — counts are ONE
+    einsum('nk,ndc->kdc') over the one-hot codes, and the jll is ONE
+    einsum('ndc,kdc->nk') contraction per task.
+
+    Documented deviation: n_categories_ is resolved from the FULL X of
+    the search (static shapes), where sklearn's per-fit resolution uses
+    only the train fold — in CV that makes sklearn RAISE at score time
+    when a test fold holds a category its train fold never saw; the
+    compiled path behaves as if `min_categories` covered the full data,
+    which is sklearn's own documented fix for that crash."""
+
+    name = "categorical_nb"
+    _sklearn_display = "CategoricalNB"
+    #: consumes int codes + search-resolved n_categories meta, which the
+    #: keyed fleet's generic build_fit_data cannot synthesise (same
+    #: opt-out as the binned tree families) — keyed CategoricalNB runs
+    #: per-key sklearn on the host instead of silently mis-smoothing
+    keyed_compatible = False
+
+    @classmethod
+    def prepare_data(cls, X, y, dtype=np.float32):
+        Xa = np.asarray(X)
+        if np.issubdtype(Xa.dtype, np.floating) and \
+                not np.isfinite(Xa).all():
+            # NaN passes a min()<0 test (NaN comparisons are False) and
+            # astype(int32) would turn it into garbage codes
+            raise ValueError("Input X contains NaN.")
+        if np.min(Xa) < 0:
+            raise ValueError(
+                "Negative values in data passed to CategoricalNB "
+                "(input X)")
+        codes = np.ascontiguousarray(Xa, dtype=np.int32)
+        data, meta = _prep_classifier_data(codes, y, dtype,
+                                           x_override=codes)
+        meta["n_categories"] = (codes.max(axis=0) + 1).astype(np.int64)
+        return data, meta
+
+    @classmethod
+    def observe_candidates(cls, candidates, base_params, meta):
+        """Resolve min_categories into the padded category counts
+        (sklearn _validate_n_categories, host-side)."""
+        super().observe_candidates(candidates, base_params, meta)
+        mc = base_params.get("min_categories")
+        if any(c.get("min_categories", mc) is not mc for c in candidates):
+            raise ValueError(
+                "min_categories changes the compiled shapes; grid it "
+                "with backend='host'")
+        if mc is not None and "n_categories" in meta:
+            mc_arr = np.asarray(mc)
+            if not np.issubdtype(mc_arr.dtype, np.signedinteger):
+                raise ValueError(
+                    "'min_categories' should have integral type. Got "
+                    f"{mc_arr.dtype} instead.")
+            d = len(meta["n_categories"])
+            # shape check BEFORE np.maximum: a (2,) array must get
+            # sklearn's message, not a raw broadcast error, and a
+            # broadcastable-but-wrong (1,) must not slip through
+            if mc_arr.ndim > 0 and mc_arr.shape != (d,):
+                raise ValueError(
+                    f"'min_categories' should have shape ({d},) when "
+                    f"an array-like is provided. Got {mc_arr.shape} "
+                    f"instead.")
+            meta["n_categories"] = np.maximum(
+                meta["n_categories"], mc_arr).astype(np.int64)
+
+    @classmethod
+    def fit(cls, dynamic, static, data, train_w, meta):
+        codes, y1h = data["X"], data["y1h"]
+        k = meta["n_classes"]
+        ncat = jnp.asarray(meta["n_categories"])             # (d,)
+        C = int(np.max(meta["n_categories"]))
+        a = cls._alpha(dynamic, static, y1h.dtype)
+        wy = y1h * train_w[:, None]                          # (n, k)
+        counts = jnp.sum(wy, axis=0)                         # (k,)
+        oh = jax.nn.one_hot(codes, C, dtype=y1h.dtype)       # (n, d, C)
+        cat = jnp.einsum("nk,ndc->kdc", wy, oh)              # (k, d, C)
+        # per-feature denominator: total + alpha * n_categories_i
+        # (padded columns beyond a feature's category count hold zero
+        # counts and are never gathered — codes stay < n_categories_i)
+        denom = jnp.sum(cat, axis=2) + a * ncat[None, :].astype(y1h.dtype)
+        flp = jnp.log(cat + a) - jnp.log(denom)[:, :, None]
+        return {"feature_log_prob": flp,                     # (k, d, C)
+                "class_log_prior": _log_prior(counts, static, k,
+                                              y1h.dtype),
+                "class_count": counts}
+
+    @classmethod
+    def _jll(cls, model, X):
+        flp = model["feature_log_prob"]                      # (k, d, C)
+        oh = jax.nn.one_hot(X.astype(jnp.int32), flp.shape[2],
+                            dtype=flp.dtype)                 # (n, d, C)
+        return jnp.einsum("ndc,kdc->nk", oh, flp) \
+            + model["class_log_prior"][None, :]
+
+    @classmethod
+    def check_predict_X(cls, X, meta):
+        """Host-side predict-input guard (TpuModel calls this): sklearn
+        raises IndexError for a category the model never allocated —
+        one_hot would silently zero it instead."""
+        ncat = np.asarray(meta["n_categories"])
+        codes = np.asarray(X)
+        bad = codes >= ncat[None, :]
+        if bad.any():
+            i, j = np.argwhere(bad)[0]
+            raise IndexError(
+                f"index {int(codes[i, j])} is out of bounds for feature "
+                f"{int(j)} with {int(ncat[j])} categories")
+
+    @classmethod
+    def sklearn_attrs(cls, model, static, meta):
+        flp = np.asarray(model["feature_log_prob"])
+        ncat = np.asarray(meta["n_categories"])
+        return {"feature_log_prob_": [flp[:, i, :ncat[i]]
+                                      for i in range(flp.shape[1])],
+                "class_log_prior_": np.asarray(model["class_log_prior"]),
+                "class_count_": np.asarray(model["class_count"]),
+                "n_categories_": ncat,
+                "classes_": meta["classes"],
+                "n_features_in_": meta["n_features"]}
+
+
+register_family(
+    CategoricalNBFamily,
+    "sklearn.naive_bayes.CategoricalNB",
+)
 register_family(
     GaussianNBFamily,
     "sklearn.naive_bayes.GaussianNB",

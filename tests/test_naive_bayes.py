@@ -186,3 +186,112 @@ class TestComplementNB:
         assert isinstance(back, ComplementNB)
         agree = np.mean(back.predict(X[300:400]) == sk.predict(X[300:400]))
         assert agree >= 0.99
+
+
+class TestCategoricalNB:
+    def test_alpha_grid_oracle_min_categories(self, digits):
+        """min_categories=17 pins both sides to the same category
+        space (without it sklearn's per-fold resolution CRASHES when a
+        test fold holds a category its train fold never saw — the
+        compiled path resolves from the full X, sklearn's documented
+        min_categories fix)."""
+        from sklearn.naive_bayes import CategoricalNB
+        X, y = digits
+        Xi = (X * 16).astype(np.int64)   # digits pixels 0..16
+        est = CategoricalNB(min_categories=17)
+        grid = {"alpha": [0.1, 1.0, 10.0]}
+        ours = sst.GridSearchCV(est, grid, cv=3, backend="tpu").fit(Xi, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(est, grid, cv=3).fit(Xi, y)
+        assert _mad(ours, theirs) < 1e-6
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_small_category_space_oracle(self):
+        from sklearn.naive_bayes import CategoricalNB
+        rng = np.random.default_rng(0)
+        X = rng.integers(0, 4, size=(600, 8))
+        y = (X[:, 0] + X[:, 1] > 3).astype(int)
+        grid = {"alpha": [0.5, 2.0]}
+        ours = sst.GridSearchCV(CategoricalNB(), grid, cv=3,
+                                backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(CategoricalNB(), grid, cv=3).fit(X, y)
+        assert _mad(ours, theirs) < 1e-6
+
+    def test_negative_x_names_categorical(self):
+        from sklearn.naive_bayes import CategoricalNB
+        rng = np.random.default_rng(2)
+        X = rng.integers(0, 4, size=(40, 3))
+        X[7, 1] = -2
+        y = (np.arange(40) % 2)
+        with pytest.raises(ValueError, match="CategoricalNB"):
+            sst.GridSearchCV(CategoricalNB(), {"alpha": [1.0]}, cv=2,
+                             backend="tpu").fit(X, y)
+
+    def test_round_trip(self):
+        from sklearn.naive_bayes import CategoricalNB
+        rng = np.random.default_rng(1)
+        X = rng.integers(0, 5, size=(400, 6))
+        y = (X[:, 0] > 2).astype(int)
+        sk = CategoricalNB(alpha=0.5).fit(X[:300], y[:300])
+        tm = sst.Converter().toTPU(sk)
+        assert (tm.predict(X[300:]) == sk.predict(X[300:])).all()
+        back = sst.Converter().toSKLearn(tm)
+        assert isinstance(back, CategoricalNB)
+        agree = np.mean(back.predict(X[300:]) == sk.predict(X[300:]))
+        assert agree >= 0.99
+
+    def test_converted_model_rejects_unseen_category(self):
+        """Review fix (r5): sklearn raises IndexError for a category
+        the model never allocated; the one-hot evaluator must not
+        silently zero it."""
+        from sklearn.naive_bayes import CategoricalNB
+        rng = np.random.default_rng(3)
+        X = rng.integers(0, 4, size=(200, 5))
+        y = (X[:, 0] > 1).astype(int)
+        tm = sst.Converter().toTPU(CategoricalNB().fit(X, y))
+        Xbad = X[:5].copy()
+        Xbad[0, 2] = 9
+        with pytest.raises(IndexError, match="out of bounds"):
+            tm.predict(Xbad)
+
+    def test_min_categories_shape_validation(self):
+        """Review fix (r5): wrong-shape min_categories must get
+        sklearn's message, and a broadcastable (1,) array must not
+        slip through."""
+        from sklearn.naive_bayes import CategoricalNB
+        rng = np.random.default_rng(4)
+        X = rng.integers(0, 3, size=(60, 3))
+        y = (np.arange(60) % 2)
+        for bad in (np.array([5, 6]), np.array([5])):
+            with pytest.raises(ValueError, match="should have shape"):
+                sst.GridSearchCV(
+                    CategoricalNB(min_categories=bad),
+                    {"alpha": [1.0]}, cv=2, backend="tpu").fit(X, y)
+
+    def test_nan_input_rejected(self):
+        from sklearn.naive_bayes import CategoricalNB
+        X = np.ones((40, 3))
+        X[3, 1] = np.nan
+        y = (np.arange(40) % 2)
+        with pytest.raises(ValueError, match="NaN"):
+            sst.GridSearchCV(CategoricalNB(), {"alpha": [1.0]}, cv=2,
+                             backend="tpu").fit(X, y)
+
+    def test_keyed_categorical_goes_host(self):
+        """CategoricalNB is keyed_compatible=False: the fleet must run
+        per-key sklearn instead of mis-smoothing with fleet-local
+        category counts."""
+        import pandas as pd
+        from sklearn.naive_bayes import CategoricalNB
+        rng = np.random.default_rng(5)
+        df = pd.DataFrame({
+            "k": np.repeat(["a", "b"], 60),
+            "x": [rng.integers(0, 4, size=3) for _ in range(120)],
+        })
+        df["y"] = [int(v[0] > 1) for v in df["x"]]
+        km = sst.KeyedEstimator(
+            sklearnEstimator=CategoricalNB(min_categories=4),
+            keyCols=["k"], xCol="x", yCol="y").fit(df)
+        assert km.backend != "tpu"
+        assert len(km.keyedModels) == 2
