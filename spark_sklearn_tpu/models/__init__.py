@@ -4,6 +4,7 @@ from spark_sklearn_tpu.models import svm  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import svr  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import trees  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import cluster  # noqa: F401 — registers families
+from spark_sklearn_tpu.models import discriminant  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import naive_bayes  # noqa: F401 — registers families
 from spark_sklearn_tpu.models import neighbors  # noqa: F401 — registers families
 from spark_sklearn_tpu.models.estimators import (  # noqa: F401

@@ -1,0 +1,112 @@
+"""LinearDiscriminantAnalysis (lsqr) family tests vs sklearn oracles."""
+
+import numpy as np
+import pytest
+from sklearn.discriminant_analysis import LinearDiscriminantAnalysis as LDA
+from sklearn.model_selection import GridSearchCV as SkGS
+
+import spark_sklearn_tpu as sst
+
+
+def _mad(ours, theirs):
+    return float(np.max(np.abs(ours.cv_results_["mean_test_score"]
+                               - theirs.cv_results_["mean_test_score"])))
+
+
+class TestLDA:
+    def test_shrinkage_grid_oracle(self, digits):
+        X, y = digits
+        est = LDA(solver="lsqr")
+        grid = {"shrinkage": [0.0, 0.1, 0.5, 0.9]}
+        ours = sst.GridSearchCV(est, grid, cv=3, backend="tpu").fit(X, y)
+        assert ours.search_report["backend"] == "tpu"
+        theirs = SkGS(est, grid, cv=3).fit(X, y)
+        assert _mad(ours, theirs) < 5e-3
+        assert ours.best_params_ == theirs.best_params_
+
+    def test_none_shrinkage_matches_zero(self, digits):
+        """shrinkage=None is arithmetically s=0; sklearn treats them
+        identically and so must the compiled fit.  Tolerance is looser
+        than the shrunk cases: s=0 leaves the within-class covariance
+        SINGULAR on digits (constant pixels), and min-norm lstsq
+        conditioning noise at f32 differs between the two lstsq
+        implementations — accuracy-level, not float-level, parity."""
+        X, y = digits
+        Xs, ys = X[:400], y[:400]
+        est = LDA(solver="lsqr", shrinkage=0.3)
+        ours = sst.GridSearchCV(est, {"shrinkage": [None, 0.3]}, cv=3,
+                                backend="tpu").fit(Xs, ys)
+        theirs = SkGS(est, {"shrinkage": [None, 0.3]}, cv=3).fit(Xs, ys)
+        assert _mad(ours, theirs) < 2e-2
+
+    def test_binary_proba_and_auc(self, digits):
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:300], y[m][:300]
+        est = LDA(solver="lsqr", shrinkage=0.2)
+        for scoring in ("roc_auc", "neg_log_loss"):
+            ours = sst.GridSearchCV(est, {"shrinkage": [0.1, 0.5]}, cv=3,
+                                    scoring=scoring,
+                                    backend="tpu").fit(Xs, ys)
+            assert ours.search_report["backend"] == "tpu"
+            theirs = SkGS(est, {"shrinkage": [0.1, 0.5]}, cv=3,
+                          scoring=scoring).fit(Xs, ys)
+            assert _mad(ours, theirs) < 5e-3, scoring
+
+    def test_priors_oracle(self, digits):
+        X, y = digits
+        m = y < 3
+        Xs, ys = X[m][:300], y[m][:300]
+        est = LDA(solver="lsqr", priors=[0.2, 0.5, 0.3])
+        ours = sst.GridSearchCV(est, {"shrinkage": [0.2]}, cv=3,
+                                backend="tpu").fit(Xs, ys)
+        theirs = SkGS(est, {"shrinkage": [0.2]}, cv=3).fit(Xs, ys)
+        assert _mad(ours, theirs) < 5e-3
+
+    def test_svd_default_falls_back_to_host(self, digits):
+        """solver='svd' (the ctor default) is a designed host fallback
+        — rank-truncated behavior on singular covariance differs from
+        the lsqr math, so faking it compiled would silently diverge."""
+        X, y = digits
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(LDA(), {"tol": [1e-4, 1e-3]},
+                                  cv=3).fit(X[:300], y[:300])
+        assert gs.search_report["backend"] == "host"
+        sk = SkGS(LDA(), {"tol": [1e-4, 1e-3]}, cv=3).fit(X[:300], y[:300])
+        np.testing.assert_allclose(
+            gs.cv_results_["mean_test_score"],
+            sk.cv_results_["mean_test_score"])
+
+    def test_auto_shrinkage_falls_back(self, digits):
+        X, y = digits
+        with pytest.warns(UserWarning, match="falling back"):
+            gs = sst.GridSearchCV(
+                LDA(solver="lsqr", shrinkage="auto"),
+                {"tol": [1e-4]}, cv=3).fit(X[:300], y[:300])
+        assert gs.search_report["backend"] == "host"
+
+    def test_unnormalized_priors_renormalized_like_sklearn(self, digits):
+        """Review fix (r5): sklearn warns and renormalizes priors that
+        don't sum to 1; the compiled fit must do the same."""
+        X, y = digits
+        m = y < 2
+        Xs, ys = X[m][:200], y[m][:200]
+        est = LDA(solver="lsqr", shrinkage=0.2, priors=[30, 70])
+        with pytest.warns(UserWarning, match="Renormalizing"):
+            ours = sst.GridSearchCV(est, {"shrinkage": [0.2]}, cv=3,
+                                    backend="tpu").fit(Xs, ys)
+        assert ours.search_report["backend"] == "tpu"
+        import warnings as w
+        with w.catch_warnings():
+            w.simplefilter("ignore")
+            theirs = SkGS(est, {"shrinkage": [0.2]}, cv=3).fit(Xs, ys)
+        assert _mad(ours, theirs) < 5e-3
+
+    def test_wrong_length_priors_raise_host_side(self, digits):
+        X, y = digits
+        m = y < 3
+        with pytest.raises(ValueError, match="length n_classes"):
+            sst.GridSearchCV(
+                LDA(solver="lsqr", priors=[0.5, 0.5]),
+                {"shrinkage": [0.2]}, cv=3,
+                backend="tpu").fit(X[m][:150], y[m][:150])
