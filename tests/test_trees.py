@@ -167,7 +167,12 @@ class TestDepthFidelitySignals:
 
     def test_rf_default_unbounded_depth_warns_once(self, digits):
         """sklearn's default forest (max_depth=None) is the sharp edge:
-        it silently trained a depth-10 model before round 5."""
+        it silently trained a depth-10 model before round 5.  Tiny
+        feature slice deliberately: the warning is shape-independent,
+        and the default-depth forest program is the suite's heaviest —
+        two long-session native aborts (XLA:CPU SIGABRT inside its
+        execution, unreproducible in isolation) happened on the full
+        64-feature version (docs/ROADMAP.md)."""
         import warnings as w
         X, y = digits
         with w.catch_warnings(record=True) as rec:
@@ -175,7 +180,7 @@ class TestDepthFidelitySignals:
             sst.GridSearchCV(
                 RandomForestClassifier(random_state=0),
                 {"n_estimators": [5]}, cv=2,
-                backend="tpu").fit(X[:200], y[:200])
+                backend="tpu").fit(X[:120, :16], y[:120])
         depth_warns = [r for r in rec
                        if "max_depth values" in str(r.message)]
         assert len(depth_warns) == 1, [str(r.message) for r in rec]
@@ -186,7 +191,7 @@ class TestDepthFidelitySignals:
             sst.GridSearchCV(
                 RandomForestClassifier(random_state=0),
                 {"max_depth": [4, 15], "n_estimators": [5]}, cv=2,
-                backend="tpu").fit(X[:200], y[:200])
+                backend="tpu").fit(X[:120, :16], y[:120])
 
     def test_bounded_grid_does_not_warn(self, digits):
         import warnings as w
