@@ -102,6 +102,13 @@ class _BruteKNNShim:
         negv, idx = lax.top_k(-_sq_dists(X, model["X"]), k)
         if static.get("weights", "uniform") == "distance":
             w = 1.0 / jnp.maximum(jnp.sqrt(-negv), _EPS_DIST)
+            # sklearn's _get_weights: a query with ANY exact-duplicate
+            # neighbor uses ONLY its zero-distance neighbors (weight 1),
+            # zeroing the rest — the eps clamp alone would mix the other
+            # neighbors in with tiny weights
+            zero = negv >= 0.0          # negv = -dist^2 <= 0
+            w = jnp.where(jnp.any(zero, axis=1, keepdims=True),
+                          zero.astype(w.dtype), w)
         else:
             w = jnp.ones_like(negv)
         return idx, w

@@ -42,6 +42,9 @@ class LinearDiscriminantFamily(Family):
     is_classifier = True
     dynamic_params = {"shrinkage": np.float32}
     accepts_sample_weight = False
+    #: sklearn's LDA preserves the user's X dtype to the proba output
+    #: (grid.py's log_loss clip resolves the eps per family)
+    proba_dtype_rule = "input"
 
     @classmethod
     def check_static(cls, static):

@@ -79,6 +79,10 @@ class MLPClassifierFamily(Family):
     is_classifier = True
     dynamic_params = {"alpha": np.float32,
                       "learning_rate_init": np.float32}
+    #: sklearn's MLP keeps the user's X dtype all the way to the proba
+    #: output (one of the two classifiers on this sklearn that do —
+    #: everything else upcasts to f64; see grid.py's log_loss clip)
+    proba_dtype_rule = "input"
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):

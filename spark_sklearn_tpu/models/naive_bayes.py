@@ -213,9 +213,23 @@ class MultinomialNBFamily(Family):
     #: sklearn's check_non_negative names the concrete class
     _sklearn_display = "MultinomialNB"
 
+    @staticmethod
+    def _check_finite(Xa):
+        """sklearn's check_array contract: NaN (which would pass a
+        min()<0 test — NaN comparisons are False) and infinity both
+        raise BEFORE any launch, with sklearn's OWN per-case message
+        (delegated, so the wording can never drift from the installed
+        sklearn), instead of becoming masked failed fits."""
+        if not np.issubdtype(Xa.dtype, np.floating):
+            return
+        from sklearn.utils import assert_all_finite
+        assert_all_finite(Xa, input_name="X")
+
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
-        if np.min(X) < 0:
+        Xa = np.asarray(X)
+        cls._check_finite(Xa)
+        if np.min(Xa) < 0:
             # sklearn's exact complaint; surfaces host-side before any
             # launch (the engine's designed fallback runs sklearn, which
             # raises the same for every candidate)
@@ -304,7 +318,11 @@ class BernoulliNBFamily(MultinomialNBFamily):
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
-        # negative X is fine here (binarize thresholds it)
+        # negative X is fine here (binarize thresholds it), but the
+        # finiteness contract still applies: NaN > threshold is False,
+        # so without the guard a NaN X would silently binarize to 0
+        # where sklearn raises
+        cls._check_finite(np.asarray(X))
         return _prep_classifier_data(X, y, dtype)
 
     @classmethod

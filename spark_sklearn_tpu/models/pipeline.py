@@ -37,6 +37,11 @@ class PipelineFamily:
         self.name = f"pipeline({'+'.join(n for n, _ in steps)}" \
                     f"+{final_family.name})"
         self.is_classifier = final_family.is_classifier
+        # the sklearn twin's proba dtype is the FINAL step's fact (the
+        # transformers only feed it X) — forward it so log_loss clips
+        # where the oracle pipeline clips
+        self.proba_dtype_rule = getattr(
+            final_family, "proba_dtype_rule", "float64")
         self.dynamic_params = {
             f"{final_name}__{k}": v
             for k, v in final_family.dynamic_params.items()

@@ -1,2 +1,5 @@
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh, replicate, shard_leading
+from spark_sklearn_tpu.parallel.pipeline import (
+    ChunkPipeline, LaunchItem, enable_persistent_cache,
+    persistent_cache_counts)
 from spark_sklearn_tpu.parallel.taskgrid import CompileGroup, build_compile_groups, build_fold_masks

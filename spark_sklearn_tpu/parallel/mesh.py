@@ -64,6 +64,31 @@ class TpuConfig:
     # process restarts (jax_compilation_cache_dir), so repeated searches
     # over the same shapes skip the cold compile entirely.
     compile_cache_dir: Optional[str] = None
+    # preferred spelling of compile_cache_dir (kept above for
+    # back-compat); when both are set this one wins.  See
+    # parallel/pipeline.py enable_persistent_cache.
+    compilation_cache_dir: Optional[str] = None
+    # jax only persists programs whose XLA compile took at least this
+    # long (jax_persistent_cache_min_compile_time_secs); 0.0 caches
+    # everything (tests use this to observe hits on tiny programs).
+    persistent_cache_min_compile_s: float = 0.5
+    # pipelined chunk executor (parallel/pipeline.py): how many chunk
+    # launches may be in flight beyond the one being gathered.  Chunk
+    # k+1's host staging, chunk k-1's result gather, and the next
+    # compile group's lowering/compile all overlap chunk k's device
+    # compute.  0 = fully synchronous (bit-for-bit the pre-pipeline
+    # execution order — the debugging/A-B escape hatch); scores are
+    # identical at every depth.
+    pipeline_depth: int = 2
+    # donate each chunk's per-launch dynamic-parameter buffers to XLA.
+    # Default off, with the measured reason recorded: these programs'
+    # outputs (per-task scores, nc x folds) can never alias the donated
+    # inputs, so XLA reports the donation unusable and ignores it — the
+    # pipeline already caps allocator pressure by dropping each chunk's
+    # staged buffers at dispatch (they free the moment the execution
+    # consumes them).  The knob exists for backends/families where the
+    # aliasing does bind.
+    donate_chunk_buffers: bool = False
     # convergence-sorted chunking: when a family exposes a difficulty
     # proxy (GLM: larger C / smaller alpha converges slower), big compile
     # groups are sorted by it and split into ~8 narrower launches so the
@@ -87,6 +112,11 @@ class TpuConfig:
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()
+
+    def resolved_cache_dir(self) -> Optional[str]:
+        """The persistent compilation cache directory, honoring both
+        spellings (`compilation_cache_dir` preferred)."""
+        return self.compilation_cache_dir or self.compile_cache_dir
 
 
 def build_mesh(config: Optional[TpuConfig] = None) -> Mesh:

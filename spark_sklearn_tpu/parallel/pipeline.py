@@ -1,0 +1,386 @@
+"""Pipelined chunk executor — overlap host work with device compute.
+
+The search engine launches its (candidate x fold) grid as a sequence of
+chunked XLA programs.  Run synchronously (stage -> dispatch -> block ->
+gather, one chunk at a time) every host phase serializes with the device:
+staging chunk k+1's dynamic params, gathering chunk k-1's scores, and
+lowering the NEXT compile group's program all stall the accelerator —
+exactly the executor-overlap problem of distributed-Spark ML (arXiv:
+1612.01437) and the pipelined-dispatch answer of MPMD pipeline training
+(arXiv:2412.14374).
+
+`ChunkPipeline` runs the same launch sequence double-buffered:
+
+  - a *stage* thread prepares chunk k+1's host inputs (mask tiling,
+    candidate stacking, `device_put`) while chunk k executes;
+  - the main thread dispatches launches in order (JAX dispatch is async:
+    the call returns as soon as the program is enqueued), so a trace or
+    compile triggered by the next compile group's first chunk runs while
+    the device is still busy with the previous group;
+  - a *gather* thread blocks on each launch's outputs, timestamps device
+    readiness, runs the (blocking) `device_get` transfer, and finalizes
+    results in dispatch order;
+  - a *compile* thread AOT-lowers the next compile group's program
+    (`jit(...).lower(...).compile()`) so group boundaries stop stalling
+    the device; the persistent compilation cache (below) makes the same
+    walk survive process restarts.
+
+`depth=0` is the escape hatch: every phase runs inline on the calling
+thread in today's synchronous order, bit-for-bit, for debugging and A/B
+benchmarks.  Scores are identical at any depth — the pipeline reorders
+*host* work only; every launch sees the same program and the same
+inputs.
+
+A per-launch timeline (stage/dispatch/compute/gather walls and the
+overlap fraction) accumulates into `pipeline_report()` so the win — or
+its absence on a host-bound box — is observable in `search_report`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+__all__ = [
+    "ChunkPipeline",
+    "LaunchItem",
+    "LaunchTimings",
+    "enable_persistent_cache",
+    "persistent_cache_counts",
+    "precompile",
+]
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache
+# ---------------------------------------------------------------------------
+
+#: process-wide persistent-cache traffic, fed by jax's monitoring events
+#: (compiler.py records /jax/compilation_cache/cache_{hits,misses} on
+#: every compile request once a cache dir is configured)
+_CACHE_EVENTS = {"hits": 0, "misses": 0}
+_LISTENER_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+
+
+def _install_cache_listener() -> None:
+    global _LISTENER_INSTALLED
+    with _LISTENER_LOCK:
+        if _LISTENER_INSTALLED:
+            return
+        try:
+            from jax._src import monitoring
+        except ImportError:      # jax moved the module: counts stay zero
+            _LISTENER_INSTALLED = True
+            return
+
+        def _on_event(event: str, **kwargs) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                _CACHE_EVENTS["hits"] += 1
+            elif event == "/jax/compilation_cache/cache_misses":
+                _CACHE_EVENTS["misses"] += 1
+
+        monitoring.register_event_listener(_on_event)
+        _LISTENER_INSTALLED = True
+
+
+def persistent_cache_counts() -> Dict[str, int]:
+    """Cumulative persistent-compile-cache hits/misses this process.
+    Callers snapshot before/after a search and report the delta."""
+    return dict(_CACHE_EVENTS)
+
+
+def enable_persistent_cache(cache_dir: Optional[str],
+                            min_compile_time_s: float = 0.5) -> bool:
+    """Point jax's persistent compilation cache at `cache_dir`.
+
+    Amortizes the cold python->jaxpr->HLO->binary walk across processes
+    (bench cold runs, gate re-runs, checkpoint-resume restarts): the
+    first process pays the XLA compile, every later process with the
+    same program shapes reloads the serialized executable.
+
+    Only-if-different semantics: a search that did not ask for a cache
+    never clobbers a user's own `jax_compilation_cache_dir` setting.
+    Returns True when a cache directory is active after the call.
+    """
+    if not cache_dir:
+        # a cache the USER configured directly still deserves hit/miss
+        # accounting in search_report
+        if jax.config.jax_compilation_cache_dir:
+            _install_cache_listener()
+            return True
+        return False
+    _install_cache_listener()
+    if jax.config.jax_compilation_cache_dir != cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # the threshold rides along only when WE (re)configure the dir —
+        # an unchanged cache never clobbers out-of-band tuning
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+    return True
+
+
+def precompile(jit_fn, *args):
+    """AOT-lower and compile `jit_fn` for the given (abstract or
+    concrete) arguments; returns the compiled executable, which produces
+    bit-identical results to calling `jit_fn` (same jaxpr, same compile
+    options).  Raises whatever tracing/compilation raises — callers fall
+    back to the plain jit path."""
+    return jit_fn.lower(*args).compile()
+
+
+# ---------------------------------------------------------------------------
+# Launch pipeline
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LaunchTimings:
+    """Per-launch wall breakdown.  `compute_s` is the device-occupancy
+    estimate: time from this launch becoming the head of the device
+    queue (max of its dispatch time and the previous launch's readiness)
+    to its outputs being ready."""
+
+    stage_s: float = 0.0      # host staging work (thread-side wall)
+    stage_wait_s: float = 0.0  # un-hidden staging wait on the dispatcher
+    dispatch_s: float = 0.0
+    compute_s: float = 0.0
+    gather_s: float = 0.0
+    finalize_s: float = 0.0
+
+
+@dataclasses.dataclass
+class LaunchItem:
+    """One device launch plus its host-side phases.
+
+    stage    () -> staged payload (host prep + device_put); optional.
+    launch   (staged) -> device outputs.  Runs on the dispatching thread
+             in submission order; JAX dispatch is async so it returns as
+             soon as the program is enqueued (first call may trace and
+             compile — that wall lands in `dispatch_s`).
+    gather   (device outputs) -> host results (the blocking transfer);
+             optional.
+    finalize (host results, LaunchTimings) -> None.  Runs in submission
+             order; result-array writes, checkpointing, and report
+             accounting belong here.
+    """
+
+    key: str
+    launch: Callable[[Any], Any]
+    stage: Optional[Callable[[], Any]] = None
+    gather: Optional[Callable[[Any], Any]] = None
+    finalize: Optional[Callable[[Any, LaunchTimings], None]] = None
+    group: int = 0
+    kind: str = "launch"
+    n_tasks: int = 0
+
+
+class ChunkPipeline:
+    """Run `LaunchItem`s with staging/compile/gather overlapped against
+    device compute (`depth` >= 1), or fully synchronously (`depth` == 0).
+
+    `depth` bounds how many launches may be in flight (dispatched, not
+    yet finalized) beyond the one being gathered — double buffering at
+    depth 1, deeper lookahead beyond.
+    """
+
+    def __init__(self, depth: int = 2):
+        self.depth = max(0, int(depth))
+        self.timeline: List[Dict[str, Any]] = []
+        self._wall_t0: Optional[float] = None
+        self._wall_s = 0.0
+        self._n_precompiled = 0
+        self._compile_executor: Optional[ThreadPoolExecutor] = None
+        self._compile_futures: List[Future] = []
+
+    # -- compile-ahead ---------------------------------------------------
+    def submit_precompile(self, jit_fn, *args) -> Optional[Future]:
+        """Queue an AOT lower+compile on the compile thread (pipelined
+        mode only; at depth 0 programs compile where they always did —
+        at first dispatch).  Returns a Future of the executable, or None
+        when running synchronously."""
+        if self.depth == 0:
+            return None
+        if self._compile_executor is None:
+            self._compile_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sst-compile")
+
+        def job():
+            exe = precompile(jit_fn, *args)
+            self._n_precompiled += 1
+            return exe
+
+        fut = self._compile_executor.submit(job)
+        self._compile_futures.append(fut)
+        return fut
+
+    # -- execution -------------------------------------------------------
+    def run(self, items) -> None:
+        """Consume an iterable of LaunchItems.  Exceptions from any
+        phase propagate to the caller (first one wins) after the
+        pipeline drains; partial results written by earlier finalizes
+        remain (checkpoint-resume picks them up)."""
+        self._wall_t0 = time.perf_counter()
+        try:
+            if self.depth == 0:
+                self._run_sync(items)
+            else:
+                self._run_pipelined(items)
+        finally:
+            self._wall_s += time.perf_counter() - self._wall_t0
+            self._wall_t0 = None
+
+    def close(self) -> None:
+        """Join the compile thread (AOT jobs trace under the caller's
+        jax config — e.g. a temporarily-enabled x64 mode — so they must
+        not outlive the enclosing search)."""
+        if self._compile_executor is not None:
+            for fut in self._compile_futures:
+                fut.cancel()
+            self._compile_executor.shutdown(wait=True)
+            self._compile_executor = None
+            self._compile_futures = []
+
+    # -- reporting -------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        tl = self.timeline
+        walls = {
+            "stage_wall_s": sum(t["stage_s"] for t in tl),
+            "dispatch_wall_s": sum(t["dispatch_s"] for t in tl),
+            "compute_wall_s": sum(t["compute_s"] for t in tl),
+            "gather_wall_s": sum(t["gather_s"] for t in tl),
+            "finalize_wall_s": sum(t["finalize_s"] for t in tl),
+        }
+        busy = sum(walls.values())
+        wall = self._wall_s
+        if self._wall_t0 is not None:     # mid-run snapshot
+            wall += time.perf_counter() - self._wall_t0
+        host = busy - walls["compute_wall_s"]
+        # host work hidden behind device compute, as a fraction of all
+        # host work (0 when synchronous: wall ~= busy by construction)
+        overlap = 0.0
+        if host > 0.0 and wall > 0.0:
+            overlap = min(1.0, max(0.0, (busy - wall) / host))
+        return {
+            "depth": self.depth,
+            "n_launches": len(tl),
+            "wall_s": round(wall, 4),
+            **{k: round(v, 4) for k, v in walls.items()},
+            "overlap_frac": round(overlap, 4),
+            "n_precompiled": self._n_precompiled,
+            "launches": tl,
+        }
+
+    # -- internals -------------------------------------------------------
+    def _record(self, item: LaunchItem, tm: LaunchTimings) -> None:
+        self.timeline.append({
+            "key": item.key, "group": item.group, "kind": item.kind,
+            "n_tasks": item.n_tasks,
+            "stage_s": round(tm.stage_s, 6),
+            "stage_wait_s": round(tm.stage_wait_s, 6),
+            "dispatch_s": round(tm.dispatch_s, 6),
+            "compute_s": round(tm.compute_s, 6),
+            "gather_s": round(tm.gather_s, 6),
+            "finalize_s": round(tm.finalize_s, 6),
+        })
+
+    def _run_sync(self, items) -> None:
+        for item in items:
+            tm = LaunchTimings()
+            t0 = time.perf_counter()
+            staged = item.stage() if item.stage is not None else None
+            t1 = time.perf_counter()
+            tm.stage_s = t1 - t0
+            out = item.launch(staged)
+            t2 = time.perf_counter()
+            tm.dispatch_s = t2 - t1
+            jax.block_until_ready(out)
+            t3 = time.perf_counter()
+            tm.compute_s = t3 - t2
+            host = item.gather(out) if item.gather is not None else None
+            t4 = time.perf_counter()
+            tm.gather_s = t4 - t3
+            if item.finalize is not None:
+                item.finalize(host, tm)
+            tm.finalize_s = time.perf_counter() - t4
+            self._record(item, tm)
+
+    def _run_pipelined(self, items) -> None:
+        depth = self.depth
+        stage_ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="sst-stage")
+        gather_ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="sst-gather")
+        # readiness timestamp of the most recently completed launch —
+        # owned by the (single) gather thread
+        last_ready = [0.0]
+        staged: deque = deque()      # (item, stage Future, t_submitted)
+        inflight: deque = deque()    # gather Futures, dispatch order
+        it = iter(items)
+        exhausted = False
+
+        def staged_call(stage_fn):
+            t0 = time.perf_counter()
+            payload = stage_fn()
+            return payload, time.perf_counter() - t0
+
+        def top_up():
+            nonlocal exhausted
+            while not exhausted and len(staged) < depth + 1:
+                try:
+                    nxt = next(it)
+                except StopIteration:
+                    exhausted = True
+                    return
+                fut = (stage_ex.submit(staged_call, nxt.stage)
+                       if nxt.stage is not None else None)
+                staged.append((nxt, fut))
+
+        def gather_job(item, out, t_dispatched, tm):
+            jax.block_until_ready(out)
+            t_ready = time.perf_counter()
+            tm.compute_s = t_ready - max(t_dispatched, last_ready[0])
+            last_ready[0] = t_ready
+            host = item.gather(out) if item.gather is not None else None
+            t_got = time.perf_counter()
+            tm.gather_s = t_got - t_ready
+            if item.finalize is not None:
+                item.finalize(host, tm)
+            tm.finalize_s = time.perf_counter() - t_got
+            self._record(item, tm)
+
+        try:
+            top_up()
+            while staged:
+                item, fut = staged.popleft()
+                top_up()   # keep the stage thread fed while we dispatch
+                tm = LaunchTimings()
+                t0 = time.perf_counter()
+                payload = None
+                if fut is not None:
+                    payload, tm.stage_s = fut.result()
+                t1 = time.perf_counter()
+                tm.stage_wait_s = t1 - t0
+                out = item.launch(payload)
+                t2 = time.perf_counter()
+                tm.dispatch_s = t2 - t1
+                inflight.append(
+                    gather_ex.submit(gather_job, item, out, t2, tm))
+                while len(inflight) > depth:
+                    inflight.popleft().result()
+            while inflight:
+                inflight.popleft().result()
+        finally:
+            # on error: stop feeding, let in-flight work drain, then
+            # re-raise from the executor futures above
+            for _, fut in staged:
+                if fut is not None:
+                    fut.cancel()
+            stage_ex.shutdown(wait=True)
+            gather_ex.shutdown(wait=True)

@@ -102,6 +102,23 @@ def build_compile_groups(
     return out
 
 
+def pad_chunk(arr: np.ndarray, lo: int, hi: int, width: int,
+              repeat: int = 1) -> np.ndarray:
+    """Slice `arr[lo:hi]` and pad it to the launch's uniform `width` by
+    repeating the last row, so every chunk of a compile group reuses ONE
+    compiled program.  `repeat > 1` additionally repeats each row that
+    many times (the task-batched layout's candidate-major fold axis).
+    Pure host work: this is the "candidate stacking" phase the pipeline
+    runs on its stage thread."""
+    chunk = arr[lo:hi]
+    if len(chunk) != width:
+        chunk = np.concatenate(
+            [chunk, np.repeat(chunk[-1:], width - len(chunk), axis=0)])
+    if repeat > 1:
+        chunk = np.repeat(chunk, repeat, axis=0)
+    return chunk
+
+
 def freeze(v: Any, strict: bool = False):
     """Recursively hashable view of nested params/arrays.
 

@@ -123,9 +123,11 @@ def _cached_program(key, build):
     ("fit"|"score"|..., family, ...) tuples): a family at its cap evicts
     its own LRU entry, the global cap evicts the overall LRU entry.
     """
+    global _PROGRAM_BUILDS
     try:
         k = _freeze(key)
     except TypeError:
+        _PROGRAM_BUILDS += 1
         return build()
     hit = _PROGRAM_CACHE.get(k)
     if hit is not None:
@@ -136,10 +138,20 @@ def _cached_program(key, build):
         _cache_evict(fam)
     elif len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
         _cache_evict()
+    _PROGRAM_BUILDS += 1
     fn = build()
     _PROGRAM_CACHE[k] = (fn, fam)
     _PROGRAM_CACHE_FAMILY_COUNTS[fam] += 1
     return fn
+
+
+#: count of program-cache misses (each one is a fresh traced program
+#: that compiles at first dispatch) — the search_report's n_compiles
+_PROGRAM_BUILDS = 0
+
+
+def _program_build_count() -> int:
+    return _PROGRAM_BUILDS
 
 
 @jax.jit
@@ -191,7 +203,32 @@ def _search_estimator_has(attr):
     return check
 
 
-from sklearn.callback import CallbackSupportMixin
+try:
+    from sklearn.callback import CallbackSupportMixin
+    from sklearn.callback._callback_support import (
+        callback_management_context)
+except ImportError:
+    # installed sklearn predates (or dropped) the callback module — run
+    # with inert stand-ins so the search works identically minus hooks
+    class _NullCallbackContext:
+        def subcontext(self, *args, **kwargs):
+            return self
+
+        def call_on_fit_task_begin(self, **kwargs):
+            return self
+
+        def call_on_fit_task_end(self, **kwargs):
+            return None
+
+        def propagate_callback_context(self, estimator):
+            return _nullcontext()
+
+    class CallbackSupportMixin:  # type: ignore[no-redef]
+        def _init_callback_context(self, max_subtasks=None):
+            return _NullCallbackContext()
+
+    def callback_management_context(estimator):
+        return _nullcontext()
 
 
 class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
@@ -221,7 +258,29 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
     def search_report(self):
         """Per-search execution report (backend, compile groups, launches,
         fit/score wall).  Stored privately so fit() only adds underscore-
-        prefixed/suffixed attributes, per sklearn's estimator checks."""
+        prefixed/suffixed attributes, per sklearn's estimator checks.
+
+        Compiled searches additionally carry ``report["pipeline"]`` — the
+        chunk scheduler's timeline (parallel/pipeline.py):
+
+          - ``depth``: the pipeline depth the search ran at (0 = the
+            synchronous escape hatch);
+          - ``launches``: one record per device launch with its
+            ``kind`` (fit/score/calibrate/fused) and per-phase walls
+            (``stage_s``/``dispatch_s``/``compute_s``/``gather_s``/
+            ``finalize_s``);
+          - ``stage_wall_s``/``dispatch_wall_s``/``compute_wall_s``/
+            ``gather_wall_s``/``finalize_wall_s``: the per-phase sums,
+            and ``wall_s`` the run's actual wall — their gap is the
+            ``overlap_frac`` (host work hidden behind device compute);
+          - ``n_compiles``/``n_precompiled``: how many programs were
+            traced this search, and how many of those the compile-ahead
+            thread AOT-compiled;
+          - ``persistent_cache_hits``/``persistent_cache_misses``: the
+            persistent compilation cache's traffic during this search
+            (nonzero hits = a previous process already paid the
+            compile; see TpuConfig.compilation_cache_dir).
+        """
         if not hasattr(self, "_search_report"):
             raise AttributeError("search_report is set by fit()")
         return self._search_report
@@ -346,8 +405,6 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
     def fit(self, X, y=None, **params):
         # teardown of attached callbacks is guaranteed even when fit
         # raises (sklearn wraps fit the same way via _fit_context)
-        from sklearn.callback._callback_support import (
-            callback_management_context)
         with callback_management_context(self):
             return self._fit_impl(X, y, params)
 
@@ -800,32 +857,51 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                            fit_weight=None, score_weight=None,
                            dtype_override=None):
         from sklearn.metrics import check_scoring
-        if config.compile_cache_dir and (
-                jax.config.jax_compilation_cache_dir
-                != config.compile_cache_dir):
-            # only-if-different: never clobber a user's own cache settings
-            # from a search that didn't ask for one
-            jax.config.update("jax_compilation_cache_dir",
-                              config.compile_cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.5)
+
+        from spark_sklearn_tpu.parallel.pipeline import (
+            enable_persistent_cache)
+        enable_persistent_cache(config.resolved_cache_dir(),
+                                config.persistent_cache_min_compile_s)
         dtype = dtype_override or config.dtype or np.float32
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
 
         # sklearn's log_loss clips probas at THEIR dtype's machine eps
-        # (_classification.py _log_loss), and the sklearn twin's proba
-        # dtype is a per-family fact: libsvm/forests/KNN always produce
-        # f64 probas, while LogReg/MLP/NB preserve the user's X dtype —
-        # the compiled scorer must clip where the oracle clips, not
-        # where the engine's compute dtype lands (see scorers.py
+        # (_classification.py log_loss), and the sklearn twin's proba
+        # dtype is a per-family fact: on this sklearn nearly every
+        # classifier (libsvm, forests, KNN, LogReg, the NB family)
+        # produces f64 probas regardless of X dtype; only MLP and LDA
+        # preserve the user's X dtype (proba_dtype_rule="input") — the
+        # compiled scorer must clip where the oracle clips, not where
+        # the engine's compute dtype lands (see scorers.py
         # _neg_log_loss)
-        proba_rule = getattr(family, "proba_dtype_rule", "input")
-        # getattr, not np.asarray: sparse X would become a 0-d object
-        # array (and lists would pay a full copy just to read a dtype)
+        proba_rule = getattr(family, "proba_dtype_rule", "float64")
+        # the dtype that matters is the one sklearn's own validation
+        # would hand the estimator: float32 stays float32, EVERYTHING
+        # else (float64, ints, lists, frames — check_array's numeric
+        # rule) becomes float64.  Resolve it after coercion: sparse
+        # matrices and ndarrays expose .dtype directly; other inputs
+        # (lists, DataFrames) go through np.asarray like sklearn's
+        # check_array would
+        x_dt = getattr(X, "dtype", None)
+        if not isinstance(x_dt, np.dtype):
+            # dtype-less inputs resolve WITHOUT copying the dataset:
+            # DataFrames promote their column dtypes; lists/tuples
+            # resolve from their first row (a float32-ndarray row list
+            # stays float32 under np.asarray, everything else becomes
+            # float64 under check_array's numeric rule)
+            col_dtypes = getattr(X, "dtypes", None)
+            if col_dtypes is not None and len(col_dtypes):
+                x_dt = np.result_type(*col_dtypes)
+            elif isinstance(X, (list, tuple)) and len(X) \
+                    and isinstance(X[0], np.ndarray):
+                x_dt = X[0].dtype
+            elif isinstance(X, (list, tuple)):
+                x_dt = np.dtype(np.float64)
+            else:
+                x_dt = np.asarray(X).dtype
         oracle_proba_dt = np.float64 if (
-            proba_rule == "float64"
-            or getattr(X, "dtype", None) == np.float64) else np.float32
+            proba_rule == "float64" or x_dt != np.float32) else np.float32
         X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
         meta["logloss_clip_eps"] = float(np.finfo(oracle_proba_dt).eps)
@@ -1172,8 +1248,21 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     max_cand_per_batch, n_folds, dtype, return_train,
                     test_scores, train_scores, fit_times, score_times, ckpt,
                     fit_failed, candidates):
+        """Chunked launch schedule, executed through the pipelined chunk
+        executor (parallel/pipeline.py).
+
+        Every chunk of every compile group becomes one (or, for the
+        calibration chunk, three) `LaunchItem`s: host staging of chunk
+        k+1, the result gather of chunk k-1, and the next compile
+        group's lowering/compile all overlap chunk k's device compute at
+        `config.pipeline_depth >= 1`; depth 0 runs the identical item
+        sequence synchronously (the bit-for-bit escape hatch).  Scores
+        are independent of the depth — only host work is reordered."""
+        from spark_sklearn_tpu.parallel.pipeline import (
+            ChunkPipeline, LaunchItem, persistent_cache_counts)
+        from spark_sklearn_tpu.parallel.taskgrid import pad_chunk
+
         task_batched = hasattr(family, "fit_task_batched")
-        health_jit = _models_health
         if config.n_data_shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
             tb_mask_shard = NamedSharding(
@@ -1181,6 +1270,35 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         else:
             tb_mask_shard = task_shard
         report = self._search_report
+        donate = bool(config.donate_chunk_buffers)
+
+        # score path: every registry scorer decomposes into model views
+        # (pred/decision/proba) + a metric core, so views are computed
+        # ONCE per launch over the flat task axis — for linear families
+        # one wide matmul for ALL (candidate x fold) tasks
+        # (`views_task_batched`) instead of a matvec per task per scorer
+        # — then the cheap reduction cores vmap over tasks.  Custom
+        # scorers without a core (family default_scorer like KMeans
+        # -inertia) keep the nested path.
+        import os as _os
+        all_cores = all(hasattr(fn, "core") for fn in scorers.values()) \
+            and not _os.environ.get("SST_NESTED_SCORE")
+        needed_views = frozenset(
+            v for fn in scorers.values()
+            for v in getattr(fn, "views", ()))
+        # fused launch (default): fit + NaN-health + scoring in ONE
+        # compiled program per chunk — the model pytree stays on device.
+        # The FIRST live chunk of each multi-chunk group still runs as
+        # separate fit/score launches plus a warm calibration score
+        # launch that measures the steady-state score cost later fused
+        # chunks attribute out of their single-launch wall.
+        fused_mode = all_cores and config.fuse_fit_score
+        score_key = tuple(sorted(scorers.items()))
+
+        # ------------------------------------------------------------------
+        # group plans: chunk geometry + (lazily built) programs
+        # ------------------------------------------------------------------
+        plans = []
         for gi, group in enumerate(groups):
             static = {**base_params, **group.static_params}
             nc = group.n_candidates
@@ -1196,13 +1314,6 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # and easy launches early-exit at their own iteration count.
             # cv_results_ order is unaffected (cells are written through
             # candidate_indices).
-            # The family supplies only the ascending-difficulty PROXY
-            # array; the split policy lives here in one place: the
-            # per-family minimum grid size (`min_sort_candidates` —
-            # GLM solvers need ~32 candidates to amortise the extra
-            # dispatches, tree ensembles win from ~4) and the
-            # constant-proxy guard (a grid varying only in other params
-            # would pay the launch split for zero benefit).
             sorted_chunks = False
             proxy_hook = getattr(family, "convergence_proxy", None)
             if proxy_hook is not None and config.sort_candidates:
@@ -1230,12 +1341,42 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     mesh_lib.pad_to_multiple(
                         -(-nc // _SORTED_LAUNCHES), n_task_shards)))
 
+            # chunk resume state resolved up front: the calibration
+            # structure (which chunk calibrates, which chunks fuse) must
+            # be known before dispatch, not discovered mid-pipeline
+            chunks = []
+            for lo in range(0, nc, nc_batch):
+                hi = min(lo + nc_batch, nc)
+                # sorted chunks write cells through a PERMUTED index set:
+                # a checkpoint from an unsorted run must not resume into
+                # them (and vice versa), so the id carries the mode
+                chunk_id = f"{gi}:{lo}:{hi}" + (":s" if sorted_chunks
+                                                else "")
+                rec = ckpt.get(chunk_id) if ckpt is not None else None
+                if rec is not None and return_train and \
+                        rec.get("train") is None:
+                    rec = None  # written without train scores: recompute
+                chunks.append((lo, hi, chunk_id, rec))
+            plans.append({
+                "gi": gi, "group": group, "static": static, "nc": nc,
+                "nc_batch": nc_batch, "sorted": sorted_chunks,
+                "chunks": chunks,
+                "n_live": sum(1 for c in chunks if c[3] is None)})
+
+        def build_programs(plan):
+            """The group's jitted programs (cross-search cached); built
+            on first need so fully-resumed groups never trace."""
+            progs = plan.get("progs")
+            if progs is not None:
+                return progs
+            static = plan["static"]
+            nc_batch = plan["nc_batch"]
+            donate_kw = {"donate_argnums": (0,)} if donate else {}
+
             if task_batched:
                 # flatten (candidate x fold) into one leading task axis and
                 # let the family turn it into wide-matmul width (candidate-
                 # major order: task t = (cand t//n_folds, fold t%n_folds))
-                w_task = np.tile(fit_masks, (nc_batch, 1))
-                w_task_dev = jax.device_put(w_task, tb_mask_shard)
 
                 def fit_batch_tb(dyn_t, data_d, w_t,
                                  static={**static, "__n_folds__": n_folds,
@@ -1248,8 +1389,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
                 fit_jit = _cached_program(
                     ("fit_tb", family, static, meta, nc_batch, n_folds,
-                     bool(config.bf16_matmul)),
-                    lambda: jax.jit(fit_batch_tb))
+                     bool(config.bf16_matmul), donate),
+                    lambda: jax.jit(fit_batch_tb, **donate_kw))
 
             def fit_batch(dyn_arrs, data_d, train_m, static=static):
                 def one_cand(dyn_scalars):
@@ -1258,22 +1399,6 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                           meta)
                     return jax.vmap(one_fold)(train_m)
                 return jax.vmap(one_cand)(dyn_arrs)
-
-            # score path: every registry scorer decomposes into model
-            # views (pred/decision/proba) + a metric core, so views are
-            # computed ONCE per launch over the flat task axis — for
-            # linear families one wide matmul for ALL (candidate x fold)
-            # tasks (`views_task_batched`) instead of a matvec per task
-            # per scorer — then the cheap reduction cores vmap over
-            # tasks.  Custom scorers without a core (family
-            # default_scorer like KMeans -inertia) keep the nested path.
-            import os as _os
-            all_cores = all(hasattr(fn, "core")
-                            for fn in scorers.values()) \
-                and not _os.environ.get("SST_NESTED_SCORE")
-            needed_views = frozenset(
-                v for fn in scorers.values()
-                for v in getattr(fn, "views", ()))
 
             def score_batch_wide(models, data_d, test_m, train_m, test_u,
                                  train_u, static=static):
@@ -1339,13 +1464,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             score_batch = score_batch_wide if all_cores \
                 else score_batch_nested
 
-            # fused launch (default): fit + NaN-health + scoring in ONE
-            # compiled program per chunk — the model pytree stays on
-            # device (no host sync, no materialised transfer between
-            # phases; XLA fuses the scoring epilogue into the solver).
-            # Custom scorers without a core keep the two-launch path.
-            fused = all_cores and config.fuse_fit_score
-            if fused:
+            fused_jit = None
+            if fused_mode:
                 fit_core = fit_batch_tb if task_batched else fit_batch
 
                 def fused_batch(dyn_t, data_d, w_fit, test_m, train_m,
@@ -1376,46 +1496,204 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
                 fused_jit = _cached_program(
                     ("fused", family, static, meta, nc_batch, n_folds,
-                     bool(config.bf16_matmul), mesh,
-                     tuple(sorted(scorers.items())), return_train,
-                     sw_blind),
-                    lambda: jax.jit(fused_batch))
+                     bool(config.bf16_matmul), mesh, score_key,
+                     return_train, sw_blind, donate),
+                    lambda: jax.jit(fused_batch, **donate_kw))
             # separate fit/score programs: the non-fused path runs them
             # for every chunk; the fused path runs them for each group's
-            # FIRST chunk to calibrate the score share that splits later
-            # fused walls (sklearn's fit/score time columns must never be
-            # a silent 0.0 — VERDICT r4 next #4).  jax.jit is lazy, so a
-            # program a search never calls is never traced or compiled.
+            # first live chunk to calibrate the score share that splits
+            # later fused walls (sklearn's fit/score time columns must
+            # never be a silent 0.0 — VERDICT r4 next #4).  jax.jit is
+            # lazy, so a program a search never calls is never traced or
+            # compiled.
             if not task_batched:
                 fit_jit = _cached_program(
-                    ("fit", family, static, meta, mesh),
-                    lambda: jax.jit(fit_batch,
-                                    out_shardings=task_shard))
+                    ("fit", family, static, meta, mesh, donate),
+                    lambda: jax.jit(fit_batch, out_shardings=task_shard,
+                                    **donate_kw))
             score_jit = _cached_program(
-                ("score", family, static, meta,
-                 tuple(sorted(scorers.items())), return_train,
+                ("score", family, static, meta, score_key, return_train,
                  sw_blind, bool(all_cores)),
                 lambda: jax.jit(score_batch))
-            #: measured WARM score seconds per task from this group's
-            #: calibration chunk (a second, post-compile score launch —
-            #: the first launch's wall includes trace+compile and would
-            #: overstate the share by the compile ratio); None until one
-            #: has run
-            score_s_per_task = None
+            progs = {"fit": fit_jit, "score": score_jit,
+                     "fused": fused_jit}
+            plan["progs"] = progs
+            return progs
 
-            for lo in range(0, nc, nc_batch):
-                hi = min(lo + nc_batch, nc)
-                idx = group.candidate_indices[lo:hi]
-                # sorted chunks write cells through a PERMUTED index set:
-                # a checkpoint from an unsorted run must not resume into
-                # them (and vice versa), so the id carries the mode
-                chunk_id = f"{gi}:{lo}:{hi}" + (":s" if sorted_chunks
-                                                else "")
-                if ckpt is not None:
-                    rec = ckpt.get(chunk_id)
-                    if rec is not None and return_train and \
-                            rec.get("train") is None:
-                        rec = None  # written without train scores: recompute
+        def group_masks(plan):
+            """The group's fit-mask device buffer.  Task-batched families
+            consume the fold masks tiled to the launch width — built
+            lazily on the stage thread, once per group, so fully-resumed
+            groups never pay the tile or the upload."""
+            if not task_batched:
+                return fit_dev
+            w = plan.get("w_task_dev")
+            if w is None:
+                w = jax.device_put(
+                    np.tile(fit_masks, (plan["nc_batch"], 1)),
+                    tb_mask_shard)
+                plan["w_task_dev"] = w
+            return w
+
+        cache0 = persistent_cache_counts()
+        builds0 = _program_build_count()
+        # multi-controller runs gather through process_allgather — a
+        # cross-process COLLECTIVE.  Issuing collectives from background
+        # threads would need every process to interleave them in the
+        # same order as its peers; the synchronous schedule guarantees
+        # that, the pipelined one does not — so multihost forces depth 0
+        depth = config.pipeline_depth if jax.process_count() == 1 else 0
+        pipe = ChunkPipeline(depth)
+
+        def submit_precompile(plan):
+            """AOT-lower/compile the group's fused program on the compile
+            thread so the group boundary does not stall the device.  The
+            executable is bit-identical to the jit path (same jaxpr, same
+            compile options); failure here only means the jit path
+            compiles at first dispatch, as it always did."""
+            if plan.get("aot_submitted") or pipe.depth == 0 \
+                    or not fused_mode or plan["n_live"] < 2:
+                return
+            plan["aot_submitted"] = True
+            try:
+                progs = build_programs(plan)
+                nc_batch = plan["nc_batch"]
+                lanes = nc_batch * n_folds
+                dyn_spec = {}
+                for k, arr in plan["group"].dynamic_params.items():
+                    shape = ((lanes,) if task_batched
+                             else (nc_batch,)) + arr.shape[1:]
+                    dyn_spec[k] = jax.ShapeDtypeStruct(
+                        shape, arr.dtype, sharding=task_shard)
+                if not dyn_spec and not task_batched:
+                    dyn_spec["_pad"] = jax.ShapeDtypeStruct(
+                        (nc_batch,), dtype, sharding=task_shard)
+                if task_batched:
+                    w_spec = jax.ShapeDtypeStruct(
+                        (lanes,) + fit_masks.shape[1:],
+                        fit_masks.dtype, sharding=tb_mask_shard)
+                else:
+                    w_spec = fit_dev
+                plan["aot_future"] = pipe.submit_precompile(
+                    progs["fused"], dyn_spec, data_dev, w_spec,
+                    test_dev, train_sc_dev, test_unw_dev, train_unw_dev)
+            except Exception as exc:   # AOT is an optimization only
+                logger.debug("fused precompile submission failed: %r", exc)
+
+        def resolve_fused(plan):
+            """The callable for this group's fused chunks: the AOT
+            executable when the compile thread produced one, the plain
+            jit program otherwise (identical results either way)."""
+            call = plan.get("fused_call")
+            if call is not None:
+                return call
+            jit_fn = build_programs(plan)["fused"]
+            call = jit_fn
+            fut = plan.pop("aot_future", None)
+            if fut is not None:
+                try:
+                    exe = fut.result()
+
+                    def call(*args, _exe=exe, _jit=jit_fn, _plan=plan):
+                        try:
+                            return _exe(*args)
+                        except (TypeError, ValueError):
+                            # aval/sharding mismatch only: drop to jit
+                            # forever.  Genuine runtime failures (OOM,
+                            # XlaRuntimeError) must propagate — retrying
+                            # the identical program via jit would only
+                            # recompile and fail again with the original
+                            # context lost
+                            _plan["fused_call"] = _jit
+                            return _jit(*args)
+                except Exception as exc:
+                    logger.debug("fused precompile failed (%r); "
+                                 "falling back to jit", exc)
+            plan["fused_call"] = call
+            return call
+
+        def write_cells(plan, idx, lo, hi, chunk_id, te, tr, t_fit,
+                        t_score):
+            # charge the launch wall to the REAL candidates in the chunk
+            # (not the padded lane count), so summing ALL per-split
+            # fit-time cells (mean_fit_time x n_splits over candidates)
+            # reconstructs the true device wall; XLA fuses all lanes
+            # into one program, so a finer per-candidate split is not
+            # measurable (ROADMAP)
+            n_real = (hi - lo) * n_folds
+            fit_times[idx, :] = t_fit / n_real
+            score_times[idx, :] = t_score / n_real
+            for s in scorer_names:
+                test_scores[s][idx, :] = np.asarray(te[s])[:hi - lo]
+                if return_train:
+                    train_scores[s][idx, :] = \
+                        np.asarray(tr[s])[:hi - lo]
+            report["n_launches"] += 1
+            report["fit_wall_s"] += t_fit
+            report["score_wall_s"] += t_score
+            # per-compile-group walls: candidates in different groups
+            # (or chunks) carry genuinely different launch timings —
+            # only candidates fused into ONE launch share a per-launch
+            # average (XLA executes them as one program, so a finer
+            # split is not measurable; see ROADMAP)
+            rec = per_group_rec(plan)
+            rec["n_launches"] += 1
+            rec["fit_wall_s"] += t_fit
+            rec["score_wall_s"] += t_score
+            if self.verbose > 1:
+                self._print_task_end_lines(
+                    candidates, idx, n_folds, scorer_names,
+                    test_scores, train_scores, return_train,
+                    (t_fit + t_score) / n_real, fit_failed)
+            if ckpt is not None:
+                ckpt.put(chunk_id, {
+                    "test": {s: test_scores[s][idx, :].tolist()
+                             for s in scorer_names},
+                    "train": ({s: train_scores[s][idx, :].tolist()
+                               for s in scorer_names}
+                              if return_train else None),
+                    "fit_t": t_fit / n_real,
+                    "score_t": t_score / n_real,
+                    "failed": fit_failed[idx, :].tolist()})
+
+        def per_group_rec(plan):
+            pg = report.setdefault("per_group", {})
+            return pg.setdefault(plan["gi"], {
+                "static_params": repr(plan["group"].static_params),
+                "n_launches": 0, "fit_wall_s": 0.0, "score_wall_s": 0.0,
+                "score_path": ("wide-fused" if fused_mode else
+                               "wide" if all_cores else "nested")})
+
+        def record_iters(it_max, it_sum, lanes):
+            report.setdefault("solver_iters_per_launch", []).append(
+                int(it_max))
+            report.setdefault("solver_iters_sum_per_launch", []).append(
+                int(it_sum))
+            report.setdefault("lanes_per_launch", []).append(int(lanes))
+
+        def chunk_items():
+            """Yield this search's LaunchItems in dispatch order.  Runs
+            on the dispatching thread: the group-level work between
+            yields (program build, AOT future consumption) overlaps the
+            already-dispatched launches' device compute."""
+            for pi, plan in enumerate(plans):
+                gi, group = plan["gi"], plan["group"]
+                nc_batch = plan["nc_batch"]
+                lanes = nc_batch * n_folds
+                # compile-ahead: this group's fused program (overlaps
+                # its own calibration launches) and the next group's
+                # (overlaps this whole group)
+                submit_precompile(plan)
+                if pi + 1 < len(plans):
+                    submit_precompile(plans[pi + 1])
+                #: group-shared state: the calibrated warm score cost
+                #: per task, set by the calibration item's finalize —
+                #: which the (serial, in-order) finalize stream runs
+                #: before any fused chunk of the group finalizes
+                gstate = {"sspt": None}
+                live_seen = 0
+                for lo, hi, chunk_id, rec in plan["chunks"]:
+                    idx = group.candidate_indices[lo:hi]
                     if rec is not None:
                         for s_ in scorer_names:
                             test_scores[s_][idx, :] = np.asarray(
@@ -1430,161 +1708,206 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                 rec["failed"], bool)
                         report["n_chunks_resumed"] += 1
                         continue
-                dyn = {}
-                for k, arr in group.dynamic_params.items():
-                    chunk = arr[lo:hi]
-                    if len(chunk) != nc_batch:
-                        chunk = np.concatenate(
-                            [chunk, np.repeat(chunk[-1:],
-                                              nc_batch - len(chunk),
-                                              axis=0)])
-                    if task_batched:
-                        chunk = np.repeat(chunk, n_folds, axis=0)
-                    dyn[k] = jax.device_put(chunk, task_shard)
-                if not dyn and not task_batched:
-                    # all-static group: vmap still needs a batched operand
-                    # to define the candidate axis (families ignore unknown
-                    # keys)
-                    dyn["_pad"] = jax.device_put(
-                        np.zeros(nc_batch, dtype=dtype), task_shard)
+                    live_seen += 1
+                    n_real = (hi - lo) * n_folds
 
-                t0 = time.perf_counter()
-                if fused and score_s_per_task is not None:
-                    te, tr, bad, iters_max, iters_sum = fused_jit(
-                        dyn, data_dev,
-                        w_task_dev if task_batched else fit_dev,
-                        test_dev, train_sc_dev, test_unw_dev,
-                        train_unw_dev)
-                    te = mesh_lib.device_get_tree(te)
-                    tr = mesh_lib.device_get_tree(tr)
-                    im = int(iters_max)
-                    wall = time.perf_counter() - t0
-                    # one launch: attribute the group's measured warm
-                    # score cost (calibrated on the first chunk's second
-                    # score launch), the rest is fit — so the score-time
-                    # column is an estimate, never a silent 0.0
-                    # (TpuConfig.fuse_fit_score)
-                    t_score = min(score_s_per_task * (hi - lo) * n_folds,
-                                  wall)
-                    t_fit = wall - t_score
-                    fit_failed[idx, :] |= np.asarray(
-                        mesh_lib.device_get_tree(bad))[:hi - lo]
-                    if im >= 0:
-                        report.setdefault(
-                            "solver_iters_per_launch", []).append(im)
-                        report.setdefault(
-                            "solver_iters_sum_per_launch", []).append(
-                            int(iters_sum))
-                        report.setdefault(
-                            "lanes_per_launch", []).append(
-                            int(nc_batch * n_folds))
-                else:
-                    if task_batched:
-                        models = fit_jit(dyn, data_dev, w_task_dev)
-                    else:
-                        models = fit_jit(dyn, data_dev, fit_dev)
-                    jax.block_until_ready(models)
-                    t_fit = time.perf_counter() - t0
+                    def stage(lo=lo, hi=hi, plan=plan):
+                        dyn = {}
+                        for k, arr in plan["group"].dynamic_params.items():
+                            dyn[k] = jax.device_put(
+                                pad_chunk(arr, lo, hi, plan["nc_batch"],
+                                          n_folds if task_batched else 1),
+                                task_shard)
+                        if not dyn and not task_batched:
+                            # all-static group: vmap still needs a
+                            # batched operand to define the candidate
+                            # axis (families ignore unknown keys)
+                            dyn["_pad"] = jax.device_put(
+                                np.zeros(plan["nc_batch"], dtype=dtype),
+                                task_shard)
+                        w = group_masks(plan)
+                        # once the group's last live chunk has staged,
+                        # drop the plan's tiled-mask reference (each
+                        # payload keeps its own) so one group's masks
+                        # never outlive its launches — stage runs on a
+                        # single thread, so the count is race-free
+                        plan["n_staged"] = plan.get("n_staged", 0) + 1
+                        if plan["n_staged"] >= plan["n_live"]:
+                            plan.pop("w_task_dev", None)
+                        return dyn, w
 
-                    bad = health_jit(models)
-                    if bad is not None:
-                        fit_failed[idx, :] |= np.asarray(
-                            mesh_lib.device_get_tree(bad))[:hi - lo]
+                    if fused_mode and live_seen > 1:
+                        # steady state: ONE fused launch per chunk
 
-                    # solver-iteration accounting for FLOP/MFU reporting
-                    # (bench.py): lockstep batched solvers execute max-
-                    # over-lanes iterations, so (iters, lanes) per launch
-                    # times the family's per-lane-per-iteration matmul
-                    # FLOPs is the executed compute
-                    if isinstance(models, dict) and (
-                            "n_iter" in models or "n_iter_exec" in models):
-                        # prefer the solver's true executed count over any
-                        # sklearn-facing rescale (FISTA reports n_iter on
-                        # the caller's max_iter axis but runs a larger
-                        # internal budget)
-                        it_arr = models.get("n_iter_exec",
-                                            models.get("n_iter"))
-                        it_host = np.asarray(
+                        def launch(payload, plan=plan):
+                            dyn, w = payload
+                            return resolve_fused(plan)(
+                                dyn, data_dev, w, test_dev, train_sc_dev,
+                                test_unw_dev, train_unw_dev)
+
+                        def gather(out):
+                            te, tr, bad, it_max, it_sum = out
+                            return (mesh_lib.device_get_tree(te),
+                                    mesh_lib.device_get_tree(tr),
+                                    np.asarray(
+                                        mesh_lib.device_get_tree(bad)),
+                                    int(it_max), int(it_sum))
+
+                        def finalize(host, tm, plan=plan, idx=idx, lo=lo,
+                                     hi=hi, chunk_id=chunk_id,
+                                     gstate=gstate, lanes=lanes):
+                            te, tr, bad, im, isum = host
+                            wall = tm.dispatch_s + tm.compute_s \
+                                + tm.gather_s
+                            # one launch: attribute the group's measured
+                            # warm score cost — scaled by the PADDED
+                            # lane count, which is what the launch
+                            # actually computes — the rest is fit, so
+                            # the score-time column is an estimate,
+                            # never a silent 0.0
+                            t_score = min(gstate["sspt"] * lanes, wall)
+                            t_fit = wall - t_score
+                            fit_failed[idx, :] |= bad[:hi - lo]
+                            if im >= 0:
+                                record_iters(im, isum, lanes)
+                            write_cells(plan, idx, lo, hi, chunk_id,
+                                        te, tr, t_fit, t_score)
+
+                        yield LaunchItem(
+                            key=chunk_id, kind="fused", group=gi,
+                            n_tasks=n_real, stage=stage, launch=launch,
+                            gather=gather, finalize=finalize)
+                        continue
+
+                    # first live chunk of the group (or the never-fused
+                    # path): separate fit and score launches with exact
+                    # per-phase walls, plus — when later chunks will
+                    # fuse — a warm calibration score launch measuring
+                    # the steady-state score cost
+                    cstate = {}
+                    calibrate = fused_mode and live_seen < plan["n_live"]
+
+                    def launch_fit(payload, plan=plan, cstate=cstate):
+                        dyn, w = payload
+                        models = build_programs(plan)["fit"](
+                            dyn, data_dev, w)
+                        cstate["models"] = models
+                        bad = _models_health(models)
+                        it_arr = None
+                        if isinstance(models, dict) and (
+                                "n_iter" in models
+                                or "n_iter_exec" in models):
+                            # prefer the solver's true executed count
+                            # over any sklearn-facing rescale (FISTA
+                            # reports n_iter on the caller's max_iter
+                            # axis but runs a larger internal budget)
+                            it_arr = models.get("n_iter_exec",
+                                                models.get("n_iter"))
+                        return models, bad, it_arr
+
+                    def gather_fit(out):
+                        _, bad, it_arr = out
+                        bad_h = (np.asarray(mesh_lib.device_get_tree(bad))
+                                 if bad is not None else None)
+                        it_h = (np.asarray(
                             mesh_lib.device_get_tree(it_arr))
-                        report.setdefault(
-                            "solver_iters_per_launch", []).append(
-                            int(np.max(it_host)))
-                        report.setdefault(
-                            "solver_iters_sum_per_launch", []).append(
-                            int(np.sum(it_host)))
-                        report.setdefault(
-                            "lanes_per_launch", []).append(
-                            int(nc_batch * n_folds))
+                            if it_arr is not None else None)
+                        return bad_h, it_h
 
-                    t0 = time.perf_counter()
-                    te, tr = score_jit(models, data_dev, test_dev,
-                                       train_sc_dev, test_unw_dev,
-                                       train_unw_dev)
-                    te = mesh_lib.device_get_tree(te)
-                    tr = mesh_lib.device_get_tree(tr)
-                    t_score = time.perf_counter() - t0
-                    if fused:
+                    def fin_fit(host, tm, idx=idx, lo=lo, hi=hi,
+                                cstate=cstate, lanes=lanes):
+                        bad_h, it_h = host
+                        if bad_h is not None:
+                            fit_failed[idx, :] |= bad_h[:hi - lo]
+                        if it_h is not None:
+                            record_iters(np.max(it_h), np.sum(it_h),
+                                         lanes)
+                        cstate["t_fit"] = tm.dispatch_s + tm.compute_s
+
+                    yield LaunchItem(
+                        key=chunk_id + ":fit", kind="fit", group=gi,
+                        n_tasks=n_real, stage=stage, launch=launch_fit,
+                        gather=gather_fit, finalize=fin_fit)
+
+                    def launch_score(payload, plan=plan, cstate=cstate):
+                        return build_programs(plan)["score"](
+                            cstate["models"], data_dev, test_dev,
+                            train_sc_dev, test_unw_dev, train_unw_dev)
+
+                    def gather_score(out):
+                        te, tr = out
+                        return (mesh_lib.device_get_tree(te),
+                                mesh_lib.device_get_tree(tr))
+
+                    def fin_score(host, tm, plan=plan, idx=idx, lo=lo,
+                                  hi=hi, chunk_id=chunk_id, cstate=cstate,
+                                  calibrate=calibrate):
+                        te, tr = host
+                        t_score = tm.dispatch_s + tm.compute_s \
+                            + tm.gather_s
+                        if not calibrate:
+                            cstate.pop("models", None)
+                        write_cells(plan, idx, lo, hi, chunk_id, te, tr,
+                                    cstate["t_fit"], t_score)
+
+                    yield LaunchItem(
+                        key=chunk_id + ":score", kind="score", group=gi,
+                        n_tasks=n_real, launch=launch_score,
+                        gather=gather_score, finalize=fin_score)
+
+                    if calibrate:
                         # calibration: a SECOND, warm score launch (the
                         # first's wall includes trace+compile) measures
                         # the steady-state score cost later fused chunks
-                        # attribute out of their single-launch wall
-                        t1 = time.perf_counter()
-                        jax.block_until_ready(score_jit(
-                            models, data_dev, test_dev, train_sc_dev,
-                            test_unw_dev, train_unw_dev))
-                        score_s_per_task = (time.perf_counter() - t1) \
-                            / ((hi - lo) * n_folds)
-                    del models
+                        # attribute out of their single-launch wall.
+                        # It is real device work: counted in n_launches
+                        # and score_wall_s (not in any candidate's
+                        # cells — sklearn never ran it)
 
-                # charge the launch wall to the REAL candidates in the
-                # chunk (not the padded lane count), so summing ALL
-                # per-split fit-time cells (mean_fit_time x n_splits over
-                # candidates) reconstructs the true device wall; XLA fuses
-                # all lanes into one program, so a finer per-candidate
-                # split is not measurable (ROADMAP)
-                fit_times[idx, :] = t_fit / ((hi - lo) * n_folds)
-                score_times[idx, :] = t_score / ((hi - lo) * n_folds)
-                for s in scorer_names:
-                    test_scores[s][idx, :] = np.asarray(te[s])[:hi - lo]
-                    if return_train:
-                        train_scores[s][idx, :] = \
-                            np.asarray(tr[s])[:hi - lo]
-                report["n_launches"] += 1
-                report["fit_wall_s"] += t_fit
-                report["score_wall_s"] += t_score
-                # per-compile-group walls: candidates in different groups
-                # (or chunks) carry genuinely different launch timings —
-                # only candidates fused into ONE launch share a
-                # per-launch average (XLA executes them as one program,
-                # so a finer split is not measurable; see ROADMAP)
-                pg = report.setdefault("per_group", {})
-                rec = pg.setdefault(gi, {"static_params": repr(
-                    group.static_params), "n_launches": 0,
-                    "fit_wall_s": 0.0, "score_wall_s": 0.0,
-                    "score_path": ("wide-fused" if fused else
-                                   "wide" if all_cores else "nested")})
-                rec["n_launches"] += 1
-                rec["fit_wall_s"] += t_fit
-                rec["score_wall_s"] += t_score
-                if fused and score_s_per_task is not None:
-                    rec["score_s_per_task_calibrated"] = round(
-                        score_s_per_task, 7)
-                if self.verbose > 1:
-                    self._print_task_end_lines(
-                        candidates, idx, n_folds, scorer_names,
-                        test_scores, train_scores, return_train,
-                        (t_fit + t_score) / ((hi - lo) * n_folds),
-                        fit_failed)
-                if ckpt is not None:
-                    ckpt.put(chunk_id, {
-                        "test": {s: test_scores[s][idx, :].tolist()
-                                 for s in scorer_names},
-                        "train": ({s: train_scores[s][idx, :].tolist()
-                                   for s in scorer_names}
-                                  if return_train else None),
-                        "fit_t": t_fit / ((hi - lo) * n_folds),
-                        "score_t": t_score / ((hi - lo) * n_folds),
-                        "failed": fit_failed[idx, :].tolist()})
+                        def launch_cal(payload, plan=plan,
+                                       cstate=cstate):
+                            return build_programs(plan)["score"](
+                                cstate.pop("models"), data_dev, test_dev,
+                                train_sc_dev, test_unw_dev,
+                                train_unw_dev)
+
+                        def fin_cal(host, tm, plan=plan, gstate=gstate,
+                                    lanes=lanes):
+                            wall = tm.dispatch_s + tm.compute_s
+                            # per PADDED lane: the launch computes
+                            # nc_batch lanes regardless of how many are
+                            # real, and fused chunks scale back up by
+                            # the same padded count
+                            gstate["sspt"] = wall / lanes
+                            report["n_launches"] += 1
+                            report["score_wall_s"] += wall
+                            rec = per_group_rec(plan)
+                            rec["n_launches"] += 1
+                            rec["score_wall_s"] += wall
+                            rec["score_s_per_task_calibrated"] = round(
+                                gstate["sspt"], 7)
+
+                        yield LaunchItem(
+                            key=chunk_id + ":calibrate", kind="calibrate",
+                            group=gi, n_tasks=n_real, launch=launch_cal,
+                            finalize=fin_cal)
+
+        try:
+            pipe.run(chunk_items())
+        finally:
+            # the compile thread traces under this search's jax config
+            # (e.g. temporarily-enabled x64): join it before returning
+            pipe.close()
+            pr = pipe.report()
+            cache1 = persistent_cache_counts()
+            pr["persistent_cache_hits"] = cache1["hits"] - cache0["hits"]
+            pr["persistent_cache_misses"] = \
+                cache1["misses"] - cache0["misses"]
+            # distinct traced-program constructions this search (program-
+            # cache misses; each is one python->jaxpr->HLO walk whether
+            # the compile then ran on the AOT thread or at jit dispatch)
+            pr["n_compiles"] = _program_build_count() - builds0
+            report["pipeline"] = pr
 
     def _print_task_end_lines(self, candidates, idx, n_folds, scorer_names,
                               test_scores, train_scores, return_train,
@@ -1675,7 +1998,17 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             "backend": "host", "n_tasks": len(tasks),
             "n_jobs": self.n_jobs if self.n_jobs is not None else 1}
 
+        from inspect import signature as _sig
+        _fs_params = _sig(_fit_and_score).parameters
+
         def run(params, train, test, callback_ctx):
+            # caller/callback_ctx exist only on the sklearn callback
+            # branch; stock releases reject unknown kwargs
+            extra = {}
+            if "caller" in _fs_params:
+                extra["caller"] = self
+            if "callback_ctx" in _fs_params:
+                extra["callback_ctx"] = callback_ctx
             return _fit_and_score(
                 clone(estimator), X, y, scorer=scorer_for_fs,
                 train=train, test=test, verbose=self.verbose,
@@ -1683,7 +2016,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 score_params=score_params or None,
                 return_train_score=self.return_train_score,
                 return_times=True, error_score=self.error_score,
-                caller=self, callback_ctx=callback_ctx)
+                **extra)
 
         ctxs = eval_ctxs if eval_ctxs is not None else [None] * len(tasks)
         n_jobs = self.n_jobs if self.n_jobs is not None else 1

@@ -16,13 +16,23 @@ from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
 
 
 class TpuSession:
-    """Holds the mesh + config a process uses for searches and fleets."""
+    """Holds the mesh + config a process uses for searches and fleets.
+
+    A session with `TpuConfig(compilation_cache_dir=...)` points jax's
+    persistent compilation cache there at construction, so every search
+    in the process — and every LATER process sharing the directory —
+    amortizes the python->HLO->binary walk (the session-level analog of
+    a Spark cluster reusing its deployed jars)."""
 
     def __init__(self, config: Optional[TpuConfig] = None,
                  appName: str = "spark-sklearn-tpu"):
+        from spark_sklearn_tpu.parallel.pipeline import (
+            enable_persistent_cache)
         self.appName = appName
         self.config = config or TpuConfig()
         self.mesh = build_mesh(self.config)
+        enable_persistent_cache(self.config.resolved_cache_dir(),
+                                self.config.persistent_cache_min_compile_s)
 
     @property
     def n_devices(self) -> int:

@@ -384,9 +384,15 @@ class TestReviewRegressions:
         ours = sst.GridSearchCV(pipe, {"clf__C": [1.0]}, cv=3,
                                 backend="tpu").fit(X, y)
         theirs = SkGS(pipe, {"clf__C": [1.0]}, cv=3).fit(X, y)
+        # sklearn's lbfgs exhausts max_iter here without converging
+        # (n_iter_=200), so both sides compare UNCONVERGED trajectories
+        # and the tolerance must absorb optimizer-version drift (~1e-2
+        # after a scipy/sklearn update).  The bug this guards against —
+        # with_mean=False forgetting to scale by std-about-the-mean —
+        # craters the score far beyond this band.
         np.testing.assert_allclose(
             ours.cv_results_["mean_test_score"],
-            theirs.cv_results_["mean_test_score"], atol=7e-3)
+            theirs.cv_results_["mean_test_score"], atol=2e-2)
 
     def test_converter_rejects_unsupported(self, digits):
         """Regression (round-5 update): family registration must not

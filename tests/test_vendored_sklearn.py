@@ -18,7 +18,13 @@ def test_upstream_search_suite_passes():
         known = [line.strip() for line in f if line.strip()]
     deselect = []
     for k in known:
-        deselect += ["--deselect", f"_upstream_test_search.py::{k}"]
+        # rootdir resolution differs by invocation (the repo pytest.ini
+        # anchors nodeids at the repo root even with cwd=vendored_tests)
+        # — pass both spellings; an unmatched deselect is ignored
+        deselect += [
+            "--deselect", f"_upstream_test_search.py::{k}",
+            "--deselect", f"vendored_tests/_upstream_test_search.py::{k}",
+        ]
     env = {**os.environ,
            "PYTHONPATH": os.pathsep.join(
                [os.path.dirname(HERE)]

@@ -16,13 +16,37 @@ from scipy.stats import bernoulli, expon, randint, uniform
 
 from sklearn import config_context
 from sklearn.base import BaseEstimator, ClassifierMixin, clone, is_classifier
-from sklearn.callback.tests._utils import (
-    MaxIterEstimator,
-    NoCallbackEstimator,
-    RecordingAutoPropagatedCallback,
-    RecordingCallback,
-    skip_callback_test_if_wasm,
-)
+try:
+    from sklearn.callback.tests._utils import (
+        MaxIterEstimator,
+        NoCallbackEstimator,
+        RecordingAutoPropagatedCallback,
+        RecordingCallback,
+        skip_callback_test_if_wasm,
+    )
+except ImportError:
+    # installed sklearn has no callback module (stock releases): keep the
+    # rest of the upstream suite runnable and skip only the callback
+    # tests.  The stubs exist because _searchcv_callback_test_cases
+    # instantiates them at parametrize time.
+    class MaxIterEstimator(BaseEstimator):
+        def __init__(self, max_iter=10):
+            self.max_iter = max_iter
+
+        def fit(self, X, y=None):
+            return self
+
+    class NoCallbackEstimator(MaxIterEstimator):
+        pass
+
+    class RecordingCallback:
+        pass
+
+    class RecordingAutoPropagatedCallback:
+        pass
+
+    skip_callback_test_if_wasm = pytest.mark.skip(
+        reason="sklearn.callback is not available in this sklearn")
 from sklearn.cluster import KMeans
 from sklearn.compose import ColumnTransformer
 from sklearn.datasets import (
@@ -2877,7 +2901,9 @@ def test_cv_results_multi_size_array():
 def test_array_api_search_cv_classifier(
     SearchCV, array_namespace, device_name, dtype_name
 ):
-    xp, device = _array_api_for_tests(array_namespace, device_name, dtype_name)
+    # installed sklearn's helper takes (namespace, device); the dtype
+    # argument of the branch this file was vendored from is gone
+    xp, device = _array_api_for_tests(array_namespace, device_name)
 
     X = np.arange(100).reshape((10, 10))
     X_np = X.astype(dtype_name)
