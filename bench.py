@@ -369,7 +369,7 @@ def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
     # reported MFU is a slight UNDERestimate of the fit-only figure.
     dev = jax.devices()[0]
     kind_label, peak = _peak_bf16_flops(getattr(dev, "device_kind", ""))
-    rep = getattr(gs2, "_search_report", {}) or {}
+    rep = gs2.search_report
     glm_flops, glm_iters = _glm_fit_flops(rep, n_samples, n_feat, n_classes)
     if glm_flops and dev_warm > 0:
         fit_wall = rep.get("fit_wall_s", dev_warm) or dev_warm
@@ -455,7 +455,7 @@ def leg_svc_mxu(cache_dir=None, n=10_000, d=784, folds=3, max_iter=100,
     # (sum semantics — the scan runs candidates sequentially, each at
     # its own count); the max_iter formula remains only as the fallback
     # upper bound and is labelled as such in the detail.
-    rep = getattr(svc, "_search_report", {}) or {}
+    rep = svc.search_report
     sum_lane_iters = sum(rep.get("solver_iters_sum_per_launch", []))
     base_flops = (2.0 * n * n * d + 40.0 * n * n) * n_cand
     if sum_lane_iters > 0:
@@ -711,6 +711,40 @@ _BREADTH_TOY_KWARGS = {
 }
 
 
+def _traced(leg_key, trace_dir, fn, **kwargs):
+    """Run one bench leg with the span tracer recording and export its
+    Chrome trace next to the other artifacts.  Returns (result,
+    trace_path); tracing failures never fail the leg."""
+    import time as _time
+
+    from spark_sklearn_tpu.obs.export import export_chrome_trace
+    from spark_sklearn_tpu.obs.trace import get_tracer
+
+    tracer = get_tracer()
+    was_on = tracer.enabled
+    if not was_on:
+        tracer.clear()
+        tracer.enable()
+    # an already-on tracer (SST_TRACE) keeps its cumulative buffer, so
+    # each leg's artifact exports only the events it recorded itself
+    t_leg0 = _time.perf_counter()
+    try:
+        result = fn(**kwargs)
+    finally:
+        path = os.path.join(trace_dir, f"trace_{leg_key}.json")
+        try:
+            export_chrome_trace(
+                path, events=[e for e in tracer.events()
+                              if e[2] >= t_leg0])
+        except Exception as exc:  # noqa: BLE001 — observability only
+            sys.stderr.write(f"trace export failed for {leg_key}: "
+                             f"{exc!r}\n")
+            path = None
+        if not was_on:
+            tracer.disable()
+    return result, path
+
+
 def run_child(platform):
     import jax
     if platform == "cpu":
@@ -739,9 +773,20 @@ def run_child(platform):
     else:
         cache_dir = tempfile.mkdtemp(prefix="sst_jax_cache_")
 
-    detail, fits_per_sec, vs_baseline = leg_headline(
+    # per-leg trace artifacts: each leg's JSON payload names the
+    # Perfetto-loadable Chrome trace the tracer exported for it
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+    else:
+        trace_dir = tempfile.mkdtemp(prefix="sst_traces_")
+
+    (detail, fits_per_sec, vs_baseline), headline_trace = _traced(
+        "headline", trace_dir, leg_headline,
         cache_dir=cache_dir, n_candidates=n_candidates,
         measure_bf16=on_tpu)
+    if headline_trace:
+        detail["trace_file"] = headline_trace
     if cache_reused:
         detail["compile_cache_reused"] = True  # cold wall excludes compile
 
@@ -780,7 +825,11 @@ def run_child(platform):
                 # rehearsal mode: same sequence, CPU-feasible shapes
                 kwargs = {**kwargs, **_BREADTH_TOY_KWARGS.get(key, {})}
             try:
-                detail[key] = fn(cache_dir=cache_dir, **kwargs)
+                leg_detail, leg_trace = _traced(
+                    key, trace_dir, fn, cache_dir=cache_dir, **kwargs)
+                if leg_trace and isinstance(leg_detail, dict):
+                    leg_detail["trace_file"] = leg_trace
+                detail[key] = leg_detail
             except Exception as exc:  # noqa: BLE001 — breadth only
                 detail[f"{key}_error"] = repr(exc)[:300]
             _emit(payload)  # superseding milestone after every leg

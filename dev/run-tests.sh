@@ -21,6 +21,31 @@ fi
 echo "== own tests (${1:---full}) =="
 python -m pytest tests/ -q "${MARK[@]}"
 
+echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
+OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
+JAX_PLATFORMS=cpu python - "$OBS_TRACE" <<'PY'
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+cfg = sst.TpuConfig(trace=sys.argv[1])
+gs = sst.GridSearchCV(LogisticRegression(max_iter=10),
+                      {"C": [0.1, 1.0, 10.0]}, cv=2, refit=False,
+                      backend="tpu", config=cfg)
+gs.fit(X, y)
+assert gs.search_report["backend"] == "tpu", gs.search_report
+print(f"obs smoke: trace exported to {sys.argv[1]}")
+PY
+# trace_summary exits nonzero when the trace holds no spans
+JAX_PLATFORMS=cpu python tools/trace_summary.py "$OBS_TRACE"
+rm -f "$OBS_TRACE"
+
 echo "== vendored upstream sklearn suite =="
 # explicit path: the vendored file keeps upstream's name under a
 # leading underscore, so pytest's test_*.py discovery skips it and a

@@ -1,0 +1,50 @@
+"""Observability subsystem — tracing, metrics, structured logging.
+
+The performance story of this engine (pipelined chunk launches, AOT
+compile-ahead, persistent compile caches) lives or dies on being able to
+*see* where wall-clock goes — the executor-timeline problem of
+distributed-Spark ML (arXiv:1612.01437) and the per-stage-visibility
+problem of MPMD pipeline schedulers (arXiv:2412.14374).  Four pieces:
+
+  - ``obs.trace``   — a low-overhead, thread-aware span tracer recording
+    into a bounded in-memory ring buffer (documented <2% overhead
+    budget, enforced by test; exactly zero recorded work when disabled);
+  - ``obs.export``  — Chrome trace-event JSON export: load the file in
+    Perfetto (https://ui.perfetto.dev) or ``chrome://tracing`` to see
+    the stage/dispatch/compute/gather threads, compile-group boundaries
+    and per-launch chunk spans on a shared timeline;
+  - ``obs.metrics`` — a registry of named counters/gauges/histograms
+    behind ``search_report``: the report's schema is pinned in ONE
+    place (``SEARCH_REPORT_SCHEMA``) instead of hand-assembled dicts;
+  - ``obs.log``     — a structured logger the ``verbose > 0`` paths
+    route through; its stdout-parity emit preserves sklearn's
+    ``[CV i/n] END ...`` line format byte-for-byte.
+
+Enable tracing per search with ``TpuConfig(trace=True)`` (record only)
+or ``TpuConfig(trace="out.json")`` (record + export), or process-wide
+with the ``SST_TRACE`` environment variable (``1`` or a path).
+"""
+
+from spark_sklearn_tpu.obs.trace import Tracer, get_tracer, search_tracing
+from spark_sklearn_tpu.obs.export import chrome_trace_events, export_chrome_trace
+from spark_sklearn_tpu.obs.metrics import (
+    SEARCH_REPORT_SCHEMA,
+    MetricsRegistry,
+    schema_markdown,
+    search_registry,
+)
+from spark_sklearn_tpu.obs.log import StructuredLogger, get_logger
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "search_tracing",
+    "chrome_trace_events",
+    "export_chrome_trace",
+    "MetricsRegistry",
+    "SEARCH_REPORT_SCHEMA",
+    "search_registry",
+    "schema_markdown",
+    "StructuredLogger",
+    "get_logger",
+]

@@ -1,0 +1,129 @@
+"""Chrome trace-event JSON export.
+
+Serializes the tracer's ring buffer into the Trace Event Format that
+Perfetto (https://ui.perfetto.dev) and ``chrome://tracing`` load
+directly: ``{"traceEvents": [...]}`` with
+
+  - ``ph: "X"`` complete events for thread-local spans (``ts``/``dur``
+    in microseconds, ``pid``/``tid`` integers, attributes in ``args``);
+  - ``ph: "b"``/``"e"`` async pairs for spans that may overlap on one
+    virtual track (per-launch chunk spans, compile-group boundaries);
+  - ``ph: "i"`` instants for zero-duration markers;
+  - ``ph: "M"`` metadata naming the process and each thread/track, so
+    the viewer shows ``sst-stage``/``sst-gather``/``sst-compile``/
+    ``device`` tracks by name.
+
+Timestamps are rebased to the earliest event so the viewer opens at
+t=0 regardless of process uptime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from spark_sklearn_tpu.obs.trace import Event, get_tracer
+
+__all__ = ["chrome_trace_events", "export_chrome_trace"]
+
+#: stable viewer ordering: the dispatching main thread first, then the
+#: pipeline workers, then the virtual tracks
+_SORT_HINTS = (
+    ("MainThread", 0),
+    ("sst-stage", 1),
+    ("sst-compile", 2),
+    ("sst-gather", 3),
+    ("device", 10),
+    ("launches", 11),
+    ("compile-groups", 12),
+)
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def _sort_index(track_name: str) -> int:
+    for prefix, idx in _SORT_HINTS:
+        if track_name.startswith(prefix):
+            return idx
+    return 5
+
+
+def chrome_trace_events(events: Optional[List[Event]] = None,
+                        pid: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Convert tracer events (default: the global tracer's buffer) to a
+    list of Chrome trace-event dicts."""
+    if events is None:
+        events = get_tracer().events()
+    pid = os.getpid() if pid is None else pid
+    out: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "spark_sklearn_tpu"},
+    }]
+    if not events:
+        return out
+    t_base = min(e[2] for e in events)
+    tids: Dict[Any, int] = {}
+
+    def tid_for(key: Any, tname: str) -> int:
+        # composite key: CPython recycles thread idents, so a later
+        # thread (e.g. the next search's sst-stage) can reuse a dead
+        # thread's ident — the name keeps their tracks separate
+        tkey = (key, tname)
+        tid = tids.get(tkey)
+        if tid is None:
+            tid = tids[tkey] = len(tids) + 1
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": str(tname)},
+            })
+            out.append({
+                "name": "thread_sort_index", "ph": "M", "pid": pid,
+                "tid": tid, "args": {"sort_index": _sort_index(str(tname))},
+            })
+        return tid
+
+    async_id = 0
+    for ph, name, t0, t1, key, tname, attrs in events:
+        tid = tid_for(key, tname)
+        ts = round((t0 - t_base) * 1e6, 3)
+        args = {k: _jsonable(v) for k, v in attrs.items()}
+        if ph == "X":
+            out.append({
+                "name": name, "cat": "sst", "ph": "X", "ts": ts,
+                "dur": round((t1 - t0) * 1e6, 3), "pid": pid, "tid": tid,
+                "args": args,
+            })
+        elif ph == "i":
+            out.append({
+                "name": name, "cat": "sst", "ph": "i", "s": "t", "ts": ts,
+                "pid": pid, "tid": tid, "args": args,
+            })
+        else:  # "b": async span -> b/e pair
+            async_id += 1
+            base = {"name": name, "cat": "sst-async", "pid": pid,
+                    "tid": tid, "id": async_id}
+            out.append({**base, "ph": "b", "ts": ts, "args": args})
+            out.append({**base, "ph": "e",
+                        "ts": round((t1 - t_base) * 1e6, 3)})
+    return out
+
+
+def export_chrome_trace(path: str,
+                        events: Optional[List[Event]] = None) -> str:
+    """Write a Perfetto/``chrome://tracing``-loadable JSON file and
+    return its path.  Parent directories are created as needed."""
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    payload = {
+        "traceEvents": chrome_trace_events(events),
+        "displayTimeUnit": "ms",
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path

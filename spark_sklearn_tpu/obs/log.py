@@ -1,0 +1,79 @@
+"""Structured logger — the `verbose > 0` paths' replacement for print().
+
+Two emit channels with different contracts:
+
+  - :meth:`StructuredLogger.print` — **stdout parity**: writes exactly
+    the given line via the builtin ``print`` (sklearn's ``[CV i/n] END
+    ...`` verbose format is pinned byte-for-byte by test), and mirrors
+    a structured record to the stdlib ``logging`` channel plus an
+    instant event into the tracer when one is recording — so verbose
+    output lands on the exported timeline next to the launches that
+    produced it.
+  - :meth:`StructuredLogger.info` / :meth:`StructuredLogger.debug` —
+    logging-channel only (never stdout): operational messages that have
+    no legacy print contract (pipeline per-launch records, session
+    bootstrap, compile-ahead fallbacks).
+
+Loggers live under the ``spark_sklearn_tpu.*`` namespace of the stdlib
+``logging`` module, so users attach handlers/levels the standard way.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from spark_sklearn_tpu.obs.trace import get_tracer
+
+__all__ = ["StructuredLogger", "get_logger"]
+
+
+class StructuredLogger:
+    """Thin wrapper pairing print-parity emits with structured
+    records."""
+
+    __slots__ = ("_log",)
+
+    def __init__(self, name: str):
+        self._log = logging.getLogger(name)
+
+    @property
+    def logger(self) -> logging.Logger:
+        return self._log
+
+    def print(self, msg: str, **fields: Any) -> None:
+        """Emit `msg` to stdout byte-for-byte (the legacy ``print()``
+        contract) and mirror it as a DEBUG logging record + a trace
+        instant carrying the structured fields."""
+        print(msg)
+        if self._log.isEnabledFor(logging.DEBUG):
+            self._log.debug("%s", msg,
+                            extra={"sst_fields": dict(fields)})
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant("log", message=msg, **fields)
+
+    def _emit(self, level: int, msg: str, args, fields: Dict[str, Any]):
+        if self._log.isEnabledFor(level):
+            self._log.log(level, msg, *args,
+                          extra={"sst_fields": dict(fields)})
+
+    def info(self, msg: str, *args: Any, **fields: Any) -> None:
+        self._emit(logging.INFO, msg, args, fields)
+
+    def debug(self, msg: str, *args: Any, **fields: Any) -> None:
+        self._emit(logging.DEBUG, msg, args, fields)
+
+    def warning(self, msg: str, *args: Any, **fields: Any) -> None:
+        self._emit(logging.WARNING, msg, args, fields)
+
+
+_LOGGERS: Dict[str, StructuredLogger] = {}
+
+
+def get_logger(name: str) -> StructuredLogger:
+    """Cached StructuredLogger for a dotted module name."""
+    lg = _LOGGERS.get(name)
+    if lg is None:
+        lg = _LOGGERS[name] = StructuredLogger(name)
+    return lg

@@ -1,0 +1,350 @@
+"""Metrics registry — ``search_report``'s schema pinned in one place.
+
+Before this module the search engine hand-assembled ``search_report``
+dicts in ``search/grid.py`` (and ``parallel/pipeline.py`` its
+``pipeline`` block): the schema lived implicitly in a dozen mutation
+sites.  Now every report key is declared once in
+:data:`SEARCH_REPORT_SCHEMA` (name, kind, description), the engine
+updates typed metric handles (counters / gauges / histograms / series /
+structs), and the report the user reads is the registry's rendered
+view — so the schema is documented from the same definitions the code
+writes through (``schema_markdown()`` feeds ``docs/API.md``).
+
+Backward compatibility contract: the rendered dict is key-for-key and
+value-type compatible with the pre-registry reports; a registry in
+strict mode (the default for ``search_registry``) refuses to create a
+metric whose name or kind is not declared, so the schema cannot drift
+silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, Optional
+
+__all__ = [
+    "MetricDef",
+    "MetricsRegistry",
+    "SEARCH_REPORT_SCHEMA",
+    "PIPELINE_BLOCK_SCHEMA",
+    "search_registry",
+    "schema_markdown",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricDef:
+    """One declared metric: its name, kind and human description."""
+
+    name: str
+    kind: str          # counter | gauge | histogram | series | struct | label
+    description: str
+    #: which backends emit it ("tpu", "host", "tpu,host")
+    backends: str = "tpu"
+
+
+#: the pinned schema of ``BaseSearchTPU.search_report``
+SEARCH_REPORT_SCHEMA = (
+    MetricDef(
+        "backend", "label",
+        "Execution tier that ran the search: 'tpu' (compiled, the "
+        "candidates x folds grid lowered onto the mesh) or 'host' "
+        "(sklearn `_fit_and_score` fanned out with joblib).",
+        backends="tpu,host"),
+    MetricDef(
+        "n_compile_groups", "gauge",
+        "Number of static-signature compile groups the candidate grid "
+        "partitioned into (one jitted program pair per group)."),
+    MetricDef(
+        "n_launches", "counter",
+        "Device launches executed (fit/score/calibrate/fused chunks; "
+        "resumed chunks do not launch)."),
+    MetricDef(
+        "n_chunks_resumed", "counter",
+        "Chunks whose results were restored from the checkpoint "
+        "instead of launched (TpuConfig.checkpoint_dir)."),
+    MetricDef(
+        "fit_wall_s", "gauge",
+        "Summed device wall attributed to fitting across all launches "
+        "(fused launches attribute out the calibrated score share)."),
+    MetricDef(
+        "score_wall_s", "gauge",
+        "Summed device wall attributed to scoring across all launches, "
+        "including the per-group warm calibration launch."),
+    MetricDef(
+        "mesh", "struct",
+        "Mesh geometry the search ran on: {'task': n_task_shards, "
+        "'data': n_data_shards}."),
+    MetricDef(
+        "per_group", "struct",
+        "Per-compile-group record: static_params (repr), n_launches, "
+        "fit_wall_s, score_wall_s, score_path (wide-fused/wide/nested) "
+        "and, when fused chunks calibrated, "
+        "score_s_per_task_calibrated."),
+    MetricDef(
+        "solver_iters_per_launch", "series",
+        "Per-launch max executed solver iterations over the launch's "
+        "lanes (lockstep semantics; -1 launches are omitted)."),
+    MetricDef(
+        "solver_iters_sum_per_launch", "series",
+        "Per-launch sum of executed solver iterations over lanes "
+        "(per-lane semantics for scan-sequential families)."),
+    MetricDef(
+        "lanes_per_launch", "series",
+        "Per-launch padded lane count (candidate x fold program "
+        "instances actually computed, including padding)."),
+    MetricDef(
+        "padding_waste", "histogram",
+        "Per-launch fraction of computed lanes that were padding "
+        "(chunk tail repeated to the group's uniform width) — the "
+        "price of one-compile-per-group chunking."),
+    MetricDef(
+        "pipeline", "struct",
+        "The chunk scheduler's timeline (see the pipeline-block schema "
+        "below): per-phase walls, overlap_frac, n_compiles, "
+        "n_precompiled, persistent-cache traffic and the per-launch "
+        "records."),
+    MetricDef(
+        "n_tasks", "gauge",
+        "Host tier: number of (candidate, fold) fit-and-score tasks.",
+        backends="host"),
+    MetricDef(
+        "n_jobs", "gauge",
+        "Host tier: joblib worker count the fan-out used.",
+        backends="host"),
+)
+
+#: sub-keys of ``search_report["pipeline"]`` (written by
+#: ``parallel.pipeline.ChunkPipeline.report`` plus the engine's cache /
+#: compile counters) — documented here so the whole report schema lives
+#: in one module.
+PIPELINE_BLOCK_SCHEMA = (
+    MetricDef("depth", "gauge",
+              "Pipeline depth the search ran at (0 = synchronous)."),
+    MetricDef("n_launches", "counter",
+              "Launches the pipeline executed."),
+    MetricDef("wall_s", "gauge", "The run's actual wall."),
+    MetricDef("stage_wall_s", "gauge",
+              "Sum of host staging walls (stage thread)."),
+    MetricDef("dispatch_wall_s", "gauge",
+              "Sum of dispatch walls (async enqueue; a first dispatch "
+              "includes trace+compile)."),
+    MetricDef("compute_wall_s", "gauge",
+              "Sum of device-occupancy estimates."),
+    MetricDef("gather_wall_s", "gauge",
+              "Sum of blocking device->host transfer walls."),
+    MetricDef("finalize_wall_s", "gauge",
+              "Sum of result-write/checkpoint walls."),
+    MetricDef("overlap_frac", "gauge",
+              "Host work hidden behind device compute, as a fraction "
+              "of all host work."),
+    MetricDef("n_precompiled", "counter",
+              "Programs the compile thread AOT-compiled ahead of "
+              "dispatch."),
+    MetricDef("n_compiles", "counter",
+              "Distinct traced-program constructions this search "
+              "(program-cache misses)."),
+    MetricDef("persistent_cache_hits", "counter",
+              "Persistent XLA compilation-cache hits during this "
+              "search."),
+    MetricDef("persistent_cache_misses", "counter",
+              "Persistent XLA compilation-cache misses during this "
+              "search."),
+    MetricDef("launches", "series",
+              "One record per launch: key, group, kind "
+              "(fit/score/calibrate/fused), n_tasks and per-phase "
+              "walls (stage_s/stage_wait_s/dispatch_s/compute_s/"
+              "gather_s/finalize_s)."),
+)
+
+
+class Counter:
+    """Monotonically increasing integer metric."""
+
+    __slots__ = ("_data", "name")
+
+    def __init__(self, data, name):
+        self._data = data
+        self.name = name
+
+    def inc(self, n: int = 1) -> None:
+        self._data[self.name] += n
+
+    @property
+    def value(self) -> int:
+        return self._data[self.name]
+
+
+class Gauge:
+    """Point-in-time numeric metric (settable and accumulable)."""
+
+    __slots__ = ("_data", "name")
+
+    def __init__(self, data, name):
+        self._data = data
+        self.name = name
+
+    def set(self, v) -> None:
+        self._data[self.name] = v
+
+    def add(self, v) -> None:
+        self._data[self.name] += v
+
+    @property
+    def value(self):
+        return self._data[self.name]
+
+
+class Label(Gauge):
+    """String-valued metric (e.g. the backend name)."""
+
+    __slots__ = ()
+
+
+class Histogram:
+    """Streaming summary of observations, rendered as a plain dict
+    {count, sum, mean, min, max} so the report stays JSON-able."""
+
+    __slots__ = ("_data", "name")
+
+    def __init__(self, data, name):
+        self._data = data
+        self.name = name
+
+    def observe(self, v: float) -> None:
+        h = self._data[self.name]
+        v = float(v)
+        h["count"] += 1
+        h["sum"] += v
+        h["min"] = v if h["min"] is None else min(h["min"], v)
+        h["max"] = v if h["max"] is None else max(h["max"], v)
+        h["mean"] = h["sum"] / h["count"]
+
+    @property
+    def value(self) -> Dict[str, Any]:
+        return self._data[self.name]
+
+
+_KIND_DEFAULTS = {
+    "counter": lambda: 0,
+    "gauge": lambda: 0.0,
+    "label": lambda: "",
+    "series": list,
+    "struct": dict,
+    "histogram": lambda: {"count": 0, "sum": 0.0, "mean": 0.0,
+                          "min": None, "max": None},
+}
+
+_KIND_HANDLES = {
+    "counter": Counter,
+    "gauge": Gauge,
+    "label": Label,
+    "histogram": Histogram,
+}
+
+
+class MetricsRegistry:
+    """Named metrics writing into one ordered dict (``.data``).
+
+    ``.data`` is the live rendered view: handing it to a consumer (the
+    ``search_report`` property) costs nothing and stays current as the
+    engine updates metrics mid-run.  In strict mode every metric must
+    be declared in the schema with a matching kind — the pin that stops
+    report drift.
+    """
+
+    def __init__(self, schema: Optional[Iterable[MetricDef]] = None,
+                 strict: Optional[bool] = None):
+        self._defs = {d.name: d for d in (schema or ())}
+        self._strict = bool(self._defs) if strict is None else strict
+        self.data: "OrderedDict[str, Any]" = OrderedDict()
+        self._handles: Dict[str, Any] = {}
+
+    # -- declaration / lookup -------------------------------------------
+    def _resolve(self, name: str, kind: str):
+        d = self._defs.get(name)
+        if d is None:
+            if self._strict:
+                raise KeyError(
+                    f"metric {name!r} is not declared in this registry's "
+                    "schema; add a MetricDef before writing it")
+        elif d.kind != kind:
+            raise TypeError(
+                f"metric {name!r} is declared as a {d.kind}, not a {kind}")
+        if name not in self.data:
+            self.data[name] = _KIND_DEFAULTS[kind]()
+
+    def _handle(self, name: str, kind: str):
+        h = self._handles.get(name)
+        if h is None:
+            self._resolve(name, kind)
+            h = self._handles[name] = _KIND_HANDLES[kind](self.data, name)
+        return h
+
+    def counter(self, name: str) -> Counter:
+        return self._handle(name, "counter")
+
+    def gauge(self, name: str) -> Gauge:
+        return self._handle(name, "gauge")
+
+    def label(self, name: str) -> Label:
+        return self._handle(name, "label")
+
+    def histogram(self, name: str) -> Histogram:
+        return self._handle(name, "histogram")
+
+    def series(self, name: str) -> list:
+        """The named append-only list itself (per-launch records)."""
+        self._resolve(name, "series")
+        return self.data[name]
+
+    def struct(self, name: str) -> dict:
+        """The named nested-dict value itself (mesh, per_group, ...)."""
+        self._resolve(name, "struct")
+        return self.data[name]
+
+    def put(self, name: str, value) -> None:
+        """Assign a struct wholesale (e.g. the pipeline block computed
+        by ChunkPipeline.report())."""
+        self._resolve(name, "struct")
+        self.data[name] = value
+
+    # -- rendering -------------------------------------------------------
+    def render(self) -> Dict[str, Any]:
+        """Plain-dict snapshot (shallow; series/struct values are the
+        live containers — copy before mutating)."""
+        return dict(self.data)
+
+    def describe(self) -> Iterable[MetricDef]:
+        return tuple(self._defs.values())
+
+
+def search_registry(backend: str) -> MetricsRegistry:
+    """A strict registry pre-declared with the search_report schema,
+    with the backend label already set (always the first key)."""
+    reg = MetricsRegistry(SEARCH_REPORT_SCHEMA)
+    reg.label("backend").set(backend)
+    return reg
+
+
+def schema_markdown() -> str:
+    """The search_report schema as a markdown section — the single
+    source `docs/API.md` renders (dev/build_api_docs.py)."""
+    out = [
+        "## `search_report` schema\n",
+        "\nRendered from `spark_sklearn_tpu.obs.metrics."
+        "SEARCH_REPORT_SCHEMA` — the same definitions the engine "
+        "writes through, so this table cannot drift from the code.\n",
+        "\n| key | kind | backend | description |\n",
+        "|---|---|---|---|\n",
+    ]
+    for d in SEARCH_REPORT_SCHEMA:
+        out.append(
+            f"| `{d.name}` | {d.kind} | {d.backends} | "
+            f"{d.description} |\n")
+    out.append("\n### `search_report[\"pipeline\"]` block\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in PIPELINE_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    return "".join(out)

@@ -1,0 +1,247 @@
+"""Span tracer — thread-aware, nestable, bounded, near-free when off.
+
+Design constraints (ISSUE 2 tentpole):
+
+  - **thread-aware**: every span records the thread it closed on, so
+    the pipeline's ``sst-stage`` / ``sst-gather`` / ``sst-compile``
+    workers and the dispatching main thread each get their own track in
+    the exported trace;
+  - **nestable**: ``tracer.span(...)`` is a context manager; nesting
+    follows Python's ``with`` stack, so spans on one thread are always
+    properly nested (the Chrome trace viewer infers the hierarchy from
+    timestamp containment);
+  - **monotonic timestamps**: ``time.perf_counter()`` throughout —
+    wall-clock adjustments can never produce negative durations;
+  - **bounded**: events land in a ``deque(maxlen=...)`` ring buffer
+    (default 65536); a pathological span storm evicts the oldest spans
+    instead of growing without bound;
+  - **overhead budget**: tracing OFF costs one attribute read per
+    instrumentation site (the shared no-op span is returned before any
+    allocation) and must be bit-exact with uninstrumented behavior;
+    tracing ON is budgeted at **<2% of search wall** — spans are
+    per-launch/per-phase (tens per search), never per-sample.  Both
+    sides are enforced by ``tests/test_obs.py``.
+
+Enablement: ``TpuConfig(trace=...)`` per search (``True`` records;
+a string records AND exports a Chrome trace there after ``fit``), or
+the ``SST_TRACE`` environment variable process-wide (``1``/``true`` to
+record, any other value is treated as an export path).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "search_tracing",
+]
+
+#: default ring-buffer capacity (events, not bytes)
+DEFAULT_BUFFER_SIZE = 65536
+
+#: event tuples: (ph, name, t0, t1, track_key, track_name, attrs)
+#:   ph "X" — complete span (t0..t1 on one thread or virtual track)
+#:   ph "i" — instant event (t1 is None)
+#:   ph "b" — async span (may overlap others on its virtual track;
+#:            the exporter emits a Chrome b/e pair)
+Event = Tuple[str, str, float, Optional[float], Any, str, Dict[str, Any]]
+
+
+class _NullSpan:
+    """Shared no-op span handed out when tracing is disabled — the
+    entire cost of an instrumentation site with tracing off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_attrs", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+        self._t0 = 0.0
+
+    def set(self, **attrs):
+        """Attach attributes after the span opened (e.g. results)."""
+        self._attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        th = threading.current_thread()
+        # deque.append is atomic under the GIL: no lock on the hot path
+        self._tracer._events.append(
+            ("X", self._name, self._t0, t1, th.ident, th.name, self._attrs))
+        return False
+
+
+class Tracer:
+    """Recorder of spans/instants into a bounded ring buffer.
+
+    One process-global instance (``get_tracer()``) is shared by every
+    instrumented layer; tests may construct private ones.
+    """
+
+    def __init__(self, max_events: int = DEFAULT_BUFFER_SIZE):
+        self._events: deque = deque(maxlen=max_events)
+        self._enabled = False
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def max_events(self) -> int:
+        return self._events.maxlen or 0
+
+    def enable(self, max_events: Optional[int] = None) -> None:
+        if max_events and max_events != self._events.maxlen:
+            self._events = deque(self._events, maxlen=int(max_events))
+        self._enabled = True
+
+    def disable(self) -> None:
+        """Stop recording; already-recorded events stay exportable."""
+        self._enabled = False
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    # -- recording -------------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Context manager timing a block on the current thread."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker on the current thread."""
+        if not self._enabled:
+            return
+        th = threading.current_thread()
+        self._events.append(
+            ("i", name, time.perf_counter(), None, th.ident, th.name, attrs))
+
+    def record_span(self, name: str, t0: float, t1: float,
+                    track: Optional[str] = None, **attrs) -> None:
+        """Retroactively record a span from explicit perf_counter
+        timestamps — on the current thread, or on a named virtual track
+        (e.g. the ``device`` occupancy track).  Spans on one virtual
+        track must not overlap; use :meth:`record_async` when they can.
+        """
+        if not self._enabled:
+            return
+        if track is None:
+            th = threading.current_thread()
+            key, tname = th.ident, th.name
+        else:
+            key = tname = track
+        self._events.append(("X", name, t0, t1, key, tname, attrs))
+
+    def record_async(self, name: str, t0: float, t1: float, track: str,
+                     **attrs) -> None:
+        """Record a possibly-overlapping span on a virtual track (the
+        exporter emits a Chrome async b/e pair, which the viewers lay
+        out on parallel lanes)."""
+        if not self._enabled:
+            return
+        self._events.append(("b", name, t0, t1, track, track, attrs))
+
+    # -- consumption -----------------------------------------------------
+    def events(self) -> List[Event]:
+        """Snapshot of the ring buffer, oldest first."""
+        return list(self._events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer every instrumented layer records to."""
+    return _GLOBAL
+
+
+def _env_spec() -> Tuple[bool, Optional[str]]:
+    """(enabled, export_path) requested by the SST_TRACE env var."""
+    v = os.environ.get("SST_TRACE", "").strip()
+    if not v or v.lower() in ("0", "false", "off", "no"):
+        return False, None
+    if v.lower() in ("1", "true", "on", "yes"):
+        return True, None
+    return True, v
+
+
+def _config_spec(config) -> Tuple[bool, Optional[str]]:
+    """(enabled, export_path) requested by TpuConfig.trace."""
+    spec = getattr(config, "trace", None) if config is not None else None
+    if isinstance(spec, str) and spec:
+        return True, spec
+    return bool(spec), None
+
+
+@contextlib.contextmanager
+def search_tracing(config=None):
+    """Scope the global tracer to one search.
+
+    Enables recording when ``TpuConfig(trace=...)`` or ``SST_TRACE``
+    asks for it (clearing the buffer so the export covers exactly this
+    search), exports a Chrome trace afterwards when a path was given,
+    and restores the tracer's prior state — a tracer something else
+    enabled (a bench harness, an outer search) is never cleared or
+    disabled here.
+    """
+    cfg_on, cfg_path = _config_spec(config)
+    env_on, env_path = _env_spec()
+    path = cfg_path or env_path
+    tracer = _GLOBAL
+    we_enabled = (cfg_on or env_on) and not tracer.enabled
+    if we_enabled:
+        tracer.clear()
+        tracer.enable(max_events=getattr(config, "trace_buffer_size", None))
+    try:
+        yield tracer
+    finally:
+        if path and (tracer.enabled or we_enabled):
+            from spark_sklearn_tpu.obs.export import export_chrome_trace
+            try:
+                export_chrome_trace(path)
+            except OSError:
+                from spark_sklearn_tpu.obs.log import get_logger
+                get_logger(__name__).debug(
+                    "trace export to %r failed", path)
+        if we_enabled:
+            tracer.disable()
+
+
+# process-wide opt-in via environment (import-time, so even code that
+# never constructs a TpuConfig records)
+if _env_spec()[0]:
+    _GLOBAL.enable()

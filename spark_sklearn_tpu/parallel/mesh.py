@@ -25,6 +25,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_sklearn_tpu.obs.trace import get_tracer
+
 TASK_AXIS = "task"
 DATA_AXIS = "data"
 
@@ -96,6 +98,16 @@ class TpuConfig:
     # lockstep iterations.  Same compiled program, same cv_results_
     # order; False restores single-width unsorted chunking.
     sort_candidates: bool = True
+    # span tracing (obs/): record host-side spans of the search into
+    # the in-memory ring buffer.  None defers to the SST_TRACE env var;
+    # True records (export later via obs.export.export_chrome_trace);
+    # a string records AND writes a Perfetto/chrome://tracing-loadable
+    # trace to that path after each fit.  Off is bit-exact with
+    # untraced behavior; on is budgeted <2% overhead (obs/trace.py,
+    # enforced by test).
+    trace: Any = None
+    # tracer ring-buffer capacity (events) while this search records
+    trace_buffer_size: int = 65536
     # fold fit + NaN-health + scoring into ONE compiled launch per chunk
     # (models never reach the host; XLA fuses the scoring epilogue into
     # the solver).  Timing contract (sklearn _search.py fit/score time
@@ -127,19 +139,21 @@ def build_mesh(config: Optional[TpuConfig] = None) -> Mesh:
     `n_data_shards` asks for in-fit data parallelism.
     """
     config = config or TpuConfig()
-    devices = config.resolve_devices()
-    n = len(devices)
-    nd = max(1, config.n_data_shards)
-    if n % nd != 0:
-        raise ValueError(
-            f"n_data_shards={nd} does not divide device count {n}")
-    nt = config.n_task_shards or (n // nd)
-    if nt * nd != n:
-        raise ValueError(
-            f"mesh {nt}x{nd} != {n} devices; set n_task_shards/n_data_shards "
-            f"so their product equals the device count")
-    dev_array = np.asarray(devices).reshape(nt, nd)
-    return Mesh(dev_array, axis_names=(TASK_AXIS, DATA_AXIS))
+    with get_tracer().span("build_mesh"):
+        devices = config.resolve_devices()
+        n = len(devices)
+        nd = max(1, config.n_data_shards)
+        if n % nd != 0:
+            raise ValueError(
+                f"n_data_shards={nd} does not divide device count {n}")
+        nt = config.n_task_shards or (n // nd)
+        if nt * nd != n:
+            raise ValueError(
+                f"mesh {nt}x{nd} != {n} devices; set "
+                "n_task_shards/n_data_shards so their product equals "
+                "the device count")
+        dev_array = np.asarray(devices).reshape(nt, nd)
+        return Mesh(dev_array, axis_names=(TASK_AXIS, DATA_AXIS))
 
 
 def replicate(mesh: Mesh, *arrays):
@@ -147,7 +161,8 @@ def replicate(mesh: Mesh, *arrays):
     `sc.broadcast`.  One transfer per device over ICI; no BitTorrent, no
     pickle (reference: grid_search.py X_bc = sc.broadcast(X))."""
     sharding = NamedSharding(mesh, P())
-    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    with get_tracer().span("device_put.replicate", n_arrays=len(arrays)):
+        out = tuple(jax.device_put(a, sharding) for a in arrays)
     return out[0] if len(out) == 1 else out
 
 
@@ -156,7 +171,9 @@ def shard_leading(mesh: Mesh, *arrays, axis: str = TASK_AXIS):
     sc.parallelize(indexed_param_grid, n): each device owns a contiguous
     stripe of the task grid."""
     sharding = NamedSharding(mesh, P(axis))
-    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    with get_tracer().span("device_put.shard", n_arrays=len(arrays),
+                           axis=axis):
+        out = tuple(jax.device_put(a, sharding) for a in arrays)
     return out[0] if len(out) == 1 else out
 
 
@@ -183,7 +200,8 @@ def device_get_tree(x):
     collect() back to the driver, except every host gets the result).
     Single-process: plain device_get, zero overhead."""
     if jax.process_count() == 1:
-        return jax.device_get(x)
+        with get_tracer().span("device_get"):
+            return jax.device_get(x)
     from jax.experimental import multihost_utils
 
     def one(a):
@@ -192,4 +210,5 @@ def device_get_tree(x):
                 multihost_utils.process_allgather(a, tiled=True))
         return jax.device_get(a)
 
-    return jax.tree_util.tree_map(one, x)
+    with get_tracer().span("device_get.allgather"):
+        return jax.tree_util.tree_map(one, x)

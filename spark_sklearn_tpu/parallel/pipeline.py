@@ -47,6 +47,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.trace import get_tracer
+
+_slog = get_logger(__name__)
+
 __all__ = [
     "ChunkPipeline",
     "LaunchItem",
@@ -190,17 +195,23 @@ class ChunkPipeline:
     depth 1, deeper lookahead beyond.
     """
 
-    def __init__(self, depth: int = 2):
+    def __init__(self, depth: int = 2, verbose: int = 0):
         self.depth = max(0, int(depth))
+        self.verbose = int(verbose)
         self.timeline: List[Dict[str, Any]] = []
         self._wall_t0: Optional[float] = None
         self._wall_s = 0.0
         self._n_precompiled = 0
         self._compile_executor: Optional[ThreadPoolExecutor] = None
         self._compile_futures: List[Future] = []
+        self._tracer = get_tracer()
+        # per compile group: [first dispatch t, last finalize t] — the
+        # compile-group boundary spans of the exported trace
+        self._group_bounds: Dict[int, List[float]] = {}
 
     # -- compile-ahead ---------------------------------------------------
-    def submit_precompile(self, jit_fn, *args) -> Optional[Future]:
+    def submit_precompile(self, jit_fn, *args,
+                          label: str = "") -> Optional[Future]:
         """Queue an AOT lower+compile on the compile thread (pipelined
         mode only; at depth 0 programs compile where they always did —
         at first dispatch).  Returns a Future of the executable, or None
@@ -212,7 +223,8 @@ class ChunkPipeline:
                 max_workers=1, thread_name_prefix="sst-compile")
 
         def job():
-            exe = precompile(jit_fn, *args)
+            with self._tracer.span("compile", label=label):
+                exe = precompile(jit_fn, *args)
             self._n_precompiled += 1
             return exe
 
@@ -235,6 +247,13 @@ class ChunkPipeline:
         finally:
             self._wall_s += time.perf_counter() - self._wall_t0
             self._wall_t0 = None
+            # compile-group boundary spans (async: group g+1's first
+            # stage may overlap group g's last finalize)
+            for g, (t0, t1) in sorted(self._group_bounds.items()):
+                self._tracer.record_async(
+                    f"compile-group {g}", t0, t1, track="compile-groups",
+                    group=g)
+            self._group_bounds.clear()
 
     def close(self) -> None:
         """Join the compile thread (AOT jobs trace under the caller's
@@ -279,7 +298,7 @@ class ChunkPipeline:
 
     # -- internals -------------------------------------------------------
     def _record(self, item: LaunchItem, tm: LaunchTimings) -> None:
-        self.timeline.append({
+        rec = {
             "key": item.key, "group": item.group, "kind": item.kind,
             "n_tasks": item.n_tasks,
             "stage_s": round(tm.stage_s, 6),
@@ -288,31 +307,72 @@ class ChunkPipeline:
             "compute_s": round(tm.compute_s, 6),
             "gather_s": round(tm.gather_s, 6),
             "finalize_s": round(tm.finalize_s, 6),
-        })
+        }
+        self.timeline.append(rec)
+        if self.verbose > 0:
+            # logging channel only (never stdout: launch records have
+            # no legacy print contract to preserve)
+            _slog.debug(
+                "launch %s kind=%s group=%d compute=%.4fs gather=%.4fs",
+                item.key, item.kind, item.group, tm.compute_s,
+                tm.gather_s, **rec)
+
+    def _note_group(self, group: int, t0: float, t1: float) -> None:
+        if not self._tracer.enabled:
+            return
+        b = self._group_bounds.get(group)
+        if b is None:
+            self._group_bounds[group] = [t0, t1]
+        else:
+            b[0] = min(b[0], t0)
+            b[1] = max(b[1], t1)
 
     def _run_sync(self, items) -> None:
+        tr = self._tracer
         for item in items:
             tm = LaunchTimings()
             t0 = time.perf_counter()
-            staged = item.stage() if item.stage is not None else None
+            if item.stage is not None:
+                with tr.span("stage", key=item.key, kind=item.kind,
+                             group=item.group):
+                    staged = item.stage()
+            else:
+                staged = None
             t1 = time.perf_counter()
             tm.stage_s = t1 - t0
-            out = item.launch(staged)
+            with tr.span("dispatch", key=item.key, kind=item.kind,
+                         group=item.group):
+                out = item.launch(staged)
             t2 = time.perf_counter()
             tm.dispatch_s = t2 - t1
-            jax.block_until_ready(out)
+            with tr.span("compute.wait", key=item.key):
+                jax.block_until_ready(out)
             t3 = time.perf_counter()
             tm.compute_s = t3 - t2
-            host = item.gather(out) if item.gather is not None else None
+            tr.record_span("compute", t2, t3, track="device",
+                           key=item.key, kind=item.kind, group=item.group)
+            if item.gather is not None:
+                with tr.span("gather", key=item.key):
+                    host = item.gather(out)
+            else:
+                host = None
             t4 = time.perf_counter()
             tm.gather_s = t4 - t3
             if item.finalize is not None:
-                item.finalize(host, tm)
+                with tr.span("finalize", key=item.key):
+                    item.finalize(host, tm)
             tm.finalize_s = time.perf_counter() - t4
+            t_end = time.perf_counter()
+            tr.record_async(f"launch {item.key}", t1, t_end,
+                            track="launches", key=item.key,
+                            kind=item.kind, group=item.group,
+                            n_tasks=item.n_tasks)
+            self._note_group(item.group, t1, t_end)
             self._record(item, tm)
 
     def _run_pipelined(self, items) -> None:
         depth = self.depth
+        tr = self._tracer
         stage_ex = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="sst-stage")
         gather_ex = ThreadPoolExecutor(
@@ -325,9 +385,11 @@ class ChunkPipeline:
         it = iter(items)
         exhausted = False
 
-        def staged_call(stage_fn):
+        def staged_call(item):
             t0 = time.perf_counter()
-            payload = stage_fn()
+            with tr.span("stage", key=item.key, kind=item.kind,
+                         group=item.group):
+                payload = item.stage()
             return payload, time.perf_counter() - t0
 
         def top_up():
@@ -338,21 +400,36 @@ class ChunkPipeline:
                 except StopIteration:
                     exhausted = True
                     return
-                fut = (stage_ex.submit(staged_call, nxt.stage)
+                fut = (stage_ex.submit(staged_call, nxt)
                        if nxt.stage is not None else None)
                 staged.append((nxt, fut))
 
-        def gather_job(item, out, t_dispatched, tm):
-            jax.block_until_ready(out)
+        def gather_job(item, out, t_dispatch0, t_dispatched, tm):
+            with tr.span("compute.wait", key=item.key):
+                jax.block_until_ready(out)
             t_ready = time.perf_counter()
-            tm.compute_s = t_ready - max(t_dispatched, last_ready[0])
+            t_head = max(t_dispatched, last_ready[0])
+            tm.compute_s = t_ready - t_head
             last_ready[0] = t_ready
-            host = item.gather(out) if item.gather is not None else None
+            tr.record_span("compute", t_head, t_ready, track="device",
+                           key=item.key, kind=item.kind, group=item.group)
+            if item.gather is not None:
+                with tr.span("gather", key=item.key):
+                    host = item.gather(out)
+            else:
+                host = None
             t_got = time.perf_counter()
             tm.gather_s = t_got - t_ready
             if item.finalize is not None:
-                item.finalize(host, tm)
+                with tr.span("finalize", key=item.key):
+                    item.finalize(host, tm)
             tm.finalize_s = time.perf_counter() - t_got
+            t_end = time.perf_counter()
+            tr.record_async(f"launch {item.key}", t_dispatch0, t_end,
+                            track="launches", key=item.key,
+                            kind=item.kind, group=item.group,
+                            n_tasks=item.n_tasks)
+            self._note_group(item.group, t_dispatch0, t_end)
             self._record(item, tm)
 
         try:
@@ -367,11 +444,13 @@ class ChunkPipeline:
                     payload, tm.stage_s = fut.result()
                 t1 = time.perf_counter()
                 tm.stage_wait_s = t1 - t0
-                out = item.launch(payload)
+                with tr.span("dispatch", key=item.key, kind=item.kind,
+                             group=item.group):
+                    out = item.launch(payload)
                 t2 = time.perf_counter()
                 tm.dispatch_s = t2 - t1
                 inflight.append(
-                    gather_ex.submit(gather_job, item, out, t2, tm))
+                    gather_ex.submit(gather_job, item, out, t1, t2, tm))
                 while len(inflight) > depth:
                     inflight.popleft().result()
             while inflight:

@@ -18,9 +18,12 @@ the same grid must become *arrays*:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+from spark_sklearn_tpu.obs.trace import get_tracer
 
 
 @dataclasses.dataclass
@@ -58,6 +61,7 @@ def build_compile_groups(
     learning_rate_init).  Anything else — and any dynamic-name whose value is
     non-numeric (e.g. C="auto") — is static for that candidate.
     """
+    t_span0 = time.perf_counter()
     dynamic_names = set(dynamic_names or ())
     dynamic_dtypes = dict(dynamic_dtypes or {})
     groups: Dict[Tuple, Dict[str, Any]] = {}
@@ -99,6 +103,9 @@ def build_compile_groups(
         )
     # deterministic order: by first candidate index
     out.sort(key=lambda g: g.candidate_indices[0])
+    get_tracer().record_span(
+        "build_compile_groups", t_span0, time.perf_counter(),
+        n_candidates=len(candidate_params), n_groups=len(out))
     return out
 
 
@@ -110,13 +117,14 @@ def pad_chunk(arr: np.ndarray, lo: int, hi: int, width: int,
     many times (the task-batched layout's candidate-major fold axis).
     Pure host work: this is the "candidate stacking" phase the pipeline
     runs on its stage thread."""
-    chunk = arr[lo:hi]
-    if len(chunk) != width:
-        chunk = np.concatenate(
-            [chunk, np.repeat(chunk[-1:], width - len(chunk), axis=0)])
-    if repeat > 1:
-        chunk = np.repeat(chunk, repeat, axis=0)
-    return chunk
+    with get_tracer().span("pad_chunk", lo=lo, hi=hi, width=width):
+        chunk = arr[lo:hi]
+        if len(chunk) != width:
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[-1:], width - len(chunk), axis=0)])
+        if repeat > 1:
+            chunk = np.repeat(chunk, repeat, axis=0)
+        return chunk
 
 
 def freeze(v: Any, strict: bool = False):

@@ -63,12 +63,14 @@ from spark_sklearn_tpu.search.scorers import (
     resolve_scoring,
 )
 from spark_sklearn_tpu.utils.native import fold_masks
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.metrics import search_registry
+from spark_sklearn_tpu.obs.trace import get_tracer, search_tracing
 
 
 import contextlib as _contextlib
-import logging
 
-logger = logging.getLogger("spark_sklearn_tpu.search")
+logger = get_logger("spark_sklearn_tpu.search")
 _nullcontext = _contextlib.nullcontext
 
 
@@ -260,6 +262,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         fit/score wall).  Stored privately so fit() only adds underscore-
         prefixed/suffixed attributes, per sklearn's estimator checks.
 
+        The report is the rendered view of a typed metrics registry —
+        its full schema (every key, kind and meaning) is pinned in
+        ``spark_sklearn_tpu.obs.metrics.SEARCH_REPORT_SCHEMA`` and
+        rendered into ``docs/API.md``.
+
         Compiled searches additionally carry ``report["pipeline"]`` — the
         chunk scheduler's timeline (parallel/pipeline.py):
 
@@ -282,7 +289,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             compile; see TpuConfig.compilation_cache_dir).
         """
         if not hasattr(self, "_search_report"):
-            raise AttributeError("search_report is set by fit()")
+            from sklearn.exceptions import NotFittedError
+
+            # NotFittedError subclasses AttributeError, so hasattr()
+            # and legacy `except AttributeError` callers keep working
+            raise NotFittedError(
+                f"This {type(self).__name__} instance is not fitted yet; "
+                "search_report is set by fit(). Call 'fit' with "
+                "appropriate arguments first.")
         return self._search_report
 
     # -- candidate generation -------------------------------------------
@@ -406,7 +420,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # teardown of attached callbacks is guaranteed even when fit
         # raises (sklearn wraps fit the same way via _fit_context)
         with callback_management_context(self):
-            return self._fit_impl(X, y, params)
+            # span tracing scoped to this search: recording only when
+            # TpuConfig(trace=...)/SST_TRACE asks; exact no-op otherwise
+            with search_tracing(self.config) as tracer:
+                with tracer.span(
+                        "search.fit", search=type(self).__name__,
+                        estimator=type(self.estimator).__name__):
+                    return self._fit_impl(X, y, params)
 
     def _fit_impl(self, X, y, params):
         estimator = self.estimator
@@ -540,9 +560,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         def evaluate_candidates(candidate_params, callback_ctx=None):
             cands = list(candidate_params)
             if self.verbose > 0:
-                print(f"Fitting {self.n_splits_} folds for each of "
-                      f"{len(cands)} candidates, totalling "
-                      f"{self.n_splits_ * len(cands)} fits")
+                # structured logger, stdout-parity channel: the line is
+                # byte-for-byte sklearn's (BaseSearchCV.fit)
+                logger.print(
+                    f"Fitting {self.n_splits_} folds for each of "
+                    f"{len(cands)} candidates, totalling "
+                    f"{self.n_splits_ * len(cands)} fits",
+                    n_splits=self.n_splits_, n_candidates=len(cands))
             if not cands:
                 if not acc["params"]:
                     raise ValueError(
@@ -638,7 +662,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 task_name="refit-with-best-params")
             t0 = time.perf_counter()
             with refit_subctx.propagate_callback_context(
-                    self.best_estimator_):
+                    self.best_estimator_), \
+                    get_tracer().span("refit",
+                                      estimator=type(
+                                          self.best_estimator_).__name__):
                 refit_subctx.call_on_fit_task_begin(
                     estimator=self, X=X, y=y, metadata=metadata_callbacks)
                 if y is not None:
@@ -994,7 +1021,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # like a raising est.fit (upstream test_search_cv_timing).
         # set_params stays outside the try: unknown param KEYS abort the
         # whole search, as in sklearn.
-        preval_failed, preval_exc = self._prevalidate_candidates(candidates)
+        with get_tracer().span("prevalidate", n_candidates=len(candidates)):
+            preval_failed, preval_exc = \
+                self._prevalidate_candidates(candidates)
         if preval_exc is not None and isinstance(self.error_score, str) \
                 and self.error_score == "raise":
             # marker consumed by _dispatch: re-raise instead of the usual
@@ -1030,6 +1059,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         repl = mesh_lib.replicated_sharding(mesh)
         task_shard = mesh_lib.task_sharding(mesh)
 
+        _t_upload0 = time.perf_counter()
         if config.n_data_shards > 1:
             # large-X mode: shard samples over the "data" mesh axis instead
             # of replicating (the TPU-native answer to X not fitting one
@@ -1076,6 +1106,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             train_unw_dev = jax.device_put(train_masks, put_masks)
         else:
             test_unw_dev, train_unw_dev = test_dev, train_sc_dev
+        get_tracer().record_span(
+            "device_put.broadcast", _t_upload0, time.perf_counter(),
+            n_samples=n_samples, n_data_shards=config.n_data_shards)
 
         test_scores = {s: np.empty((n_cand, n_folds)) for s in scorer_names}
         train_scores = ({s: np.empty((n_cand, n_folds))
@@ -1125,12 +1158,20 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             profiler_cm.__enter__()
         debug_ctx = (jax.debug_nans(True) if config.debug_nans
                      else _nullcontext())
-        self._search_report = {
-            "backend": "tpu", "n_compile_groups": len(groups),
-            "n_launches": 0, "n_chunks_resumed": 0,
-            "fit_wall_s": 0.0, "score_wall_s": 0.0,
-            "mesh": {"task": n_task_shards,
-                     "data": config.n_data_shards}}
+        # search_report = the rendered view of a typed registry whose
+        # schema lives in obs.metrics.SEARCH_REPORT_SCHEMA (keys
+        # materialize here in the legacy order, so the report is
+        # key-for-key identical to the pre-registry dict)
+        metrics = search_registry("tpu")
+        metrics.gauge("n_compile_groups").set(len(groups))
+        metrics.counter("n_launches")
+        metrics.counter("n_chunks_resumed")
+        metrics.gauge("fit_wall_s")
+        metrics.gauge("score_wall_s")
+        metrics.struct("mesh").update(
+            {"task": n_task_shards, "data": config.n_data_shards})
+        self._search_metrics = metrics
+        self._search_report = metrics.data
 
         # bound peak HBM: chunk each compile group so one launch holds at
         # most max_tasks_per_batch (candidate x fold) program instances;
@@ -1269,7 +1310,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 mesh, P(mesh_lib.TASK_AXIS, mesh_lib.DATA_AXIS))
         else:
             tb_mask_shard = task_shard
-        report = self._search_report
+        metrics = self._search_metrics
         donate = bool(config.donate_chunk_buffers)
 
         # score path: every registry scorer decomposes into model views
@@ -1543,7 +1584,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # same order as its peers; the synchronous schedule guarantees
         # that, the pipelined one does not — so multihost forces depth 0
         depth = config.pipeline_depth if jax.process_count() == 1 else 0
-        pipe = ChunkPipeline(depth)
+        pipe = ChunkPipeline(depth, verbose=self.verbose)
 
         def submit_precompile(plan):
             """AOT-lower/compile the group's fused program on the compile
@@ -1576,7 +1617,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     w_spec = fit_dev
                 plan["aot_future"] = pipe.submit_precompile(
                     progs["fused"], dyn_spec, data_dev, w_spec,
-                    test_dev, train_sc_dev, test_unw_dev, train_unw_dev)
+                    test_dev, train_sc_dev, test_unw_dev, train_unw_dev,
+                    label=f"fused group {plan['gi']}")
             except Exception as exc:   # AOT is an optimization only
                 logger.debug("fused precompile submission failed: %r", exc)
 
@@ -1628,9 +1670,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 if return_train:
                     train_scores[s][idx, :] = \
                         np.asarray(tr[s])[:hi - lo]
-            report["n_launches"] += 1
-            report["fit_wall_s"] += t_fit
-            report["score_wall_s"] += t_score
+            metrics.counter("n_launches").inc()
+            metrics.gauge("fit_wall_s").add(t_fit)
+            metrics.gauge("score_wall_s").add(t_score)
+            lanes_launch = plan["nc_batch"] * n_folds
+            metrics.histogram("padding_waste").observe(
+                (lanes_launch - n_real) / lanes_launch)
             # per-compile-group walls: candidates in different groups
             # (or chunks) carry genuinely different launch timings —
             # only candidates fused into ONE launch share a per-launch
@@ -1657,7 +1702,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     "failed": fit_failed[idx, :].tolist()})
 
         def per_group_rec(plan):
-            pg = report.setdefault("per_group", {})
+            pg = metrics.struct("per_group")
             return pg.setdefault(plan["gi"], {
                 "static_params": repr(plan["group"].static_params),
                 "n_launches": 0, "fit_wall_s": 0.0, "score_wall_s": 0.0,
@@ -1665,11 +1710,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                "wide" if all_cores else "nested")})
 
         def record_iters(it_max, it_sum, lanes):
-            report.setdefault("solver_iters_per_launch", []).append(
-                int(it_max))
-            report.setdefault("solver_iters_sum_per_launch", []).append(
+            metrics.series("solver_iters_per_launch").append(int(it_max))
+            metrics.series("solver_iters_sum_per_launch").append(
                 int(it_sum))
-            report.setdefault("lanes_per_launch", []).append(int(lanes))
+            metrics.series("lanes_per_launch").append(int(lanes))
 
         def chunk_items():
             """Yield this search's LaunchItems in dispatch order.  Runs
@@ -1706,7 +1750,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         if rec.get("failed") is not None:
                             fit_failed[idx, :] |= np.asarray(
                                 rec["failed"], bool)
-                        report["n_chunks_resumed"] += 1
+                        metrics.counter("n_chunks_resumed").inc()
                         continue
                     live_seen += 1
                     n_real = (hi - lo) * n_folds
@@ -1879,8 +1923,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             # real, and fused chunks scale back up by
                             # the same padded count
                             gstate["sspt"] = wall / lanes
-                            report["n_launches"] += 1
-                            report["score_wall_s"] += wall
+                            metrics.counter("n_launches").inc()
+                            metrics.gauge("score_wall_s").add(wall)
                             rec = per_group_rec(plan)
                             rec["n_launches"] += 1
                             rec["score_wall_s"] += wall
@@ -1907,7 +1951,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # cache misses; each is one python->jaxpr->HLO walk whether
             # the compile then ran on the AOT thread or at jit dispatch)
             pr["n_compiles"] = _program_build_count() - builds0
-            report["pipeline"] = pr
+            metrics.put("pipeline", pr)
 
     def _print_task_end_lines(self, candidates, idx, n_folds, scorer_names,
                               test_scores, train_scores, return_train,
@@ -1935,14 +1979,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 progress_msg = (f" {f + 1}/{n_folds}"
                                 if self.verbose > 2 else "")
                 result_msg = params_msg + (";" if params_msg else "")
-                if len(scorer_names) > 1:
+                # scores appear at verbose > 2 only — sklearn's exact
+                # gating (_fit_and_score: `if verbose > 2:`)
+                if self.verbose > 2 and len(scorer_names) > 1:
                     for s in sorted(scorer_names):
                         result_msg += f" {s}: ("
                         if return_train:
                             result_msg += ("train="
                                            f"{cell(train_scores[s], gidx, f):.3f}, ")
                         result_msg += f"test={cell(test_scores[s], gidx, f):.3f})"
-                else:
+                elif self.verbose > 2:
                     s = scorer_names[0]
                     result_msg += ", score="
                     if return_train:
@@ -1955,7 +2001,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 end_msg = f"[CV{progress_msg}] END "
                 end_msg += "." * max(0, 80 - len(end_msg) - len(result_msg))
                 end_msg += result_msg
-                print(end_msg)
+                # stdout-parity channel: byte-for-byte sklearn's
+                # _fit_and_score END line (pinned by test_obs.py)
+                logger.print(end_msg, candidate=int(gidx), fold=f)
 
     # ------------------------------------------------------------------
     # Tier B: host fallback (full sklearn generality)
@@ -1994,9 +2042,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             for ci, params in enumerate(candidates)
             for fi, (train, test) in enumerate(splits)
         ]
-        self._search_report = {
-            "backend": "host", "n_tasks": len(tasks),
-            "n_jobs": self.n_jobs if self.n_jobs is not None else 1}
+        metrics = search_registry("host")
+        metrics.gauge("n_tasks").set(len(tasks))
+        metrics.gauge("n_jobs").set(
+            self.n_jobs if self.n_jobs is not None else 1)
+        self._search_metrics = metrics
+        self._search_report = metrics.data
 
         from inspect import signature as _sig
         _fs_params = _sig(_fit_and_score).parameters
@@ -2020,9 +2071,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
         ctxs = eval_ctxs if eval_ctxs is not None else [None] * len(tasks)
         n_jobs = self.n_jobs if self.n_jobs is not None else 1
-        results = Parallel(n_jobs=n_jobs)(
-            delayed(run)(params, train, test, ctx)
-            for (_, _, params, train, test), ctx in zip(tasks, ctxs))
+        with get_tracer().span("host.fit_and_score", n_tasks=len(tasks),
+                               n_jobs=n_jobs):
+            results = Parallel(n_jobs=n_jobs)(
+                delayed(run)(params, train, test, ctx)
+                for (_, _, params, train, test), ctx in zip(tasks, ctxs))
 
         # sklearn's own failure accounting: FitFailedWarning with the
         # "n fits failed out of a total of m" format, ValueError when all

@@ -74,8 +74,12 @@ def worker_main(coordinator: str, n_proc: int, pid: int, n_dev: int) -> int:
     scores = gs.cv_results_["mean_test_score"]
     assert np.all(np.isfinite(scores)), scores
     assert float(scores.max()) > 0.5, scores
-    mesh_shape = dict(gs._search_report["mesh"]) \
-        if hasattr(gs, "_search_report") else {}
+    # public report surface; degrade to an empty mesh dict if fit has
+    # not populated it (NotFittedError is also an AttributeError)
+    try:
+        mesh_shape = dict(gs.search_report.get("mesh", {}))
+    except AttributeError:
+        mesh_shape = {}
     print(f"proc {pid}/{n_proc}: {jax.local_device_count()} local of "
           f"{jax.device_count()} global devices, mesh={mesh_shape}, "
           f"best={float(scores.max()):.3f}", flush=True)

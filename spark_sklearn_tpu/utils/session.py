@@ -12,7 +12,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
+
+logger = get_logger(__name__)
 
 
 class TpuSession:
@@ -30,13 +34,40 @@ class TpuSession:
             enable_persistent_cache)
         self.appName = appName
         self.config = config or TpuConfig()
-        self.mesh = build_mesh(self.config)
-        enable_persistent_cache(self.config.resolved_cache_dir(),
-                                self.config.persistent_cache_min_compile_s)
+        if getattr(self.config, "trace", None):
+            # a session asking for tracing turns the recorder on for its
+            # whole lifetime (per-search enable would lose inter-search
+            # host work from the timeline)
+            get_tracer().enable(
+                max_events=getattr(self.config, "trace_buffer_size", None))
+        with get_tracer().span("session.init", appName=appName):
+            self.mesh = build_mesh(self.config)
+            enable_persistent_cache(
+                self.config.resolved_cache_dir(),
+                self.config.persistent_cache_min_compile_s)
+        # structured logging channel (never stdout: the session has no
+        # legacy print contract)
+        logger.info("TpuSession %r: mesh=%s, cache_dir=%r", appName,
+                    dict(self.mesh.shape),
+                    self.config.resolved_cache_dir(),
+                    appName=appName, n_devices=self.mesh.size)
 
     @property
     def n_devices(self) -> int:
         return self.mesh.size
+
+    def export_trace(self, path: Optional[str] = None) -> str:
+        """Write the tracer's current buffer as a Chrome trace-event
+        JSON (default path: ``TpuConfig.trace`` when it is a string)
+        and return the written path."""
+        from spark_sklearn_tpu.obs.export import export_chrome_trace
+        target = path or (self.config.trace
+                          if isinstance(self.config.trace, str) else None)
+        if not target:
+            raise ValueError(
+                "no export path: pass one, or construct the session "
+                "with TpuConfig(trace='out.json')")
+        return export_chrome_trace(target)
 
     def stop(self):  # reference API symmetry (SparkSession.stop)
         pass

@@ -301,14 +301,16 @@ class TestParamPrevalidation:
                 error_score=np.nan, refit=False).fit(X[m][:120], y[m][:120])
 
     def test_verbose_end_lines_show_error_score(self, digits, capsys):
-        """verbose>1 END lines print error_score for failed candidates,
-        not the garbage a degenerate lane computed."""
+        """verbose>2 END lines print error_score for failed candidates,
+        not the garbage a degenerate lane computed (verbose=3 because
+        scores appear at verbose>2 only — sklearn's exact gating,
+        pinned by tests/test_obs.py)."""
         from sklearn.svm import LinearSVC
         X, y = digits
         m = y < 2
         with pytest.warns(Warning):
             sst.GridSearchCV(
-                LinearSVC(), {"C": [0.0, 1.0]}, cv=3, verbose=2,
+                LinearSVC(), {"C": [0.0, 1.0]}, cv=3, verbose=3,
                 error_score=np.nan, refit=False).fit(X[m][:120], y[m][:120])
         out = capsys.readouterr().out
         assert out.count("score=nan") == 3          # the C=0 candidate

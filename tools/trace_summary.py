@@ -1,0 +1,166 @@
+"""Digest a Chrome trace-event JSON exported by `obs.export`.
+
+Prints a top-spans / per-thread / critical-path summary of a trace, so
+the pipeline's overlap story can be read in a terminal without loading
+Perfetto:
+
+    python tools/trace_summary.py TRACE.json [--top N]
+
+Works on any Trace Event Format file (object form with "traceEvents"
+or bare array form).  Exits nonzero when the trace holds no spans —
+the CI smoke leg uses that as its assertion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+__all__ = ["load_events", "summarize", "format_summary", "main"]
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("traceEvents", [])
+    return [e for e in data if isinstance(e, dict)]
+
+
+def _self_times(spans: List[Dict[str, Any]]) -> Dict[int, float]:
+    """Self time (dur minus nested children) per span index, for one
+    thread's complete events.  Spans are stack-nested by construction,
+    so a sweep with an enclosing-span stack suffices."""
+    order = sorted(range(len(spans)),
+                   key=lambda i: (spans[i]["ts"], -spans[i]["dur"]))
+    self_us = {i: float(spans[i]["dur"]) for i in order}
+    stack: List[int] = []
+    for i in order:
+        ts = spans[i]["ts"]
+        while stack and ts >= (spans[stack[-1]]["ts"]
+                               + spans[stack[-1]]["dur"]):
+            stack.pop()
+        if stack:
+            self_us[stack[-1]] -= float(spans[i]["dur"])
+        stack.append(i)
+    return self_us
+
+
+def summarize(events: List[Dict[str, Any]], top: int = 12) -> Dict[str, Any]:
+    """Aggregate a trace into the printed digest's data structure."""
+    thread_names: Dict[Any, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+
+    spans = [e for e in events if e.get("ph") == "X"]
+    asyncs = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "b":
+            asyncs[e.get("name", "").split(" ")[0] or "?"] += 1
+
+    by_thread: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for e in spans:
+        key = (e.get("pid"), e.get("tid"))
+        by_thread[thread_names.get(key, f"tid {e.get('tid')}")].append(e)
+
+    t_lo = min((e["ts"] for e in spans), default=0.0)
+    t_hi = max((e["ts"] + e["dur"] for e in spans), default=0.0)
+    wall_ms = (t_hi - t_lo) / 1e3
+
+    names: Dict[str, Dict[str, float]] = {}
+    threads: Dict[str, Dict[str, Any]] = {}
+    for tname, tev in by_thread.items():
+        self_us = _self_times(tev)
+        busy_us = sum(self_us.values())
+        threads[tname] = {
+            "n_spans": len(tev),
+            "busy_ms": round(busy_us / 1e3, 3),
+            "utilization": round(busy_us / 1e3 / wall_ms, 4)
+            if wall_ms > 0 else 0.0,
+        }
+        for i, e in enumerate(tev):
+            rec = names.setdefault(e["name"], {
+                "count": 0, "total_ms": 0.0, "self_ms": 0.0, "max_ms": 0.0})
+            dur_ms = float(e["dur"]) / 1e3
+            rec["count"] += 1
+            rec["total_ms"] += dur_ms
+            rec["self_ms"] += self_us[i] / 1e3
+            rec["max_ms"] = max(rec["max_ms"], dur_ms)
+
+    top_spans = sorted(names.items(), key=lambda kv: -kv[1]["self_ms"])[:top]
+    # critical path digest: the busiest thread is the run's bottleneck;
+    # its top self-time spans are where optimization effort goes
+    bottleneck = max(threads.items(), key=lambda kv: kv[1]["busy_ms"],
+                     default=(None, None))[0]
+    return {
+        "n_events": len(events),
+        "n_spans": len(spans),
+        "wall_ms": round(wall_ms, 3),
+        "threads": threads,
+        "top_spans": [
+            {"name": k, "count": int(v["count"]),
+             "total_ms": round(v["total_ms"], 3),
+             "self_ms": round(v["self_ms"], 3),
+             "mean_ms": round(v["total_ms"] / v["count"], 3),
+             "max_ms": round(v["max_ms"], 3)}
+            for k, v in top_spans],
+        "async_tracks": dict(asyncs),
+        "bottleneck_thread": bottleneck,
+    }
+
+
+def format_summary(s: Dict[str, Any]) -> str:
+    out = [f"trace: {s['n_spans']} spans / {s['n_events']} events, "
+           f"wall {s['wall_ms']:.1f} ms"]
+    out.append("\nthreads (self-time busy / utilization):")
+    for tname, t in sorted(s["threads"].items(),
+                           key=lambda kv: -kv[1]["busy_ms"]):
+        mark = "  <- critical path" if tname == s["bottleneck_thread"] \
+            else ""
+        out.append(f"  {tname:<24} {t['busy_ms']:>10.1f} ms  "
+                   f"{100 * t['utilization']:>5.1f}%  "
+                   f"({t['n_spans']} spans){mark}")
+    out.append("\ntop spans by self time:")
+    out.append(f"  {'name':<28} {'count':>5} {'self ms':>10} "
+               f"{'total ms':>10} {'mean ms':>9} {'max ms':>9}")
+    for r in s["top_spans"]:
+        out.append(f"  {r['name']:<28} {r['count']:>5} "
+                   f"{r['self_ms']:>10.1f} {r['total_ms']:>10.1f} "
+                   f"{r['mean_ms']:>9.2f} {r['max_ms']:>9.2f}")
+    if s["async_tracks"]:
+        counts = ", ".join(f"{k}={v}"
+                           for k, v in sorted(s["async_tracks"].items()))
+        out.append(f"\nasync spans: {counts}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("--top", type=int, default=12,
+                    help="how many span names to list (default 12)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digest as JSON instead of a table")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    s = summarize(events, top=args.top)
+    try:
+        if args.json:
+            print(json.dumps(s, indent=2))
+        else:
+            print(format_summary(s))
+    except BrokenPipeError:      # `... | head` is a legitimate use
+        pass
+    if s["n_spans"] == 0:
+        print("error: trace contains no complete spans", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
