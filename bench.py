@@ -307,6 +307,15 @@ def _glm_fit_flops(report, n, d, k):
     return 4.0 * n * d * max(k, 1) * il, (max(iters) if iters else 0)
 
 
+def _faults_summary(report):
+    """The search's recovery counters (search_report["faults"] minus the
+    per-event journal) — recorded per leg so BENCH_* files show whether
+    a number was achieved clean or paid recovery overhead."""
+    f = dict(report.get("faults", {}))
+    f.pop("events", None)
+    return f
+
+
 def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
                  max_iter=100, measure_bf16=False, serial_subsample=20):
     """BASELINE config #1 at north-star scale: LogReg C-grid on digits.
@@ -360,6 +369,7 @@ def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
         "pipeline_warm": {
             k: v for k, v in gs2.search_report.get(
                 "pipeline", {}).items() if k != "launches"},
+        "faults": _faults_summary(gs2.search_report),
     }
 
     # MFU accounting (honest: digits is latency-bound — 64 features
@@ -482,6 +492,7 @@ def leg_svc_mxu(cache_dir=None, n=10_000, d=784, folds=3, max_iter=100,
                              "bf16_peak_tflops": round(peak / 1e12)},
         "best_score": round(float(
             svc.cv_results_["mean_test_score"].max()), 4),
+        "faults": _faults_summary(rep),
     }
 
 
@@ -511,7 +522,8 @@ def leg_svc_digits(cache_dir=None, n_C=8, n_gamma=8, folds=3,
     return {"wall_s": round(w, 2),
             "fits_per_sec": round(n_fits / w, 2),
             "best_score": round(float(
-                svc.cv_results_["mean_test_score"].max()), 4)}
+                svc.cv_results_["mean_test_score"].max()), 4),
+            "faults": _faults_summary(svc.search_report)}
 
 
 def leg_config3_rf(cache_dir=None, n=20_000, d=54, n_classes=7, n_iter=8,
@@ -541,7 +553,8 @@ def leg_config3_rf(cache_dir=None, n=20_000, d=54, n_classes=7, n_iter=8,
                      f"{folds} folds",
             "wall_s": round(w, 2),
             "fits_per_sec": round(n_iter * folds / w, 2),
-            "backend": rs.search_report["backend"]}
+            "backend": rs.search_report["backend"],
+            "faults": _faults_summary(rs.search_report)}
 
 
 def leg_config4_gbr(cache_dir=None, n=20_000, d=8, folds=3,
@@ -571,7 +584,8 @@ def leg_config4_gbr(cache_dir=None, n=20_000, d=8, folds=3,
                      f"{n_fits // folds} cand x {folds} folds",
             "wall_s": round(w, 2),
             "fits_per_sec": round(n_fits / w, 2),
-            "backend": gbr.search_report["backend"]}
+            "backend": gbr.search_report["backend"],
+            "faults": _faults_summary(gbr.search_report)}
 
 
 def leg_config5_mlp(cache_dir=None, hidden=64, max_iter=60, folds=3,
@@ -603,7 +617,8 @@ def leg_config5_mlp(cache_dir=None, hidden=64, max_iter=60, folds=3,
     return {"shape": f"digits, {len(alphas)} alpha x {folds} folds",
             "wall_s": round(w, 2),
             "fits_per_sec": round(n_fits / w, 2),
-            "backend": mlp.search_report["backend"]}
+            "backend": mlp.search_report["backend"],
+            "faults": _faults_summary(mlp.search_report)}
 
 
 #: tiny search run by the persistent-cache probe subprocesses: shapes

@@ -46,6 +46,34 @@ PY
 JAX_PLATFORMS=cpu python tools/trace_summary.py "$OBS_TRACE"
 rm -f "$OBS_TRACE"
 
+echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+grid = {"C": np.logspace(-2, 1, 40).tolist()}
+base = sst.GridSearchCV(LogisticRegression(max_iter=10), grid, cv=2,
+                        refit=False, backend="tpu").fit(X, y)
+# launch order: fit, score, calibrate, then fused chunks — 4 and 6 are
+# fused steady-state launches on any device count
+cfg = sst.TpuConfig(fault_plan="transient@4,oom@6", retry_backoff_s=0.01)
+gs = sst.GridSearchCV(LogisticRegression(max_iter=10), grid, cv=2,
+                      refit=False, backend="tpu", config=cfg).fit(X, y)
+f = gs.search_report["faults"]
+assert f["retries"] >= 1 and f["bisections"] >= 1, f
+np.testing.assert_array_equal(base.cv_results_["mean_test_score"],
+                              gs.cv_results_["mean_test_score"])
+print("fault smoke:", {k: f[k] for k in
+                       ("retries", "bisections", "host_fallbacks",
+                        "timeouts", "injected")})
+PY
+
 echo "== vendored upstream sklearn suite =="
 # explicit path: the vendored file keeps upstream's name under a
 # leading underscore, so pytest's test_*.py discovery skips it and a

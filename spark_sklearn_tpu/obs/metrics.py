@@ -28,6 +28,7 @@ __all__ = [
     "MetricsRegistry",
     "SEARCH_REPORT_SCHEMA",
     "PIPELINE_BLOCK_SCHEMA",
+    "FAULTS_BLOCK_SCHEMA",
     "search_registry",
     "schema_markdown",
 ]
@@ -106,6 +107,15 @@ SEARCH_REPORT_SCHEMA = (
         "n_precompiled, persistent-cache traffic and the per-launch "
         "records."),
     MetricDef(
+        "faults", "struct",
+        "The launch supervisor's recovery record (see the faults-block "
+        "schema below): retry/bisection/host-fallback/timeout counters, "
+        "per-class fault counts and the per-event journal "
+        "(parallel/faults.py).  On the host tier the block carries the "
+        "exception that pushed the compiled tier to fall back, when "
+        "one did.",
+        backends="tpu,host"),
+    MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
         backends="host"),
@@ -156,6 +166,43 @@ PIPELINE_BLOCK_SCHEMA = (
               "(fit/score/calibrate/fused), n_tasks and per-phase "
               "walls (stage_s/stage_wait_s/dispatch_s/compute_s/"
               "gather_s/finalize_s)."),
+)
+
+#: sub-keys of ``search_report["faults"]`` (written by
+#: ``parallel.faults.LaunchSupervisor``) — the recovery contract's
+#: observable surface, pinned next to the rest of the report schema.
+FAULTS_BLOCK_SCHEMA = (
+    MetricDef("retries", "counter",
+              "Transient-fault retry attempts performed (exponential "
+              "backoff + deterministic jitter; budgets: "
+              "TpuConfig.max_launch_retries / max_search_retries)."),
+    MetricDef("bisections", "counter",
+              "OOM chunk bisections performed (each split relaunches "
+              "the chunk as two half-width launches, lanes re-padded "
+              "via parallel/taskgrid.pad_chunk)."),
+    MetricDef("host_fallbacks", "counter",
+              "Ranges degraded to per-candidate host execution with "
+              "exact sklearn error_score semantics (bisection bottomed "
+              "out, or the item had no bisect hook)."),
+    MetricDef("timeouts", "counter",
+              "Launches failed by the watchdog for exceeding "
+              "TpuConfig.launch_timeout_s (each raises a clean "
+              "LaunchTimeoutError naming the chunk and compile "
+              "group)."),
+    MetricDef("injected", "counter",
+              "Faults injected by the deterministic fault plan "
+              "(TpuConfig.fault_plan / SST_FAULT_PLAN)."),
+    MetricDef("by_class", "struct",
+              "Observed fault counts keyed by taxonomy class "
+              "(transient/oom/hung/fatal)."),
+    MetricDef("events", "series",
+              "Per-event journal (bounded at 64 records): key, group, "
+              "class, action (retry/recover/bisect/host_fallback/"
+              "fail/raise/retries_exhausted), attempt, error."),
+    MetricDef("fallback_exception", "label",
+              "Host tier only: the exception type (and truncated "
+              "message) that made the compiled tier fall back to the "
+              "host backend, when the search started compiled."),
 )
 
 
@@ -346,5 +393,9 @@ def schema_markdown() -> str:
     out.append("\n### `search_report[\"pipeline\"]` block\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in PIPELINE_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"faults\"]` block\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in FAULTS_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     return "".join(out)

@@ -1,0 +1,655 @@
+"""Fault-tolerant launch supervisor — the engine's Spark-resilience story.
+
+The reference gets fault tolerance for free from Spark: a failed task is
+retried on another executor, and a dead search is re-run wholesale
+(SURVEY §5.4).  The TPU-native engine has no executors to lean on — a
+single transient ``XlaRuntimeError``, a RESOURCE_EXHAUSTED on an
+oversized chunk, or a hung launch used to kill the whole
+``GridSearchCV.fit``, with the offline checkpoint as the only recovery.
+This module supplies the missing contract around every ``LaunchItem``
+the chunk pipeline executes (``parallel/pipeline.py``):
+
+  - **error taxonomy** — every failure classifies as ``TRANSIENT`` /
+    ``OOM`` / ``HUNG`` / ``FATAL`` (:func:`classify_error`, extensible
+    via :func:`register_classifier`);
+  - **retry with exponential backoff + jitter** for ``TRANSIENT``
+    faults, under per-launch (``TpuConfig.max_launch_retries``) and
+    per-search (``max_search_retries``) budgets.  A retry re-runs the
+    item's own ``stage -> launch -> wait`` phases: same program, same
+    inputs, bit-identical scores;
+  - **graceful OOM degradation** — an ``OOM`` launch is bisected into
+    halves (the item's ``bisect`` hook re-pads lanes via
+    ``parallel/taskgrid.pad_chunk`` and relaunches at the narrower
+    width), recursing down to single candidates and finally falling
+    back to per-candidate host execution with exact sklearn
+    ``error_score`` semantics (the item's ``host_fallback`` hook);
+  - **watchdog timeouts** — ``TpuConfig.launch_timeout_s`` bounds the
+    blocking ``jax.block_until_ready`` wait; a launch that exceeds it
+    fails the search with a clean :class:`LaunchTimeoutError` naming
+    the chunk and compile group instead of hanging the gather thread
+    forever (previously-finalized chunks are already durable in the
+    checkpoint, so the failed search resumes);
+  - **deterministic fault injection** — ``TpuConfig(fault_plan=...)``
+    or the ``SST_FAULT_PLAN`` env var inject any taxonomy class at
+    chosen launch indices (``"transient@3,oom@5"``), so CPU tests
+    exercise every recovery path with no flaky hardware required.
+
+Every recovery event lands in the metrics registry
+(``search_report["faults"]`` — schema pinned in
+``obs.metrics.FAULTS_BLOCK_SCHEMA``), in ``launch.retry`` /
+``launch.bisect`` / ``launch.host_fallback`` trace spans, and in
+structured log lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.parallel.pipeline import LaunchItem
+
+_slog = get_logger(__name__)
+
+__all__ = [
+    "TRANSIENT",
+    "OOM",
+    "HUNG",
+    "FATAL",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "LaunchTimeoutError",
+    "LaunchSupervisor",
+    "classify_error",
+    "is_oom",
+    "register_classifier",
+]
+
+
+# ---------------------------------------------------------------------------
+# Taxonomy
+# ---------------------------------------------------------------------------
+
+#: retry with backoff: the device hiccuped but the program is fine
+TRANSIENT = "transient"
+#: bisect the chunk / fall back to host: the launch was too big
+OOM = "oom"
+#: fail the search cleanly: the launch never came back
+HUNG = "hung"
+#: re-raise unchanged: a real bug (or an unsupported combo the search
+#: engine's own compiled->host fallback knows how to handle)
+FATAL = "fatal"
+
+#: plan-only pseudo-class: OOM that also fails every multi-candidate
+#: bisected sub-range, forcing recovery all the way to the host path
+OOM_DEEP = "oom_deep"
+
+_CLASSES = (TRANSIENT, OOM, HUNG, FATAL, OOM_DEEP)
+
+#: message substrings marking a device error as OOM / transient.  XLA
+#: runtime errors carry their grpc-style status name in the message
+#: (RESOURCE_EXHAUSTED, UNAVAILABLE, ...), so string matching is the
+#: stable cross-version classifier.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "Resource exhausted", "Failed to allocate")
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "ABORTED", "CANCELLED",
+                      "DEADLINE_EXCEEDED", "Socket closed",
+                      "connection reset", "transient")
+
+#: user-extensible classifiers, consulted first: fn(exc) -> class | None
+_CUSTOM_CLASSIFIERS: List[Callable[[BaseException], Optional[str]]] = []
+
+
+def register_classifier(fn: Callable[[BaseException], Optional[str]]) -> None:
+    """Prepend a custom error classifier.  ``fn(exc)`` returns one of
+    the taxonomy classes, or None to defer to the built-in rules —
+    the extension point for backend-specific error shapes."""
+    _CUSTOM_CLASSIFIERS.insert(0, fn)
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the deterministic injection plan.  Carries its
+    taxonomy class explicitly so classification never guesses."""
+
+    def __init__(self, fault_class: str, message: str):
+        super().__init__(message)
+        self.fault_class = fault_class
+        #: OOM_DEEP faults stay sticky through bisection: every
+        #: multi-candidate sub-range re-fails, forcing host fallback
+        self.sst_sticky_oom = fault_class == OOM_DEEP
+
+
+class LaunchTimeoutError(TimeoutError):
+    """A launch exceeded ``TpuConfig.launch_timeout_s``.  Names the
+    chunk and compile group; never silently re-run on the host (a hung
+    device would only hang the host re-run's next compiled search)."""
+
+    #: consumed by grid._dispatch: no compiled->host fallback
+    _sst_no_fallback = True
+
+    def __init__(self, key: str, group: int, timeout_s: float,
+                 injected: bool = False):
+        super().__init__(
+            f"launch {key!r} (compile group {group}) exceeded "
+            f"launch_timeout_s={timeout_s}s"
+            + (" [injected]" if injected else ""))
+        self.key = key
+        self.group = group
+        self.timeout_s = timeout_s
+        self.injected = injected
+
+
+def classify_error(exc: BaseException) -> str:
+    """Map an exception to its taxonomy class.
+
+    Conservative by design: anything not positively identified as
+    transient or OOM is FATAL, so genuine bugs keep today's behavior
+    (propagate immediately; the search engine's own compiled->host
+    fallback still applies) instead of burning a retry budget."""
+    for fn in _CUSTOM_CLASSIFIERS:
+        cls = fn(exc)
+        if cls in _CLASSES:
+            return OOM if cls == OOM_DEEP else cls
+    if isinstance(exc, InjectedFault):
+        return OOM if exc.fault_class == OOM_DEEP else exc.fault_class
+    if isinstance(exc, LaunchTimeoutError):
+        return HUNG
+    if isinstance(exc, MemoryError):
+        return OOM
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(m in msg for m in _OOM_MARKERS):
+        return OOM
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return FATAL
+
+
+def is_oom(exc: BaseException) -> bool:
+    return classify_error(exc) == OOM
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault-injection plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Inject `fault_class` at launch `index` for its first `count`
+    attempts (count=1: the launch fails once and the first retry
+    succeeds)."""
+
+    index: int
+    fault_class: str
+    count: int = 1
+
+
+_PLAN_TOKEN = re.compile(
+    r"(?i)^(transient|oom_deep|oom|hung|fatal)@(\d+)(?:x(\d+))?$")
+
+
+class FaultPlan:
+    """Deterministic injection schedule over supervised launch indices.
+
+    Spec forms (``TpuConfig(fault_plan=...)`` / ``SST_FAULT_PLAN``):
+
+      - string: comma-separated ``CLASS@INDEX[xCOUNT]`` tokens, e.g.
+        ``"transient@3,oom@5"`` or ``"transient@2x3"`` (fail 3
+        consecutive attempts — enough to exhaust a retry budget);
+      - sequence of ``FaultSpec`` / ``(index, class[, count])`` tuples /
+        ``{"index": .., "class": .., "count": ..}`` dicts.
+
+    Launch indices count the supervised ``LaunchItem``s in dispatch
+    order (resumed chunks launch nothing and are not counted), which is
+    identical at every ``pipeline_depth`` — so a plan reproduces the
+    same faults in the pipelined run and the synchronous escape hatch.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()):
+        self._by_index: Dict[int, FaultSpec] = {}
+        for s in specs:
+            if s.fault_class not in _CLASSES:
+                raise ValueError(
+                    f"unknown fault class {s.fault_class!r}; expected one "
+                    f"of {_CLASSES}")
+            if s.index in self._by_index:
+                raise ValueError(
+                    f"duplicate fault-plan entry for launch index "
+                    f"{s.index}")
+            self._by_index[s.index] = s
+
+    def __bool__(self) -> bool:
+        return bool(self._by_index)
+
+    def __len__(self) -> int:
+        return len(self._by_index)
+
+    @property
+    def specs(self) -> Tuple[FaultSpec, ...]:
+        return tuple(self._by_index[i] for i in sorted(self._by_index))
+
+    def match(self, index: int, attempt: int) -> Optional[FaultSpec]:
+        """The spec to fire for this (launch index, attempt number), or
+        None.  attempt counts from 0 (the first try)."""
+        spec = self._by_index.get(index)
+        if spec is not None and attempt < spec.count:
+            return spec
+        return None
+
+    @classmethod
+    def parse(cls, spec: Any) -> "FaultPlan":
+        if spec is None:
+            return cls(())
+        if isinstance(spec, FaultPlan):
+            return spec
+        if isinstance(spec, str):
+            out = []
+            for tok in spec.split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                m = _PLAN_TOKEN.match(tok)
+                if m is None:
+                    raise ValueError(
+                        f"bad fault-plan token {tok!r}; expected "
+                        "CLASS@INDEX[xCOUNT] with CLASS in "
+                        f"{_CLASSES}, e.g. 'transient@3,oom@5'")
+                out.append(FaultSpec(int(m.group(2)), m.group(1).lower(),
+                                     int(m.group(3) or 1)))
+            return cls(out)
+        out = []
+        for entry in spec:
+            if isinstance(entry, FaultSpec):
+                out.append(entry)
+            elif isinstance(entry, dict):
+                out.append(FaultSpec(
+                    int(entry["index"]),
+                    str(entry.get("class",
+                                  entry.get("fault_class"))).lower(),
+                    int(entry.get("count", 1))))
+            else:
+                idx, fcls = entry[0], entry[1]
+                count = entry[2] if len(entry) > 2 else 1
+                out.append(FaultSpec(int(idx), str(fcls).lower(),
+                                     int(count)))
+        return cls(out)
+
+    @classmethod
+    def resolve(cls, config=None) -> "FaultPlan":
+        """The active plan: ``TpuConfig.fault_plan`` when set, else the
+        ``SST_FAULT_PLAN`` environment variable, else empty."""
+        spec = getattr(config, "fault_plan", None) if config is not None \
+            else None
+        if spec is None:
+            spec = os.environ.get("SST_FAULT_PLAN") or None
+        return cls.parse(spec)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+
+class _Recovered:
+    """Marker wrapping an already-gathered HOST result produced by a
+    recovery path (bisection merge or host fallback).  The wrapped
+    item's wait/gather phases pass it through / unwrap it, so the
+    original finalize runs unchanged — writing cells and the checkpoint
+    record under the original chunk id."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+#: indirection so tests can substitute a controllable blocker
+_block_until_ready = jax.block_until_ready
+
+#: cap on per-search recovery-event records kept in the report
+_MAX_EVENTS = 64
+
+
+class LaunchSupervisor:
+    """Wrap the search's ``LaunchItem`` stream with retry / bisection /
+    watchdog / injection semantics.
+
+    Usage (``search/grid.py _run_groups``)::
+
+        sup = LaunchSupervisor(config, faults=metrics.struct("faults"),
+                               ckpt=ckpt)
+        pipe.run(sup.wrap(chunk_items()))
+
+    The fault-free fast path costs one try/except per launch phase; the
+    watchdog thread only exists while ``launch_timeout_s`` is set.
+    Recovery runs on whichever thread hit the failure (the dispatch
+    thread for synchronous launch errors, the gather thread for errors
+    surfacing at ``block_until_ready``) — already-dispatched launches
+    keep computing meanwhile.
+    """
+
+    def __init__(self, config=None, faults: Optional[Dict[str, Any]] = None,
+                 ckpt=None, verbose: int = 0):
+        self.max_launch_retries = int(
+            getattr(config, "max_launch_retries", 2) or 0)
+        self.max_search_retries = int(
+            getattr(config, "max_search_retries", 16) or 0)
+        self.retry_backoff_s = float(
+            getattr(config, "retry_backoff_s", 0.5) or 0.0)
+        self.retry_backoff_mult = float(
+            getattr(config, "retry_backoff_mult", 2.0) or 1.0)
+        self.retry_jitter_frac = float(
+            getattr(config, "retry_jitter_frac", 0.25) or 0.0)
+        self.launch_timeout_s = getattr(config, "launch_timeout_s", None)
+        self.plan = FaultPlan.resolve(config)
+        self.verbose = int(verbose)
+        self._ckpt = ckpt
+        self._tracer = get_tracer()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._retries_used = 0
+        self._sticky_oom = False
+        self.faults: Dict[str, Any] = faults if faults is not None else {}
+        self.faults.update({
+            "retries": 0, "bisections": 0, "host_fallbacks": 0,
+            "timeouts": 0, "injected": 0, "by_class": {}, "events": [],
+        })
+
+    # -- accounting ------------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.faults[name] += n
+
+    def _record_event(self, key: str, group: int, cls: str, action: str,
+                      exc: Optional[BaseException], attempt: int) -> None:
+        with self._lock:
+            by = self.faults["by_class"]
+            by[cls] = by.get(cls, 0) + 1
+            ev = self.faults["events"]
+            if len(ev) < _MAX_EVENTS:
+                ev.append({
+                    "key": key, "group": group, "class": cls,
+                    "action": action, "attempt": attempt,
+                    "error": (f"{type(exc).__name__}: {exc}"[:200]
+                              if exc is not None else "")})
+        if self._ckpt is not None:
+            # durable fault journal: a resume after a failed recovery
+            # still knows which chunk was in trouble (and the completed
+            # chunks' result records are already streamed)
+            try:
+                self._ckpt.note_fault(key, {
+                    "class": cls, "action": action, "attempt": attempt,
+                    "error": (f"{type(exc).__name__}: {exc}"[:200]
+                              if exc is not None else "")})
+            except OSError:
+                _slog.warning("fault journal write failed for %s", key)
+
+    def record_bisection(self, key: str, group: int) -> None:
+        """Called by the item's bisect hook once per split."""
+        self._count("bisections")
+        self._record_event(key, group, OOM, "bisect", None, 0)
+        _slog.warning("launch %s: OOM — bisecting the chunk", key,
+                      key=key, group=group)
+
+    def record_host_fallback(self, key: str, group: int, n_tasks: int) -> None:
+        """Called by recovery paths when a range degrades to per-
+        candidate host execution."""
+        self._count("host_fallbacks")
+        self._record_event(key, group, OOM, "host_fallback", None, 0)
+        _slog.warning(
+            "launch %s: bisection bottomed out — running %d task(s) on "
+            "the host with sklearn error_score semantics", key, n_tasks,
+            key=key, group=group, n_tasks=n_tasks)
+
+    # -- injection -------------------------------------------------------
+    def _maybe_inject(self, st: Dict[str, Any]) -> None:
+        spec = self.plan.match(st["index"], st["attempt"])
+        if spec is None:
+            return
+        self._count("injected")
+        item = st["item"]
+        _slog.warning(
+            "fault plan: injecting %s at launch %d (%s) attempt %d",
+            spec.fault_class, st["index"], item.key, st["attempt"],
+            key=item.key, fault_class=spec.fault_class,
+            attempt=st["attempt"])
+        if spec.fault_class == HUNG:
+            raise LaunchTimeoutError(
+                item.key, item.group, float(self.launch_timeout_s or 0.0),
+                injected=True)
+        marker = ("RESOURCE_EXHAUSTED: " if spec.fault_class
+                  in (OOM, OOM_DEEP) else "")
+        raise InjectedFault(
+            spec.fault_class,
+            f"{marker}injected {spec.fault_class} fault at launch index "
+            f"{st['index']} ({item.key}), attempt {st['attempt']}")
+
+    def inject_subrange(self, n_real: int) -> None:
+        """Consulted by bisected sub-launches: under a sticky
+        (``oom_deep``) fault every sub-range re-fails — single
+        candidates included — so the recursion deterministically
+        bottoms out into the per-candidate host path."""
+        if self._sticky_oom:
+            self._count("injected")
+            raise InjectedFault(
+                OOM, "RESOURCE_EXHAUSTED: injected sticky OOM on a "
+                     f"bisected sub-range of {n_real} candidate(s)")
+
+    # -- watchdog --------------------------------------------------------
+    def wait_ready(self, out, key: str = "", group: int = 0):
+        """``jax.block_until_ready`` bounded by ``launch_timeout_s``.
+
+        The blocking wait runs on a disposable daemon thread; on
+        timeout the search fails with :class:`LaunchTimeoutError`
+        (naming the chunk and compile group) while the wedged wait
+        thread is abandoned — the one leak a hung device costs, instead
+        of a gather thread hung forever."""
+        if isinstance(out, _Recovered):
+            return out
+        if not self.launch_timeout_s:
+            return _block_until_ready(out)
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def blocker():
+            try:
+                box["out"] = _block_until_ready(out)
+            except BaseException as exc:       # re-raised on the caller
+                box["exc"] = exc
+            finally:
+                done.set()
+
+        threading.Thread(target=blocker, daemon=True,
+                         name="sst-watchdog-wait").start()
+        if not done.wait(float(self.launch_timeout_s)):
+            raise LaunchTimeoutError(key, group,
+                                     float(self.launch_timeout_s))
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    # -- retry loop shared by wrapped items and bisected sub-launches ----
+    def _backoff_delay(self, key: str, attempt: int) -> float:
+        base = self.retry_backoff_s * (
+            self.retry_backoff_mult ** max(0, attempt - 1))
+        if self.retry_jitter_frac <= 0.0:
+            return base
+        # deterministic jitter: reproducible runs need reproducible
+        # sleeps, so the jitter hashes (key, attempt) instead of
+        # sampling a live RNG
+        u = zlib.crc32(f"{key}:{attempt}".encode()) / 2 ** 32
+        return base * (1.0 + self.retry_jitter_frac * (u - 0.5))
+
+    def _take_retry_budget(self, key: str) -> bool:
+        with self._lock:
+            if self._retries_used >= self.max_search_retries:
+                return False
+            self._retries_used += 1
+            self.faults["retries"] += 1
+        return True
+
+    def _retry_gate(self, key: str, group: int, attempt: int,
+                    exc: Exception) -> None:
+        """The one transient-retry policy: consume budget, journal the
+        event, back off — or re-raise `exc` when a budget is spent.
+        Shared by the wrapped-item recovery loop and bisected
+        sub-launch retries so the two paths cannot drift."""
+        if attempt > self.max_launch_retries or \
+                not self._take_retry_budget(key):
+            self._record_event(key, group, TRANSIENT,
+                               "retries_exhausted", exc, attempt)
+            _slog.warning(
+                "launch %s: transient fault but retry budget exhausted "
+                "(%d/%d per launch, %d/%d per search)", key,
+                attempt - 1, self.max_launch_retries, self._retries_used,
+                self.max_search_retries, key=key)
+            raise exc
+        self._record_event(key, group, TRANSIENT, "retry", exc, attempt)
+        delay = self._backoff_delay(key, attempt)
+        _slog.warning(
+            "launch %s: transient fault (%r), retry %d/%d in %.3fs",
+            key, exc, attempt, self.max_launch_retries, delay,
+            key=key, attempt=attempt)
+        time.sleep(delay)
+
+    def call(self, fn: Callable[[], Any], key: str, group: int = 0,
+             n_real: Optional[int] = None):
+        """Run ``fn`` (a full stage->launch->wait->gather closure used
+        by bisected sub-launches) under transient-retry semantics.  OOM
+        and HUNG propagate to the caller — the bisection recursion in
+        the item's hook decides what OOM means at its depth."""
+        attempt = 0
+        while True:
+            try:
+                if n_real is not None:
+                    self.inject_subrange(n_real)
+                if attempt == 0:
+                    return fn()
+                with self._tracer.span("launch.retry", key=key,
+                                       group=group, attempt=attempt):
+                    return fn()
+            except Exception as exc:
+                cls = classify_error(exc)
+                if cls != TRANSIENT:
+                    if cls != OOM:
+                        self._record_event(key, group, cls, "raise", exc,
+                                           attempt)
+                    if cls == HUNG:
+                        self._count("timeouts")
+                    raise
+                attempt += 1
+                self._retry_gate(key, group, attempt, exc)
+
+    # -- item wrapping ---------------------------------------------------
+    def wrap(self, items):
+        """Wrap an iterable of LaunchItems (lazily — the pipeline's
+        stage-ahead behavior is preserved)."""
+        for item in items:
+            idx = self._seq
+            self._seq += 1
+            yield self._wrap_one(item, idx)
+
+    def _wrap_one(self, item: LaunchItem, index: int) -> LaunchItem:
+        st = {"item": item, "index": index, "attempt": 0}
+
+        def guarded_launch(payload):
+            try:
+                self._maybe_inject(st)
+                return item.launch(payload)
+            except Exception as exc:
+                return self._recover(st, exc)
+
+        def guarded_wait(out):
+            if isinstance(out, _Recovered):
+                return out
+            try:
+                return self.wait_ready(out, key=item.key, group=item.group)
+            except Exception as exc:
+                return self._recover(st, exc)
+
+        def guarded_gather(out):
+            if isinstance(out, _Recovered):
+                return out.value
+            return item.gather(out) if item.gather is not None else None
+
+        return LaunchItem(
+            key=item.key, launch=guarded_launch, stage=item.stage,
+            gather=guarded_gather, finalize=item.finalize,
+            group=item.group, kind=item.kind, n_tasks=item.n_tasks,
+            wait=guarded_wait)
+
+    # -- recovery --------------------------------------------------------
+    def _recover(self, st: Dict[str, Any], exc: Exception):
+        item = st["item"]
+        while True:
+            cls = classify_error(exc)
+            if cls == FATAL:
+                # a real bug: propagate unchanged (the search engine's
+                # compiled->host fallback still applies above us)
+                self._record_event(item.key, item.group, cls, "raise",
+                                   exc, st["attempt"])
+                raise exc
+            if cls == HUNG:
+                self._count("timeouts")
+                self._record_event(item.key, item.group, cls, "fail",
+                                   exc, st["attempt"])
+                _slog.warning(
+                    "launch %s (group %d): watchdog timeout — failing "
+                    "the search cleanly (completed chunks are already "
+                    "checkpointed)", item.key, item.group, key=item.key)
+                if isinstance(exc, LaunchTimeoutError):
+                    raise exc
+                raise LaunchTimeoutError(
+                    item.key, item.group,
+                    float(self.launch_timeout_s or 0.0)) from exc
+            if cls == OOM:
+                return self._recover_oom(st, exc)
+            # TRANSIENT: exponential backoff + jitter, then re-run the
+            # item's own phases — same program, same inputs
+            st["attempt"] += 1
+            self._retry_gate(item.key, item.group, st["attempt"], exc)
+            try:
+                with self._tracer.span("launch.retry", key=item.key,
+                                       group=item.group,
+                                       attempt=st["attempt"]):
+                    self._maybe_inject(st)
+                    payload = item.stage() if item.stage is not None \
+                        else None
+                    out = item.launch(payload)
+                    return self.wait_ready(out, key=item.key,
+                                           group=item.group)
+            except Exception as e:
+                exc = e
+
+    def _recover_oom(self, st: Dict[str, Any], exc: Exception):
+        item = st["item"]
+        self._record_event(item.key, item.group, OOM, "recover", exc,
+                           st["attempt"])
+        sticky = bool(getattr(exc, "sst_sticky_oom", False))
+        if item.bisect is not None:
+            with self._tracer.span("launch.bisect", key=item.key,
+                                   group=item.group):
+                prev = self._sticky_oom
+                self._sticky_oom = prev or sticky
+                try:
+                    return _Recovered(item.bisect(self))
+                finally:
+                    self._sticky_oom = prev
+        if item.host_fallback is not None:
+            self.record_host_fallback(item.key, item.group, item.n_tasks)
+            with self._tracer.span("launch.host_fallback", key=item.key,
+                                   group=item.group):
+                return _Recovered(item.host_fallback())
+        _slog.warning(
+            "launch %s: OOM with no bisect/host_fallback hook — "
+            "propagating", item.key, key=item.key)
+        raise exc

@@ -121,6 +121,24 @@ class TpuConfig:
     # everywhere.  Applies to the wide score path only (custom scorers
     # keep separate launches).
     fuse_fit_score: bool = True
+    # ---- fault tolerance (parallel/faults.py LaunchSupervisor) ----
+    # transient device errors retry with exponential backoff + jitter;
+    # budgets are per launch AND per search (a flapping device must not
+    # retry forever).
+    max_launch_retries: int = 2
+    max_search_retries: int = 16
+    retry_backoff_s: float = 0.5
+    retry_backoff_mult: float = 2.0
+    retry_jitter_frac: float = 0.25
+    # watchdog: a launch whose blocking wait exceeds this many seconds
+    # fails the search with a clean LaunchTimeoutError naming the chunk
+    # and compile group (completed chunks stay resumable) instead of
+    # hanging the gather thread forever.  None/0 disables the watchdog
+    # (no wait threads are spawned).
+    launch_timeout_s: Optional[float] = None
+    # deterministic fault injection for tests/drills: "transient@3,oom@5"
+    # style spec (see faults.FaultPlan).  None defers to SST_FAULT_PLAN.
+    fault_plan: Any = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

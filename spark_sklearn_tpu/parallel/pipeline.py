@@ -169,11 +169,23 @@ class LaunchItem:
              in submission order; JAX dispatch is async so it returns as
              soon as the program is enqueued (first call may trace and
              compile — that wall lands in `dispatch_s`).
+    wait     (device outputs) -> device outputs, blocking until ready;
+             optional (default `jax.block_until_ready`).  The fault
+             supervisor (parallel/faults.py) installs its watchdog /
+             retry / bisection recovery here — errors from async
+             dispatch surface at this blocking point.
     gather   (device outputs) -> host results (the blocking transfer);
              optional.
     finalize (host results, LaunchTimings) -> None.  Runs in submission
              order; result-array writes, checkpointing, and report
              accounting belong here.
+
+    `bisect` / `host_fallback` are recovery hooks consumed by the fault
+    supervisor, never by the pipeline itself: `bisect(supervisor)`
+    re-runs the launch as narrower half-chunks after an OOM and returns
+    the merged result in `gather`'s output shape; `host_fallback()`
+    computes the same shape per-candidate on the host (exact sklearn
+    error_score semantics) when bisection bottoms out.
     """
 
     key: str
@@ -184,6 +196,9 @@ class LaunchItem:
     group: int = 0
     kind: str = "launch"
     n_tasks: int = 0
+    wait: Optional[Callable[[Any], Any]] = None
+    bisect: Optional[Callable[[Any], Any]] = None
+    host_fallback: Optional[Callable[[], Any]] = None
 
 
 class ChunkPipeline:
@@ -297,6 +312,16 @@ class ChunkPipeline:
         }
 
     # -- internals -------------------------------------------------------
+    @staticmethod
+    def _wait_item(item: LaunchItem, out):
+        """Block until `out` is ready via the item's wait hook (the
+        fault supervisor's interception point) or the plain jax wait.
+        Returns the outputs to gather — a recovery may substitute
+        them."""
+        if item.wait is not None:
+            return item.wait(out)
+        return jax.block_until_ready(out)
+
     def _record(self, item: LaunchItem, tm: LaunchTimings) -> None:
         rec = {
             "key": item.key, "group": item.group, "kind": item.kind,
@@ -346,7 +371,7 @@ class ChunkPipeline:
             t2 = time.perf_counter()
             tm.dispatch_s = t2 - t1
             with tr.span("compute.wait", key=item.key):
-                jax.block_until_ready(out)
+                out = self._wait_item(item, out)
             t3 = time.perf_counter()
             tm.compute_s = t3 - t2
             tr.record_span("compute", t2, t3, track="device",
@@ -406,7 +431,7 @@ class ChunkPipeline:
 
         def gather_job(item, out, t_dispatch0, t_dispatched, tm):
             with tr.span("compute.wait", key=item.key):
-                jax.block_until_ready(out)
+                out = self._wait_item(item, out)
             t_ready = time.perf_counter()
             t_head = max(t_dispatched, last_ready[0])
             tm.compute_s = t_ready - t_head

@@ -127,6 +127,17 @@ def pad_chunk(arr: np.ndarray, lo: int, hi: int, width: int,
         return chunk
 
 
+def split_range(lo: int, hi: int) -> Tuple[int, int, int]:
+    """Bisect the candidate range [lo, hi) for OOM recovery: returns
+    (lo, mid, hi) with both halves non-empty.  Callers re-pad each half
+    to its own launch width via :func:`pad_chunk` — the supervisor's
+    half-chunks are ordinary (narrower) chunks of the same compile
+    group."""
+    if hi - lo < 2:
+        raise ValueError(f"range [{lo}, {hi}) cannot be bisected")
+    return lo, lo + (hi - lo) // 2, hi
+
+
 def freeze(v: Any, strict: bool = False):
     """Recursively hashable view of nested params/arrays.
 

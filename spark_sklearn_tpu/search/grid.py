@@ -30,6 +30,7 @@ Execution: two tiers (SURVEY §7.0).
 from __future__ import annotations
 
 import numbers
+import threading
 import time
 import warnings
 from collections import OrderedDict, defaultdict
@@ -539,15 +540,29 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         family, X_arr, y, cands, splits,
                         fit_weight=fit_weight, score_weight=score_weight,
                         eval_ctxs=eval_ctxs)
+                except (KeyboardInterrupt, SystemExit):
+                    # an interactive abort / interpreter shutdown must
+                    # never be traded for a silent host re-run of the
+                    # whole grid (narrowed guard; Exception below never
+                    # caught these, but the contract is now explicit and
+                    # pinned by test)
+                    raise
                 except Exception as exc:  # unsupported static combo etc.
                     if self.backend == "tpu" or \
                             getattr(exc, "_sst_no_fallback", False):
                         # _sst_no_fallback: error_score='raise' with
-                        # invalid candidate params — sklearn raises this
-                        # exact exception; a host re-run would only repeat
-                        # the failure after redundant work
+                        # invalid candidate params (or a watchdog
+                        # LaunchTimeoutError — a hung device would only
+                        # wedge the host re-run's next compiled search)
+                        # — sklearn raises this exact exception; a host
+                        # re-run would only repeat the failure after
+                        # redundant work
                         raise
                     state["use_compiled"] = False  # fall back ONCE
+                    # recorded into the host report's faults block so
+                    # the fallback cause stays observable after the
+                    # compiled registry is replaced
+                    state["fallback_exc"] = exc
                     warnings.warn(
                         f"compiled search path failed ({exc!r}); falling "
                         "back to the host backend", UserWarning)
@@ -555,7 +570,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # sklearn estimators may validate its exact type); only the
             # compiled path needs the dense array form
             return self._fit_host(X, y, cands, splits, est_fit_params,
-                                  score_params, eval_ctxs)
+                                  score_params, eval_ctxs,
+                                  fallback_exc=state.pop(
+                                      "fallback_exc", None))
 
         def evaluate_candidates(candidate_params, callback_ctx=None):
             cands = list(candidate_params)
@@ -929,6 +946,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 x_dt = np.asarray(X).dtype
         oracle_proba_dt = np.float64 if (
             proba_rule == "float64" or x_dt != np.float32) else np.float32
+        # the pre-densified X (what sklearn estimators would see): the
+        # supervisor's per-candidate host fallback fits on THIS, so a
+        # bisection that bottoms out reproduces sklearn exactly
+        X_host = X
         X = self._densify(X, dtype)
         data, meta = family.prepare_data(X, y, dtype=dtype)
         meta["logloss_clip_eps"] = float(np.finfo(oracle_proba_dt).eps)
@@ -1189,6 +1210,77 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 max(1, max_tasks // max(n_folds, 1)),
                 n_task_shards))
 
+        host_scorer_cache: List[Any] = []
+
+        def host_eval(cand_indices):
+            """Per-candidate host execution for the supervisor's OOM
+            bottom-out: real `clone(est).set_params(**p)` fits via
+            sklearn `_fit_and_score` — exact sklearn error_score
+            semantics — returning (test, train) score dicts shaped
+            (len(cand_indices), n_folds) under the compiled scorer
+            names."""
+            from sklearn.metrics import check_scoring
+            from sklearn.model_selection._validation import (
+                _fit_and_score, _warn_or_raise_about_fit_failures)
+
+            if not host_scorer_cache:
+                if self.scoring is None or isinstance(self.scoring, str) \
+                        or callable(self.scoring):
+                    host_scorer_cache.append(
+                        check_scoring(self.estimator, self.scoring))
+                else:
+                    from sklearn.metrics._scorer import (
+                        _MultimetricScorer, _check_multimetric_scoring)
+                    sc = _check_multimetric_scoring(
+                        self.estimator, self.scoring)
+                    if set(sc) != set(scorer_names):
+                        # compiled names must address the same cells the
+                        # host scorer produces; a mismatch cannot be
+                        # recovered into cv_results_
+                        raise RuntimeError(
+                            "host fallback scorer names "
+                            f"{sorted(sc)} do not match compiled names "
+                            f"{sorted(scorer_names)}")
+                    host_scorer_cache.append(_MultimetricScorer(
+                        scorers=sc,
+                        raise_exc=(self.error_score == "raise")))
+            scorer = host_scorer_cache[0]
+            host_fit_params = ({"sample_weight": fit_weight}
+                               if fit_weight is not None else None)
+            host_score_params = ({"sample_weight": score_weight}
+                                 if score_weight is not None else None)
+            results = []
+            for ci in cand_indices:
+                for tr_idx, te_idx in splits:
+                    results.append(_fit_and_score(
+                        clone(self.estimator), X_host, y, scorer=scorer,
+                        train=tr_idx, test=te_idx, verbose=0,
+                        parameters=candidates[int(ci)],
+                        fit_params=host_fit_params,
+                        score_params=host_score_params,
+                        return_train_score=return_train,
+                        return_times=True,
+                        error_score=self.error_score))
+            _warn_or_raise_about_fit_failures(results, self.error_score)
+            n = len(cand_indices)
+            te = {s: np.empty((n, n_folds)) for s in scorer_names}
+            tr = ({s: np.empty((n, n_folds)) for s in scorer_names}
+                  if return_train else {})
+            for t, res in enumerate(results):
+                i, f = divmod(t, n_folds)
+                ts = res["test_scores"]
+                if not isinstance(ts, dict):
+                    ts = {s: ts for s in scorer_names}
+                for s in scorer_names:
+                    te[s][i, f] = ts.get(s, np.nan)
+                if return_train:
+                    trs = res.get("train_scores", {})
+                    if not isinstance(trs, dict):
+                        trs = {s: trs for s in scorer_names}
+                    for s in scorer_names:
+                        tr[s][i, f] = trs.get(s, np.nan)
+            return te, tr
+
         try:
             with debug_ctx:
                 self._run_groups(
@@ -1205,7 +1297,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     dtype=dtype, return_train=return_train,
                     test_scores=test_scores, train_scores=train_scores,
                     fit_times=fit_times, score_times=score_times, ckpt=ckpt,
-                    fit_failed=fit_failed, candidates=candidates)
+                    fit_failed=fit_failed, candidates=candidates,
+                    host_eval=host_eval)
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
@@ -1288,7 +1381,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     fit_masks, mesh, config, n_task_shards, task_shard,
                     max_cand_per_batch, n_folds, dtype, return_train,
                     test_scores, train_scores, fit_times, score_times, ckpt,
-                    fit_failed, candidates):
+                    fit_failed, candidates, host_eval=None):
         """Chunked launch schedule, executed through the pipelined chunk
         executor (parallel/pipeline.py).
 
@@ -1404,14 +1497,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 "chunks": chunks,
                 "n_live": sum(1 for c in chunks if c[3] is None)})
 
-        def build_programs(plan):
+        def build_programs(plan, width=None):
             """The group's jitted programs (cross-search cached); built
-            on first need so fully-resumed groups never trace."""
-            progs = plan.get("progs")
+            on first need so fully-resumed groups never trace.  `width`
+            overrides the group's uniform chunk width — the supervisor's
+            OOM bisection relaunches at half width, which is a distinct
+            compiled program."""
+            nc_batch = width or plan["nc_batch"]
+            cache = plan.setdefault("progs_by_width", {})
+            progs = cache.get(nc_batch)
             if progs is not None:
                 return progs
             static = plan["static"]
-            nc_batch = plan["nc_batch"]
             donate_kw = {"donate_argnums": (0,)} if donate else {}
 
             if task_batched:
@@ -1558,7 +1655,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 lambda: jax.jit(score_batch))
             progs = {"fit": fit_jit, "score": score_jit,
                      "fused": fused_jit}
-            plan["progs"] = progs
+            cache[nc_batch] = progs
             return progs
 
         def group_masks(plan):
@@ -1575,6 +1672,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     tb_mask_shard)
                 plan["w_task_dev"] = w
             return w
+
+        #: guards the per-plan staged-chunk bookkeeping: stage normally
+        #: runs on the single stage thread, but supervisor retries
+        #: re-stage on whichever thread is recovering
+        stage_lock = threading.Lock()
 
         cache0 = persistent_cache_counts()
         builds0 = _program_build_count()
@@ -1653,6 +1755,99 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                  "falling back to jit", exc)
             plan["fused_call"] = call
             return call
+
+        # ------------------------------------------------------------------
+        # OOM recovery: bisected relaunch + per-candidate host bottom-out
+        # (hooks consumed by the launch supervisor, parallel/faults.py)
+        # ------------------------------------------------------------------
+        def host_fused_range(plan, lo, hi, sup, chunk_id):
+            """Candidates [lo, hi) of the plan's group on the host —
+            sklearn `_fit_and_score` per (candidate, fold) with exact
+            error_score semantics — shaped like the fused gather."""
+            idx = plan["group"].candidate_indices[lo:hi]
+            sup.record_host_fallback(f"{chunk_id}[{lo}:{hi}]",
+                                     plan["gi"], len(idx) * n_folds)
+            te, tr = host_eval(idx)
+            bad = np.zeros((hi - lo, n_folds), bool)
+            return te, tr, bad, -1, -1
+
+        def merge_fused(a, b):
+            te = {s: np.concatenate([a[0][s], b[0][s]]) for s in a[0]}
+            tr = {s: np.concatenate([a[1][s], b[1][s]]) for s in a[1]}
+            bad = np.concatenate([a[2], b[2]])
+            im = max(a[3], b[3])
+            isum = a[4] + b[4] if a[4] >= 0 and b[4] >= 0 \
+                else max(a[4], b[4])
+            return te, tr, bad, im, isum
+
+        def exec_fused_range(plan, lo, hi, sup, chunk_id):
+            """Relaunch candidates [lo, hi) as one fused program at the
+            narrowest padded width (lanes re-padded via
+            taskgrid.pad_chunk), recursing on further OOMs down to
+            single candidates and finally the host path.  Returns
+            host-side (te, tr, bad, iters, iters_sum) with exactly
+            hi - lo real rows — per-lane results are bit-identical to
+            the full-width launch (vmap lanes are independent), so a
+            successful recovery keeps cv_results_ exact."""
+            group = plan["group"]
+            n = hi - lo
+            width = max(n_task_shards,
+                        mesh_lib.pad_to_multiple(n, n_task_shards))
+            key = f"{chunk_id}[{lo}:{hi}]"
+
+            def attempt():
+                progs = build_programs(plan, width=width)
+                dyn = {}
+                for k, arr in group.dynamic_params.items():
+                    dyn[k] = jax.device_put(
+                        pad_chunk(arr, lo, hi, width,
+                                  n_folds if task_batched else 1),
+                        task_shard)
+                if not dyn and not task_batched:
+                    dyn["_pad"] = jax.device_put(
+                        np.zeros(width, dtype=dtype), task_shard)
+                w = (jax.device_put(np.tile(fit_masks, (width, 1)),
+                                    tb_mask_shard)
+                     if task_batched else fit_dev)
+                out = progs["fused"](dyn, data_dev, w, test_dev,
+                                     train_sc_dev, test_unw_dev,
+                                     train_unw_dev)
+                out = sup.wait_ready(out, key=key, group=plan["gi"])
+                te_d, tr_d, bad_d, im_d, isum_d = out
+                te = {s: np.asarray(mesh_lib.device_get_tree(v))[:n]
+                      for s, v in te_d.items()}
+                tr = {s: np.asarray(mesh_lib.device_get_tree(v))[:n]
+                      for s, v in tr_d.items()}
+                bad = np.asarray(mesh_lib.device_get_tree(bad_d))[:n]
+                return te, tr, bad, int(im_d), int(isum_d)
+
+            try:
+                return sup.call(attempt, key=key, group=plan["gi"],
+                                n_real=n)
+            except Exception as exc:
+                from spark_sklearn_tpu.parallel import faults as _faults
+                if not _faults.is_oom(exc):
+                    raise
+                if n <= 1:
+                    return host_fused_range(plan, lo, hi, sup, chunk_id)
+                sup.record_bisection(key, plan["gi"])
+                from spark_sklearn_tpu.parallel.taskgrid import split_range
+                lo_, mid, hi_ = split_range(lo, hi)
+                return merge_fused(
+                    exec_fused_range(plan, lo_, mid, sup, chunk_id),
+                    exec_fused_range(plan, mid, hi_, sup, chunk_id))
+
+        def make_bisect_fused(plan, lo, hi, chunk_id):
+            def bisect(sup):
+                if hi - lo <= 1:
+                    return host_fused_range(plan, lo, hi, sup, chunk_id)
+                sup.record_bisection(chunk_id, plan["gi"])
+                from spark_sklearn_tpu.parallel.taskgrid import split_range
+                lo_, mid, hi_ = split_range(lo, hi)
+                return merge_fused(
+                    exec_fused_range(plan, lo_, mid, sup, chunk_id),
+                    exec_fused_range(plan, mid, hi_, sup, chunk_id))
+            return bisect
 
         def write_cells(plan, idx, lo, hi, chunk_id, te, tr, t_fit,
                         t_score):
@@ -1755,7 +1950,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     live_seen += 1
                     n_real = (hi - lo) * n_folds
 
-                    def stage(lo=lo, hi=hi, plan=plan):
+                    def stage(lo=lo, hi=hi, plan=plan, chunk_id=chunk_id):
                         dyn = {}
                         for k, arr in plan["group"].dynamic_params.items():
                             dyn[k] = jax.device_put(
@@ -1773,11 +1968,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         # once the group's last live chunk has staged,
                         # drop the plan's tiled-mask reference (each
                         # payload keeps its own) so one group's masks
-                        # never outlive its launches — stage runs on a
-                        # single thread, so the count is race-free
-                        plan["n_staged"] = plan.get("n_staged", 0) + 1
-                        if plan["n_staged"] >= plan["n_live"]:
-                            plan.pop("w_task_dev", None)
+                        # never outlive its launches.  Tracked as a set
+                        # of chunk ids under a lock: the supervisor's
+                        # transient retries re-stage on the recovering
+                        # thread, concurrent with the stage thread, and
+                        # a re-staged chunk must not count twice
+                        with stage_lock:
+                            done = plan.setdefault("staged_ids", set())
+                            done.add(chunk_id)
+                            if len(done) >= plan["n_live"]:
+                                plan.pop("w_task_dev", None)
                         return dyn, w
 
                     if fused_mode and live_seen > 1:
@@ -1808,10 +2008,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             # lane count, which is what the launch
                             # actually computes — the rest is fit, so
                             # the score-time column is an estimate,
-                            # never a silent 0.0
-                            t_score = min(gstate["sspt"] * lanes, wall)
+                            # never a silent 0.0 (unless calibration
+                            # itself was lost to OOM recovery: sspt 0.0)
+                            t_score = min((gstate["sspt"] or 0.0) * lanes,
+                                          wall)
                             t_fit = wall - t_score
-                            fit_failed[idx, :] |= bad[:hi - lo]
+                            fit_failed[idx, :] |= np.asarray(
+                                bad[:hi - lo], bool)
                             if im >= 0:
                                 record_iters(im, isum, lanes)
                             write_cells(plan, idx, lo, hi, chunk_id,
@@ -1820,7 +2023,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         yield LaunchItem(
                             key=chunk_id, kind="fused", group=gi,
                             n_tasks=n_real, stage=stage, launch=launch,
-                            gather=gather, finalize=finalize)
+                            gather=gather, finalize=finalize,
+                            bisect=make_bisect_fused(plan, lo, hi,
+                                                     chunk_id))
                         continue
 
                     # first live chunk of the group (or the never-fused
@@ -1868,20 +2073,40 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                          lanes)
                         cstate["t_fit"] = tm.dispatch_s + tm.compute_s
 
+                    def host_fb_fit(idx=idx, cstate=cstate):
+                        # the whole chunk (fit AND scores) degrades to
+                        # per-candidate host execution; the score item
+                        # consumes the stashed cells instead of
+                        # launching
+                        te, tr = host_eval(idx)
+                        cstate["host"] = (te, tr)
+                        return (None, None)
+
                     yield LaunchItem(
                         key=chunk_id + ":fit", kind="fit", group=gi,
                         n_tasks=n_real, stage=stage, launch=launch_fit,
-                        gather=gather_fit, finalize=fin_fit)
+                        gather=gather_fit, finalize=fin_fit,
+                        host_fallback=host_fb_fit)
 
                     def launch_score(payload, plan=plan, cstate=cstate):
+                        if "host" in cstate:
+                            return None   # chunk recovered on the host
                         return build_programs(plan)["score"](
                             cstate["models"], data_dev, test_dev,
                             train_sc_dev, test_unw_dev, train_unw_dev)
 
-                    def gather_score(out):
+                    def gather_score(out, cstate=cstate):
+                        if out is None and "host" in cstate:
+                            return cstate.pop("host")
                         te, tr = out
                         return (mesh_lib.device_get_tree(te),
                                 mesh_lib.device_get_tree(tr))
+
+                    def host_fb_score(idx=idx, cstate=cstate):
+                        if "host" in cstate:
+                            return cstate.pop("host")
+                        cstate.pop("models", None)
+                        return host_eval(idx)
 
                     def fin_score(host, tm, plan=plan, idx=idx, lo=lo,
                                   hi=hi, chunk_id=chunk_id, cstate=cstate,
@@ -1897,7 +2122,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     yield LaunchItem(
                         key=chunk_id + ":score", kind="score", group=gi,
                         n_tasks=n_real, launch=launch_score,
-                        gather=gather_score, finalize=fin_score)
+                        gather=gather_score, finalize=fin_score,
+                        host_fallback=host_fb_score)
 
                     if calibrate:
                         # calibration: a SECOND, warm score launch (the
@@ -1909,14 +2135,32 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         # cells — sklearn never ran it)
 
                         def launch_cal(payload, plan=plan,
-                                       cstate=cstate):
+                                       cstate=cstate, gstate=gstate):
+                            models = cstate.pop("models", None)
+                            if models is None:
+                                # the chunk recovered on the host: no
+                                # device models to calibrate with
+                                gstate["cal_skip"] = True
+                                return None
                             return build_programs(plan)["score"](
-                                cstate.pop("models"), data_dev, test_dev,
+                                models, data_dev, test_dev,
                                 train_sc_dev, test_unw_dev,
                                 train_unw_dev)
 
+                        def host_fb_cal(cstate=cstate, gstate=gstate):
+                            cstate.pop("models", None)
+                            gstate["cal_skip"] = True
+                            return None
+
                         def fin_cal(host, tm, plan=plan, gstate=gstate,
                                     lanes=lanes):
+                            if gstate.pop("cal_skip", False):
+                                # calibration lost to recovery: later
+                                # fused chunks attribute a zero score
+                                # share (documented estimate, not a
+                                # silent wrong one)
+                                gstate["sspt"] = 0.0
+                                return
                             wall = tm.dispatch_s + tm.compute_s
                             # per PADDED lane: the launch computes
                             # nc_batch lanes regardless of how many are
@@ -1934,10 +2178,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         yield LaunchItem(
                             key=chunk_id + ":calibrate", kind="calibrate",
                             group=gi, n_tasks=n_real, launch=launch_cal,
-                            finalize=fin_cal)
+                            finalize=fin_cal, host_fallback=host_fb_cal)
 
+        # every LaunchItem runs under the fault supervisor: transient
+        # retry with backoff, OOM bisection through the hooks above, a
+        # watchdog on the blocking wait, and deterministic injection for
+        # tests — identical at every pipeline depth (same item order)
+        from spark_sklearn_tpu.parallel.faults import LaunchSupervisor
+        supervisor = LaunchSupervisor(
+            config, faults=metrics.struct("faults"), ckpt=ckpt,
+            verbose=self.verbose)
         try:
-            pipe.run(chunk_items())
+            pipe.run(supervisor.wrap(chunk_items()))
         finally:
             # the compile thread traces under this search's jax config
             # (e.g. temporarily-enabled x64): join it before returning
@@ -2009,7 +2261,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
     # Tier B: host fallback (full sklearn generality)
     # ------------------------------------------------------------------
     def _fit_host(self, X, y, candidates, splits, fit_params,
-                  score_params=None, eval_ctxs=None):
+                  score_params=None, eval_ctxs=None, fallback_exc=None):
         from joblib import Parallel, delayed
         from sklearn.metrics import check_scoring
         from sklearn.metrics._scorer import _check_multimetric_scoring
@@ -2046,6 +2298,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         metrics.gauge("n_tasks").set(len(tasks))
         metrics.gauge("n_jobs").set(
             self.n_jobs if self.n_jobs is not None else 1)
+        faults = metrics.struct("faults")
+        if fallback_exc is not None:
+            # the caught exception type that pushed the compiled tier to
+            # fall back here (the compiled registry — and its faults
+            # journal — was replaced by this host one)
+            faults["fallback_exception"] = (
+                f"{type(fallback_exc).__name__}: "
+                f"{fallback_exc}"[:200])
         self._search_metrics = metrics
         self._search_report = metrics.data
 

@@ -34,17 +34,25 @@ def fingerprint(*parts) -> str:
 
 
 class SearchCheckpoint:
-    """Append-only chunk log: one json line per completed chunk."""
+    """Append-only chunk log: one json line per completed chunk, plus
+    fault-journal lines (``fault_chunk_id`` records, written by the
+    launch supervisor before each recovery attempt) that resume loaders
+    collect into :attr:`faults` without ever mistaking them for
+    results."""
 
     def __init__(self, directory: str, key: str):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"search_{key}.jsonl")
         self._done: Dict[str, Dict[str, Any]] = {}
+        self.faults: list = []
         if os.path.exists(self.path):
             with open(self.path) as f:
                 for line in f:
                     try:
                         rec = json.loads(line)
+                        if "fault_chunk_id" in rec:
+                            self.faults.append(rec)
+                            continue
                         self._done[rec["chunk_id"]] = rec
                     except (json.JSONDecodeError, KeyError):
                         continue  # torn tail line from a crash
@@ -60,6 +68,19 @@ class SearchCheckpoint:
             os.fsync(f.fileno())
         self._done[chunk_id] = record
 
+    def note_fault(self, chunk_id: str, info: Dict[str, Any]):
+        """Durably journal a recovery event BEFORE the retry runs, so a
+        recovery that then dies still leaves the fault on disk for the
+        resumed process.  Keyed ``fault_chunk_id`` (never ``chunk_id``)
+        so no loader — including pre-fault-journal ones, which skip the
+        line on KeyError — can mistake it for a completed chunk."""
+        rec = {"fault_chunk_id": chunk_id, **info}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.faults.append(rec)
+
     @property
     def n_done(self) -> int:
         return len(self._done)
@@ -67,7 +88,12 @@ class SearchCheckpoint:
 
 def save_pytree(path: str, tree) -> None:
     """Flat-key npz serialisation of a model pytree (TpuModel.model or a
-    keyed fleet's stacked models)."""
+    keyed fleet's stacked models).
+
+    Atomic: the archive is written to a temp file in the same directory,
+    fsynced, then ``os.replace``d over the target — a crash mid-save can
+    never leave a truncated ``.npz`` that poisons the next resume (the
+    target either holds the old complete archive or the new one)."""
     import jax
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     arrays = {}
@@ -77,7 +103,19 @@ def save_pytree(path: str, tree) -> None:
         arrays[f"leaf_{i}"] = np.asarray(leaf)
     arrays["__keys__"] = np.array(keys)
     arrays["__treedef__"] = np.array([str(treedef)])
-    np.savez(path, **arrays)
+    # np.savez(path) appends ".npz" to extension-less paths; resolve the
+    # real target up front so the temp file replaces the right name
+    target = path if str(path).endswith(".npz") else f"{path}.npz"
+    tmp = f"{target}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def load_pytree(path: str, like=None):

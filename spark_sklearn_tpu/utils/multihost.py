@@ -43,7 +43,15 @@ def worker_main(coordinator: str, n_proc: int, pid: int, n_dev: int) -> int:
     # platform must be pinned before any backend init; config calls (not
     # env vars) because the axon sitecustomize imports jax first
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_dev)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_dev)
+    except AttributeError:
+        # jax < 0.5 has no such option; XLA_FLAGS is read at backend
+        # INIT (not import), so setting it here — before jax.devices()
+        # — still takes effect despite the sitecustomize's early import
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_dev}")
 
     from spark_sklearn_tpu.utils.session import init_distributed
     init_distributed(coordinator_address=coordinator,
@@ -86,13 +94,86 @@ def worker_main(coordinator: str, n_proc: int, pid: int, n_dev: int) -> int:
     return 0
 
 
+def _wait_procs(procs, timeout_s: float, grace_s: float = 10.0):
+    """Reap a cluster's worker processes under one shared deadline.
+
+    Per-worker semantics: each process must exit before `timeout_s`
+    elapses (a shared wall — a multi-controller cluster's workers
+    finish together or not at all).  The moment ANY worker fails or
+    times out, the rest get `grace_s` to exit (their peer's death
+    typically wedges their next collective forever) and are then
+    killed and reaped — no straggler is ever left waiting without a
+    deadline.
+
+    Returns (outs, failed_idx, timed_out_idx): per-process output
+    strings and the process indices that exited nonzero / were killed.
+    """
+    import threading
+
+    # drain every worker's stdout on a reader thread: a chatty worker
+    # (crash tracebacks, verbose XLA logs) would otherwise fill the OS
+    # pipe buffer, block in write(), and look "hung" until the deadline
+    drained: dict = {}
+
+    def _reader(pid, stream):
+        try:
+            drained[pid] = stream.read() or ""
+        except (OSError, ValueError):         # pragma: no cover
+            drained[pid] = "<output unreadable>"
+
+    readers = {}
+    for pid, p in enumerate(procs):
+        if p.stdout is not None:
+            t = threading.Thread(target=_reader, args=(pid, p.stdout),
+                                 daemon=True)
+            t.start()
+            readers[pid] = t
+
+    deadline = time.time() + timeout_s
+    pending = dict(enumerate(procs))
+    failed_idx, timed_out_idx = [], []
+    while pending and time.time() < deadline:
+        for pid in list(pending):
+            p = pending[pid]
+            if p.poll() is None:
+                continue
+            del pending[pid]
+            if p.returncode != 0:
+                failed_idx.append(pid)
+                # fail fast: a dead cluster process wedges its peers'
+                # next collective — give them a short grace, not the
+                # whole budget
+                deadline = min(deadline, time.time() + grace_s)
+        if pending:
+            time.sleep(0.1)
+    for pid, p in sorted(pending.items()):   # stragglers: kill and reap
+        timed_out_idx.append(pid)
+        p.kill()
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:     # pragma: no cover
+            pass
+    outs = []
+    for pid, p in enumerate(procs):
+        t = readers.get(pid)
+        if t is not None:
+            t.join(timeout=30)
+        out = drained.get(pid, "")
+        if pid in timed_out_idx:
+            out += "\n<killed: exceeded deadline>"
+        outs.append(out)
+    return outs, sorted(failed_idx), sorted(timed_out_idx)
+
+
 def dryrun_multihost(n_proc: int = 2, n_dev: int = 2,
                      timeout_s: int = 600) -> None:
     """Spawn an n_proc-process CPU cluster and run one sharded search.
 
-    Raises RuntimeError with each process's output on failure, so a
-    sandbox that forbids subprocesses or localhost sockets is flagged
-    clearly rather than silently skipped."""
+    Raises RuntimeError naming WHICH process index died (plus every
+    process's output) on failure, so a sandbox that forbids
+    subprocesses or localhost sockets is flagged clearly rather than
+    silently skipped.  Worker waits carry a per-worker deadline: a hung
+    worker is killed and reaped, never awaited forever."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)   # worker pins platform itself
@@ -103,25 +184,26 @@ def dryrun_multihost(n_proc: int = 2, n_dev: int = 2,
              "--worker", coordinator, str(n_proc), str(pid), str(n_dev)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env))
-    deadline = time.time() + timeout_s
-    outs = []
-    failed = False
-    for pid, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=max(5, deadline - time.time()))
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-            out += "\n<timeout>"
-            failed = True
-        outs.append(f"--- proc {pid} (rc={p.returncode}) ---\n{out}")
-        failed = failed or p.returncode != 0
-    if failed:
+    outs, failed_idx, timed_out_idx = _wait_procs(procs, timeout_s)
+    if failed_idx or timed_out_idx:
+        blame = []
+        if failed_idx:
+            blame.append("proc(s) %s exited nonzero (%s)" % (
+                failed_idx,
+                ", ".join(f"{i}: rc={procs[i].returncode}"
+                          for i in failed_idx)))
+        if timed_out_idx:
+            blame.append(f"proc(s) {timed_out_idx} killed after "
+                         f"{timeout_s}s deadline")
+        detail = "\n".join(
+            f"--- proc {pid} (rc={p.returncode}) ---\n{outs[pid]}"
+            for pid, p in enumerate(procs))
         raise RuntimeError(
-            "dryrun_multihost failed (sandbox may forbid subprocesses or "
-            "localhost sockets):\n" + "\n".join(outs))
-    for o in outs:
-        print(o.strip())
+            "dryrun_multihost failed: " + "; ".join(blame)
+            + " (sandbox may forbid subprocesses or localhost "
+            "sockets):\n" + detail)
+    for pid, o in enumerate(outs):
+        print(f"--- proc {pid} (rc=0) ---\n{o}".strip())
     print(f"dryrun_multihost({n_proc} procs x {n_dev} devices) OK")
 
 

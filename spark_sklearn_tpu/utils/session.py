@@ -45,12 +45,26 @@ class TpuSession:
             enable_persistent_cache(
                 self.config.resolved_cache_dir(),
                 self.config.persistent_cache_min_compile_s)
+            # parse the fault-injection plan NOW so a typo in
+            # TpuConfig(fault_plan=...) / SST_FAULT_PLAN fails loudly at
+            # session construction, not halfway through a long search
+            from spark_sklearn_tpu.parallel.faults import FaultPlan
+            self.fault_plan = FaultPlan.resolve(self.config)
         # structured logging channel (never stdout: the session has no
         # legacy print contract)
         logger.info("TpuSession %r: mesh=%s, cache_dir=%r", appName,
                     dict(self.mesh.shape),
                     self.config.resolved_cache_dir(),
                     appName=appName, n_devices=self.mesh.size)
+        logger.info(
+            "fault supervisor: max_launch_retries=%d "
+            "max_search_retries=%d backoff=%.2fs timeout=%s "
+            "fault_plan=%d injection(s)",
+            getattr(self.config, "max_launch_retries", 2),
+            getattr(self.config, "max_search_retries", 16),
+            getattr(self.config, "retry_backoff_s", 0.5),
+            getattr(self.config, "launch_timeout_s", None),
+            len(self.fault_plan))
 
     @property
     def n_devices(self) -> int:

@@ -12,6 +12,7 @@ import signal
 import subprocess
 import sys
 import time
+import warnings
 
 import numpy as np
 import pytest
@@ -45,6 +46,83 @@ def _checkpoint_records(ckpt_dir):
             with open(os.path.join(ckpt_dir, name)) as f:
                 total += sum(1 for _ in f)
     return total
+
+
+#: the shared family fixture matrix (mirrors test_pipeline's): each
+#: entry is (estimator factory, grid, config kwargs forcing several
+#: chunks/groups, hung launch index for run 1).  The hung index names a
+#: launch past the first durable chunk record so run 1 dies genuinely
+#: mid-compile-group.
+def _family_matrix():
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.neighbors import KNeighborsClassifier
+    return {
+        # sorted chunking: 5+ chunks in one group; hung@5 = a fused
+        # steady-state chunk
+        "logreg": (lambda: LogisticRegression(max_iter=10),
+                   {"C": np.logspace(-2, 1, 40).tolist()}, {}, 5),
+        # 20 candidates chunked at width 8 (max_tasks_per_batch=16,
+        # cv=2): fit/score/calibrate + 2 fused; hung@4 = last fused
+        "gnb": (lambda: GaussianNB(),
+                {"var_smoothing": np.logspace(-9, -3, 20).tolist()},
+                {"max_tasks_per_batch": 16}, 4),
+        # two compile groups (weights is static): group 1's launches
+        # are durable before hung@3 kills group 2's score launch
+        "knn": (lambda: KNeighborsClassifier(),
+                {"n_neighbors": [3, 5],
+                 "weights": ["uniform", "distance"]}, {}, 3),
+    }
+
+
+@pytest.mark.parametrize("fam", ["logreg", "gnb", "knn"])
+def test_mid_group_fault_retry_resume_parity(digits, tmp_path, fam):
+    """Recovery-vs-parity across the family matrix: run 1 dies to an
+    injected hang mid-compile-group (earlier chunks durable); run 2
+    resumes AND hits an injected transient fault that the supervisor
+    retries; the recovered cv_results_ must be exact-equal to an
+    uninterrupted fault-free baseline."""
+    make_est, grid, cfg_kw, hung_at = _family_matrix()[fam]
+    X, y = digits
+    Xs, ys = X[:240], y[:240]
+
+    def run(config):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return sst.GridSearchCV(
+                make_est(), grid, cv=2, refit=False, backend="tpu",
+                config=config).fit(Xs, ys)
+
+    baseline = run(sst.TpuConfig(**cfg_kw))
+
+    ckpt = str(tmp_path / "ckpt")
+    with pytest.raises(TimeoutError):
+        run(sst.TpuConfig(checkpoint_dir=ckpt,
+                          fault_plan=f"hung@{hung_at}", **cfg_kw))
+    n_durable = sum(
+        1 for name in os.listdir(ckpt) if name.endswith(".jsonl")
+        for line in open(os.path.join(ckpt, name))
+        if '"chunk_id"' in line)
+    assert n_durable >= 1, "the hang left nothing durable"
+
+    # resume: launch index 0 is the first LIVE (non-resumed) launch —
+    # the retried-by-supervisor fault lands mid-recovery
+    resumed = run(sst.TpuConfig(checkpoint_dir=ckpt,
+                                fault_plan="transient@0",
+                                retry_backoff_s=0.01, **cfg_kw))
+    rep = resumed.search_report
+    assert rep["n_chunks_resumed"] >= 1
+    assert rep["faults"]["retries"] >= 1
+
+    for key, col in baseline.cv_results_.items():
+        if "time" in key:
+            continue
+        if key == "params":
+            assert col == resumed.cv_results_[key]
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(col), np.asarray(resumed.cv_results_[key]),
+                err_msg=key)
 
 
 @pytest.mark.slow
