@@ -316,6 +316,26 @@ def _faults_summary(report):
     return f
 
 
+def _dataplane_summary(report):
+    """The search's transfer counters (search_report["dataplane"]) plus
+    the padding_waste histogram — recorded per leg so successive
+    BENCH_r*.json files show the host->device byte trend and how much
+    launch compute was padding."""
+    dp = dict(report.get("dataplane", {}))
+    out = {k: dp[k] for k in (
+        "enabled", "hits", "misses", "bytes_uploaded", "bytes_tiled",
+        "bytes_staged", "mask_tiling") if k in dp}
+    pw = report.get("padding_waste")
+    if pw:
+        out["padding_waste"] = dict(pw)
+    geo = report.get("geometry")
+    if geo:
+        out["geometry"] = {k: geo[k] for k in (
+            "mode", "source", "planned_launches", "planned_waste_frac")
+            if k in geo}
+    return out
+
+
 def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
                  max_iter=100, measure_bf16=False, serial_subsample=20):
     """BASELINE config #1 at north-star scale: LogReg C-grid on digits.
@@ -370,6 +390,11 @@ def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
             k: v for k, v in gs2.search_report.get(
                 "pipeline", {}).items() if k != "launches"},
         "faults": _faults_summary(gs2.search_report),
+        # data-plane traffic: the cold search uploads, the warm search
+        # must show hits and (near-)zero cacheable bytes — the transfer
+        # trend future BENCH_r*.json compare against
+        "dataplane_cold": _dataplane_summary(gs.search_report),
+        "dataplane_warm": _dataplane_summary(gs2.search_report),
     }
 
     # MFU accounting (honest: digits is latency-bound — 64 features
@@ -493,6 +518,7 @@ def leg_svc_mxu(cache_dir=None, n=10_000, d=784, folds=3, max_iter=100,
         "best_score": round(float(
             svc.cv_results_["mean_test_score"].max()), 4),
         "faults": _faults_summary(rep),
+        "dataplane": _dataplane_summary(rep),
     }
 
 
@@ -523,7 +549,8 @@ def leg_svc_digits(cache_dir=None, n_C=8, n_gamma=8, folds=3,
             "fits_per_sec": round(n_fits / w, 2),
             "best_score": round(float(
                 svc.cv_results_["mean_test_score"].max()), 4),
-            "faults": _faults_summary(svc.search_report)}
+            "faults": _faults_summary(svc.search_report),
+            "dataplane": _dataplane_summary(svc.search_report)}
 
 
 def leg_config3_rf(cache_dir=None, n=20_000, d=54, n_classes=7, n_iter=8,
@@ -554,7 +581,8 @@ def leg_config3_rf(cache_dir=None, n=20_000, d=54, n_classes=7, n_iter=8,
             "wall_s": round(w, 2),
             "fits_per_sec": round(n_iter * folds / w, 2),
             "backend": rs.search_report["backend"],
-            "faults": _faults_summary(rs.search_report)}
+            "faults": _faults_summary(rs.search_report),
+            "dataplane": _dataplane_summary(rs.search_report)}
 
 
 def leg_config4_gbr(cache_dir=None, n=20_000, d=8, folds=3,
@@ -585,7 +613,8 @@ def leg_config4_gbr(cache_dir=None, n=20_000, d=8, folds=3,
             "wall_s": round(w, 2),
             "fits_per_sec": round(n_fits / w, 2),
             "backend": gbr.search_report["backend"],
-            "faults": _faults_summary(gbr.search_report)}
+            "faults": _faults_summary(gbr.search_report),
+            "dataplane": _dataplane_summary(gbr.search_report)}
 
 
 def leg_config5_mlp(cache_dir=None, hidden=64, max_iter=60, folds=3,
@@ -618,7 +647,8 @@ def leg_config5_mlp(cache_dir=None, hidden=64, max_iter=60, folds=3,
             "wall_s": round(w, 2),
             "fits_per_sec": round(n_fits / w, 2),
             "backend": mlp.search_report["backend"],
-            "faults": _faults_summary(mlp.search_report)}
+            "faults": _faults_summary(mlp.search_report),
+            "dataplane": _dataplane_summary(mlp.search_report)}
 
 
 #: tiny search run by the persistent-cache probe subprocesses: shapes

@@ -46,6 +46,45 @@ PY
 JAX_PLATFORMS=cpu python tools/trace_summary.py "$OBS_TRACE"
 rm -f "$OBS_TRACE"
 
+echo "== data-plane smoke (two searches, one session: cached broadcast) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+sess = sst.createLocalTpuSession("dataplane-smoke")
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+
+
+def run():
+    return sst.GridSearchCV(LogisticRegression(max_iter=10),
+                            {"C": [0.1, 1.0, 10.0]}, cv=2, refit=False,
+                            backend="tpu", config=sess.config).fit(X, y)
+
+
+first, second = run(), run()
+d1 = first.search_report["dataplane"]
+d2 = second.search_report["dataplane"]
+# the first search populated the plane...
+assert d1["enabled"] and d1["misses"] > 0, d1
+# ...and the second reused EVERYTHING cacheable: nonzero hits, zero
+# re-upload of X/y/masks (only per-chunk dyn staging still transfers)
+assert d2["hits"] > 0, d2
+assert d2["misses"] == 0 and d2["bytes_uploaded"] == 0, d2
+np.testing.assert_array_equal(first.cv_results_["mean_test_score"],
+                              second.cv_results_["mean_test_score"])
+geo = second.search_report["geometry"]
+assert geo["mode"] in ("auto", "fixed") and geo["groups"], geo
+print("dataplane smoke:", {k: d2[k] for k in
+                           ("hits", "misses", "bytes_uploaded",
+                            "bytes_staged")},
+      "geometry:", geo["source"], [g["width"] for g in geo["groups"]])
+PY
+
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'
 import numpy as np

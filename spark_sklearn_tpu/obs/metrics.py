@@ -29,6 +29,8 @@ __all__ = [
     "SEARCH_REPORT_SCHEMA",
     "PIPELINE_BLOCK_SCHEMA",
     "FAULTS_BLOCK_SCHEMA",
+    "DATAPLANE_BLOCK_SCHEMA",
+    "GEOMETRY_BLOCK_SCHEMA",
     "search_registry",
     "schema_markdown",
 ]
@@ -116,6 +118,20 @@ SEARCH_REPORT_SCHEMA = (
         "one did.",
         backends="tpu,host"),
     MetricDef(
+        "dataplane", "struct",
+        "The device data plane's traffic during this search (see the "
+        "dataplane-block schema below): cache hits/misses, bytes "
+        "uploaded vs reused, staging bytes, and the plane's "
+        "end-of-search state (parallel/dataplane.py)."),
+    MetricDef(
+        "geometry", "struct",
+        "The waste-aware launch-geometry plan this search ran under "
+        "(see the geometry-block schema below): per-group chunk "
+        "widths, the cost model that chose them, and whether the plan "
+        "was computed, served from the in-process plan cache, or "
+        "replayed from the checkpoint journal "
+        "(parallel/taskgrid.plan_geometry)."),
+    MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
         backends="host"),
@@ -161,11 +177,87 @@ PIPELINE_BLOCK_SCHEMA = (
     MetricDef("persistent_cache_misses", "counter",
               "Persistent XLA compilation-cache misses during this "
               "search."),
+    MetricDef("stage_bytes_total", "gauge",
+              "Total host->device bytes the launches' stage phases "
+              "transferred (data-plane accounting; cache hits "
+              "transfer nothing and count zero)."),
     MetricDef("launches", "series",
               "One record per launch: key, group, kind "
-              "(fit/score/calibrate/fused), n_tasks and per-phase "
+              "(fit/score/calibrate/fused), n_tasks, stage_bytes "
+              "(host->device transfer during its stage) and per-phase "
               "walls (stage_s/stage_wait_s/dispatch_s/compute_s/"
               "gather_s/finalize_s)."),
+)
+
+#: sub-keys of ``search_report["dataplane"]`` (written by
+#: ``parallel.dataplane.report_block``) — this search's broadcast-cache
+#: traffic plus the plane's end-of-search state.
+DATAPLANE_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Whether the device data plane was active "
+              "(TpuConfig.dataplane_bytes > 0)."),
+    MetricDef("hits", "counter",
+              "Cache hits this search: device arrays (X/y, fold "
+              "masks, tiled masks, pad zeros) reused without any "
+              "host->device transfer."),
+    MetricDef("misses", "counter",
+              "Cache misses this search (each one uploaded or "
+              "device-tiled a new resident entry)."),
+    MetricDef("evictions", "counter",
+              "LRU entries dropped this search to respect the byte "
+              "budget."),
+    MetricDef("bytes_uploaded", "gauge",
+              "Host->device bytes of CACHEABLE broadcast traffic this "
+              "search (X/y, fold masks, pad zeros).  Zero on a fully "
+              "warm search — the acceptance signal that nothing was "
+              "re-shipped."),
+    MetricDef("bytes_tiled", "gauge",
+              "Bytes materialized by ON-DEVICE mask tiling this "
+              "search (no host->device transfer; replaces the host "
+              "np.tile + upload per compile group)."),
+    MetricDef("bytes_staged", "gauge",
+              "Host->device bytes of per-chunk dynamic-parameter "
+              "staging this search (inherently per-launch; not "
+              "cacheable)."),
+    MetricDef("n_entries", "gauge",
+              "Entries resident in the plane after the search."),
+    MetricDef("bytes_in_cache", "gauge",
+              "Bytes resident in the plane after the search."),
+    MetricDef("budget_bytes", "gauge",
+              "The plane's byte budget (TpuConfig.dataplane_bytes)."),
+    MetricDef("mask_tiling", "label",
+              "How task-batched fold masks were produced: 'device' "
+              "(plane-cached on-device broadcast), 'host' (legacy "
+              "np.tile + upload), or 'n/a' (family does not tile)."),
+)
+
+#: sub-keys of ``search_report["geometry"]`` (written by
+#: ``parallel.taskgrid.GeometryPlan.report_block``) — the launch
+#: geometry the search ran under, pinned so resumes can replay it.
+GEOMETRY_BLOCK_SCHEMA = (
+    MetricDef("mode", "label",
+              "TpuConfig.geometry_mode: 'auto' (waste-aware planner) "
+              "or 'fixed' (legacy width rule)."),
+    MetricDef("source", "label",
+              "Where the plan came from: 'computed' (fresh), "
+              "'plan-cache' (first in-process plan for this structure "
+              "reused), or 'journal' (replayed from the checkpoint so "
+              "resume reuses the exact same chunk ids)."),
+    MetricDef("planned_launches", "gauge",
+              "Total chunk launches the plan schedules across all "
+              "compile groups."),
+    MetricDef("planned_waste_frac", "gauge",
+              "Fraction of planned candidate lanes that are padding "
+              "(the quantity the planner minimizes against launch "
+              "overhead)."),
+    MetricDef("cost_model", "struct",
+              "The cost-model snapshot that priced the plan: "
+              "launch_overhead_s, lane_cost_s, compile_wall_s, "
+              "n_observations, source (default/measured/override)."),
+    MetricDef("groups", "series",
+              "Per compile group: group index, n_candidates, chosen "
+              "width, n_chunks, and whether convergence-sorted "
+              "chunking pinned the width."),
 )
 
 #: sub-keys of ``search_report["faults"]`` (written by
@@ -397,5 +489,13 @@ def schema_markdown() -> str:
     out.append("\n### `search_report[\"faults\"]` block\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in FAULTS_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"dataplane\"]` block\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in DATAPLANE_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"geometry\"]` block\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in GEOMETRY_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     return "".join(out)

@@ -1,0 +1,444 @@
+"""Device-resident data plane — the session-scoped broadcast cache.
+
+The reference amortized dataset shipping with ``sc.broadcast``: X/y went
+to every executor ONCE and every task reused the handle (reference:
+grid_search.py ``X_bc = sc.broadcast(X)``).  Before this module the TPU
+rebuild re-shipped per search: every ``fit`` re-``device_put`` X/y and
+every fold mask even inside one :class:`~spark_sklearn_tpu.utils.
+session.TpuSession`, and task-batched families re-tiled the fold masks
+on the HOST (``np.tile`` to ``(width x n_folds, n_samples)``) once per
+compile group — a multi-MB host allocation plus transfer per group, and
+per RELAUNCH in OOM recovery.  Ousterhout-style overhead analysis of
+distributed ML (arXiv:1612.01437) and DrJAX's device-resident MapReduce
+primitives (arXiv:2403.07128) both land on the same answer: keep
+operands resident, size the fan-out to the measured cost, never re-ship
+per task.
+
+:class:`DataPlane` is that answer here:
+
+  - **fingerprint-keyed**: entries key on a content digest (blake2b of
+    bytes + shape + dtype) so two searches over the same data share one
+    upload no matter how the arrays were constructed;
+  - **sharding-aware**: the key includes the target sharding (mesh
+    device order + partition spec), so a replicated X and a
+    data-sharded X are distinct residents and a mesh change can never
+    serve a stale layout;
+  - **byte-budgeted LRU**: entries are evicted least-recently-used once
+    the budget (``TpuConfig.dataplane_bytes``) is exceeded — a
+    long-lived session cycling many datasets bounds its own HBM;
+  - **on-device mask tiling**: :meth:`DataPlane.tiled` replaces the
+    host ``np.tile`` + upload with a one-time base-mask upload plus a
+    tiny compiled broadcast per (width, sharding) whose result is
+    itself cached — fold masks transfer host->device at most once per
+    search, not once per group/launch;
+  - **observable**: hits/misses/bytes land in ``search_report
+    ["dataplane"]`` (schema pinned in ``obs.metrics``), every real
+    transfer records a ``dataplane.upload`` span carrying its byte
+    count (``tools/trace_summary.py`` digests them into a "bytes
+    host->device" line).
+
+Cache entries fingerprint content AT UPLOAD TIME: mutating an array in
+place after a search produces a new fingerprint (and a fresh upload) on
+the next search — entries are never revalidated on hit.
+
+Plane entries must never be donated to XLA (donation invalidates the
+buffer for every later consumer); the engine only donates per-chunk
+dynamic-parameter staging, which bypasses the cache via
+:func:`upload`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spark_sklearn_tpu.obs.trace import get_tracer
+
+__all__ = [
+    "DataPlane",
+    "StagingRing",
+    "bytes_uploaded",
+    "fingerprint",
+    "get_dataplane",
+    "plane_for",
+    "upload",
+]
+
+#: default byte budget (256 MiB) — enough to keep a bench-scale dataset,
+#: its fold masks and a few tiled-mask widths resident, small enough to
+#: be harmless on the CPU test mesh.
+DEFAULT_BYTE_BUDGET = 256 * 2 ** 20
+
+#: process-wide host->device transfer accounting (every ``upload`` call,
+#: cacheable or not) — the pipeline's per-launch ``stage_bytes`` and the
+#: trace digest read this.
+_TOTALS = {"bytes": 0, "uploads": 0}
+_TOTALS_LOCK = threading.Lock()
+
+
+def bytes_uploaded() -> int:
+    """Cumulative host->device bytes this process transferred through
+    the data plane (cache-miss broadcasts AND per-chunk staging).
+    Callers snapshot before/after a phase and report the delta."""
+    with _TOTALS_LOCK:
+        return _TOTALS["bytes"]
+
+
+def upload(arr: np.ndarray, sharding=None, label: str = "staging"):
+    """``jax.device_put`` with byte accounting and a traced
+    ``dataplane.upload`` span (the span carries ``bytes`` so transfer
+    regressions show up in the trace digest).  This is the ONLY
+    device_put the search engine's data paths use — cached entries go
+    through :meth:`DataPlane.put`, which calls this on a miss."""
+    nbytes = int(getattr(arr, "nbytes", 0))
+    with get_tracer().span("dataplane.upload", bytes=nbytes, label=label):
+        out = (jax.device_put(arr, sharding) if sharding is not None
+               else jax.device_put(arr))
+    with _TOTALS_LOCK:
+        _TOTALS["bytes"] += nbytes
+        _TOTALS["uploads"] += 1
+    return out
+
+
+def fingerprint(arr: np.ndarray) -> str:
+    """Content digest of a host array: blake2b over the raw bytes plus
+    shape/dtype.  Full-content (not sampled) — a wrong cache hit would
+    silently corrupt scores, and hashing runs at ~1 GB/s, far cheaper
+    than the transfer it saves."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((a.shape, a.dtype.str)).encode())
+    h.update(a.data if a.flags["C_CONTIGUOUS"] else a.tobytes())
+    return h.hexdigest()
+
+
+def _sharding_key(sharding) -> Any:
+    """Hashable identity of a placement: device order + partition spec
+    (+ memory kind).  Two meshes over the same chips in a different
+    order are different placements."""
+    if sharding is None:
+        return None
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is not None:
+        devs = tuple(d.id for d in np.asarray(mesh.devices).flat)
+        shape = tuple(sorted(dict(mesh.shape).items()))
+    else:
+        devs = tuple(sorted(d.id for d in sharding.device_set))
+        shape = None
+    return (type(sharding).__name__, devs, shape,
+            repr(getattr(sharding, "spec", None)),
+            getattr(sharding, "memory_kind", None))
+
+
+class DataPlane:
+    """Fingerprint-keyed, byte-budgeted LRU cache of device arrays.
+
+    One process-global instance (:func:`get_dataplane`) is shared by
+    every search; a :class:`~spark_sklearn_tpu.utils.session.TpuSession`
+    sizes its budget at construction (``TpuConfig.dataplane_bytes``).
+    Thread-safe: the pipeline's stage thread and the fault supervisor's
+    recovery threads may all reach it concurrently.
+    """
+
+    def __init__(self, byte_budget: int = DEFAULT_BYTE_BUDGET):
+        self._lock = threading.RLock()
+        #: key -> (device array, nbytes)
+        self._entries: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self.byte_budget = int(byte_budget)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.bytes_uploaded = 0       # miss uploads through put()/zeros()
+        self.bytes_tiled = 0          # device-side tile materializations
+        #: compiled tile programs keyed by (shape, dtype, reps, sharding)
+        self._tile_programs: Dict[Any, Any] = {}
+
+    # -- sizing ----------------------------------------------------------
+    def configure(self, byte_budget: Optional[int]) -> "DataPlane":
+        """Set the byte budget (evicting LRU entries if it shrank);
+        ``None`` keeps the current budget."""
+        if byte_budget is None:
+            return self
+        with self._lock:
+            self.byte_budget = int(byte_budget)
+            self._evict_over_budget()
+        return self
+
+    def _evict_over_budget(self, keep: Any = None) -> None:
+        while self._bytes > self.byte_budget and len(self._entries) > 1:
+            key = next(iter(self._entries))
+            if key == keep:
+                # never evict the entry being returned; rotate it to
+                # the MRU end and take the next-oldest instead
+                self._entries.move_to_end(key)
+                key = next(iter(self._entries))
+                if key == keep:
+                    break
+            _, nbytes = self._entries.pop(key)
+            self._bytes -= nbytes
+            self.evictions += 1
+        # a single oversized entry may exceed the budget on its own; it
+        # stays (dropping it would force a re-upload every search) and
+        # becomes the next LRU victim
+
+    # -- residency -------------------------------------------------------
+    def _get(self, key):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return hit[0]
+            return None
+
+    def _insert(self, key, value, nbytes: int):
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = (value, int(nbytes))
+                self._bytes += int(nbytes)
+                self._evict_over_budget(keep=key)
+
+    def put(self, arr: np.ndarray, sharding, label: str = "array"):
+        """The cached ``device_put``: returns the resident device array
+        for this (content, sharding), uploading at most once while the
+        entry survives the budget.
+
+        The whole miss path runs under the plane lock: two threads
+        racing on the same key (stage thread vs a supervisor recovery
+        relaunch) must not both upload — transfers serialize on the
+        host->device stream anyway, and a double upload would inflate
+        the ``bytes_uploaded`` counter the warm-search acceptance
+        asserts to be zero."""
+        key = ("host", fingerprint(arr), _sharding_key(sharding))
+        with self._lock:
+            cached = self._get(key)
+            if cached is not None:
+                return cached
+            self.misses += 1
+            self.bytes_uploaded += int(arr.nbytes)
+            dev = upload(arr, sharding, label=label)
+            self._insert(key, dev, arr.nbytes)
+            return dev
+
+    def zeros(self, n: int, dtype, sharding):
+        """Cached all-zero launch operand (the all-static group's
+        ``_pad`` axis definition) — uploaded once per (n, dtype,
+        sharding), never per launch."""
+        host = np.zeros(int(n), dtype=dtype)
+        return self.put(host, sharding, label="zeros")
+
+    def tiled(self, base: np.ndarray, base_dev, reps: int, out_sharding,
+              label: str = "mask.tiled", fp: Optional[str] = None):
+        """Device-tiled ``(reps * rows, cols)`` view of ``base`` — the
+        on-device replacement for host ``np.tile`` + upload.
+
+        ``base_dev`` is the already-resident base (e.g. the fold masks'
+        replicated upload); the tile itself is a tiny compiled
+        broadcast whose RESULT is cached per (content, reps, sharding),
+        so a width revisited by any later group, OOM relaunch or search
+        costs one cache lookup and zero transfer.  Pass ``fp`` (a
+        :func:`fingerprint` of ``base``) to skip re-hashing an array
+        the caller already fingerprinted — hot-path callers memoize it
+        once per search."""
+        fp = fp or fingerprint(base)
+        key = ("tile", fp, int(reps), _sharding_key(out_sharding))
+        with self._lock:
+            cached = self._get(key)
+            if cached is not None:
+                return cached
+            self.misses += 1
+            prog_key = (base.shape, str(base.dtype), int(reps),
+                        _sharding_key(out_sharding))
+            tile_fn = self._tile_programs.get(prog_key)
+            if tile_fn is None:
+                tile_fn = jax.jit(
+                    lambda m, _r=int(reps): jnp.tile(m, (_r, 1)),
+                    out_shardings=out_sharding)
+                self._tile_programs[prog_key] = tile_fn
+            nbytes = int(base.nbytes) * int(reps)
+            with get_tracer().span("dataplane.tile", bytes=nbytes,
+                                   reps=int(reps), label=label):
+                dev = tile_fn(base_dev)
+            self.bytes_tiled += nbytes
+            self._insert(key, dev, nbytes)
+            return dev
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def n_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_in_cache(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
+                "bytes_uploaded": self.bytes_uploaded,
+                "bytes_tiled": self.bytes_tiled,
+                "n_entries": len(self._entries),
+                "bytes_in_cache": self._bytes,
+                "budget_bytes": self.byte_budget,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._tile_programs.clear()
+
+
+_PLANE: Optional[DataPlane] = None
+_PLANE_LOCK = threading.Lock()
+
+
+def get_dataplane() -> DataPlane:
+    """The process-global plane (created on first use)."""
+    global _PLANE
+    with _PLANE_LOCK:
+        if _PLANE is None:
+            _PLANE = DataPlane()
+        return _PLANE
+
+
+def plane_for(config) -> Optional[DataPlane]:
+    """The plane a search should use under ``config``, budget applied —
+    or ``None`` when ``TpuConfig(dataplane_bytes=0)`` disabled it (the
+    legacy per-search ``device_put`` escape hatch)."""
+    budget = getattr(config, "dataplane_bytes", DEFAULT_BYTE_BUDGET)
+    if not budget or budget <= 0:
+        return None
+    return get_dataplane().configure(int(budget))
+
+
+def snapshot_counters(plane: Optional[DataPlane]) -> Dict[str, int]:
+    """Counter snapshot for per-search deltas (``search_report
+    ["dataplane"]``)."""
+    snap = {"total_bytes": bytes_uploaded()}
+    if plane is not None:
+        s = plane.stats()
+        snap.update({k: s[k] for k in (
+            "hits", "misses", "evictions", "bytes_uploaded",
+            "bytes_tiled")})
+    return snap
+
+
+def report_block(plane: Optional[DataPlane], before: Dict[str, int],
+                 mask_tiling: str = "n/a") -> Dict[str, Any]:
+    """The rendered ``search_report["dataplane"]`` block (schema pinned
+    in ``obs.metrics.DATAPLANE_BLOCK_SCHEMA``): this search's cache
+    traffic plus the plane's end-of-search state."""
+    total_delta = bytes_uploaded() - before.get("total_bytes", 0)
+    if plane is None:
+        return {"enabled": False, "hits": 0, "misses": 0, "evictions": 0,
+                "bytes_uploaded": 0, "bytes_tiled": 0,
+                "bytes_staged": total_delta, "n_entries": 0,
+                "bytes_in_cache": 0, "budget_bytes": 0,
+                "mask_tiling": mask_tiling}
+    s = plane.stats()
+    cacheable = s["bytes_uploaded"] - before.get("bytes_uploaded", 0)
+    return {
+        "enabled": True,
+        "hits": s["hits"] - before.get("hits", 0),
+        "misses": s["misses"] - before.get("misses", 0),
+        "evictions": s["evictions"] - before.get("evictions", 0),
+        "bytes_uploaded": cacheable,
+        "bytes_tiled": s["bytes_tiled"] - before.get("bytes_tiled", 0),
+        "bytes_staged": max(0, total_delta - cacheable),
+        "n_entries": s["n_entries"],
+        "bytes_in_cache": s["bytes_in_cache"],
+        "budget_bytes": s["budget_bytes"],
+        "mask_tiling": mask_tiling,
+    }
+
+
+#: does jax.device_put COPY the host buffer (True) or may it alias it
+#: (False)?  On device backends (TPU/GPU — the perf target) host and
+#: device are distinct memory spaces, so the h2d transfer is the last
+#: read of the host buffer and reuse-after-transfer is safe.  XLA:CPU
+#: zero-copies aligned host arrays (observed: mutating the source after
+#: a SHARDED device_put changes the device value), so the pending
+#: launch reads the host memory at execute time — no host-side wait can
+#: bound that, and the ring must not reuse buffers there.
+_DEVICE_PUT_COPIES: Optional[bool] = None
+
+
+def _device_put_copies() -> bool:
+    global _DEVICE_PUT_COPIES
+    if _DEVICE_PUT_COPIES is None:
+        _DEVICE_PUT_COPIES = jax.default_backend() != "cpu"
+    return _DEVICE_PUT_COPIES
+
+
+class StagingRing:
+    """Reusable host buffers for per-chunk dynamic-param staging — the
+    double-buffer behind ``TpuConfig(donate_chunk_buffers=True)``.
+
+    ``pad_chunk`` writes each chunk into a ring slot instead of a fresh
+    allocation, so the stage thread stops allocating at steady state.
+    A slot remembers the device array its last contents fed and blocks
+    on its transfer before handing the buffer out again — sufficient on
+    copying backends (the transfer is the last read of the host
+    buffer), and the block also makes supervisor retries that consume
+    extra slots harmless.  On backends where ``device_put`` may ALIAS
+    host memory (XLA:CPU) the pending launch reads the buffer at
+    execute time, so reuse is never provably safe: the ring detects
+    that once (:func:`_device_put_copies`) and degrades to fresh
+    allocations — identical results, no double-buffer win.
+    """
+
+    class _Slot:
+        __slots__ = ("array", "consumer")
+
+        def __init__(self, array: np.ndarray):
+            self.array = array
+            self.consumer = None
+
+        def commit(self, dev) -> None:
+            """Remember the device array this slot's contents fed."""
+            self.consumer = dev
+
+    def __init__(self, slots: int = 3):
+        self._n = max(2, int(slots))
+        self._lock = threading.Lock()
+        self._rings: Dict[Any, Dict[str, Any]] = {}
+
+    def slot(self, key, shape: Tuple[int, ...], dtype) -> "_Slot":
+        """The next reusable buffer for ``key`` (shape/dtype bound into
+        the ring identity, so an OOM-bisected width gets its own
+        ring)."""
+        if not _device_put_copies():
+            # aliasing backend: a fresh buffer per chunk (see class
+            # docstring) — correctness over the allocation win
+            return StagingRing._Slot(np.empty(shape, dtype))
+        rkey = (key, tuple(shape), str(np.dtype(dtype)))
+        with self._lock:
+            ring = self._rings.get(rkey)
+            if ring is None:
+                ring = {"i": 0, "slots": []}
+                self._rings[rkey] = ring
+            if len(ring["slots"]) < self._n:
+                slot = StagingRing._Slot(np.empty(shape, dtype))
+                ring["slots"].append(slot)
+            else:
+                slot = ring["slots"][ring["i"] % self._n]
+            ring["i"] += 1
+        if slot.consumer is not None:
+            try:
+                jax.block_until_ready(slot.consumer)
+            except Exception:   # donated-and-deleted: consumed for sure
+                pass
+            slot.consumer = None
+        return slot

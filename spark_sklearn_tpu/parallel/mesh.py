@@ -139,6 +139,27 @@ class TpuConfig:
     # deterministic fault injection for tests/drills: "transient@3,oom@5"
     # style spec (see faults.FaultPlan).  None defers to SST_FAULT_PLAN.
     fault_plan: Any = None
+    # ---- device data plane (parallel/dataplane.py) ----
+    # byte budget of the session-scoped device-array cache (X/y, fold
+    # masks, tiled masks) shared by every search in the process: uploads
+    # happen once per content+sharding and are reused across chunks,
+    # compile groups, calibration and subsequent searches (the
+    # TPU-native sc.broadcast, made persistent).  0 disables the plane
+    # and restores per-search device_put.
+    dataplane_bytes: int = 256 * 2 ** 20
+    # ---- launch geometry (parallel/taskgrid.plan_geometry) ----
+    # "auto": per-group chunk widths chosen by power-of-two bucketing
+    # over a measured cost model (n_launches x overhead + padded_lanes
+    # x lane_cost), recorded in search_report["geometry"] and pinned
+    # into the checkpoint journal so resume replays identical chunk
+    # ids.  "fixed": the legacy width rule (pad-to-shards capped by
+    # max_tasks_per_batch), bit-compatible with pre-planner runs.
+    geometry_mode: str = "auto"
+    # manual cost-model overrides (seconds); None uses the process
+    # model's measured/default values.  Useful for deterministic
+    # geometry in tests and for operators who know their launch costs.
+    geometry_overhead_s: Optional[float] = None
+    geometry_lane_cost_s: Optional[float] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

@@ -49,6 +49,7 @@ import jax
 
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.parallel import dataplane as _dataplane
 
 _slog = get_logger(__name__)
 
@@ -158,6 +159,7 @@ class LaunchTimings:
     compute_s: float = 0.0
     gather_s: float = 0.0
     finalize_s: float = 0.0
+    stage_bytes: int = 0      # host->device bytes the stage transferred
 
 
 @dataclasses.dataclass
@@ -308,6 +310,8 @@ class ChunkPipeline:
             **{k: round(v, 4) for k, v in walls.items()},
             "overlap_frac": round(overlap, 4),
             "n_precompiled": self._n_precompiled,
+            "stage_bytes_total": sum(
+                t.get("stage_bytes", 0) for t in tl),
             "launches": tl,
         }
 
@@ -326,6 +330,7 @@ class ChunkPipeline:
         rec = {
             "key": item.key, "group": item.group, "kind": item.kind,
             "n_tasks": item.n_tasks,
+            "stage_bytes": int(tm.stage_bytes),
             "stage_s": round(tm.stage_s, 6),
             "stage_wait_s": round(tm.stage_wait_s, 6),
             "dispatch_s": round(tm.dispatch_s, 6),
@@ -358,9 +363,11 @@ class ChunkPipeline:
             tm = LaunchTimings()
             t0 = time.perf_counter()
             if item.stage is not None:
+                b0 = _dataplane.bytes_uploaded()
                 with tr.span("stage", key=item.key, kind=item.kind,
                              group=item.group):
                     staged = item.stage()
+                tm.stage_bytes = _dataplane.bytes_uploaded() - b0
             else:
                 staged = None
             t1 = time.perf_counter()
@@ -412,10 +419,15 @@ class ChunkPipeline:
 
         def staged_call(item):
             t0 = time.perf_counter()
+            # bytes accounted via the (single) stage thread's delta of
+            # the process-wide data-plane counter — supervisor re-stages
+            # on recovery threads land in the global counter only
+            b0 = _dataplane.bytes_uploaded()
             with tr.span("stage", key=item.key, kind=item.kind,
                          group=item.group):
                 payload = item.stage()
-            return payload, time.perf_counter() - t0
+            return (payload, time.perf_counter() - t0,
+                    _dataplane.bytes_uploaded() - b0)
 
         def top_up():
             nonlocal exhausted
@@ -466,7 +478,7 @@ class ChunkPipeline:
                 t0 = time.perf_counter()
                 payload = None
                 if fut is not None:
-                    payload, tm.stage_s = fut.result()
+                    payload, tm.stage_s, tm.stage_bytes = fut.result()
                 t1 = time.perf_counter()
                 tm.stage_wait_s = t1 - t0
                 with tr.span("dispatch", key=item.key, kind=item.kind,

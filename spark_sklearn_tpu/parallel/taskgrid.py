@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.parallel.mesh import pad_to_multiple as _pad_up
 
 
 @dataclasses.dataclass
@@ -110,21 +111,39 @@ def build_compile_groups(
 
 
 def pad_chunk(arr: np.ndarray, lo: int, hi: int, width: int,
-              repeat: int = 1) -> np.ndarray:
+              repeat: int = 1, out: Optional[np.ndarray] = None
+              ) -> np.ndarray:
     """Slice `arr[lo:hi]` and pad it to the launch's uniform `width` by
     repeating the last row, so every chunk of a compile group reuses ONE
     compiled program.  `repeat > 1` additionally repeats each row that
     many times (the task-batched layout's candidate-major fold axis).
     Pure host work: this is the "candidate stacking" phase the pipeline
-    runs on its stage thread."""
+    runs on its stage thread.
+
+    Writes into ONE preallocated output buffer (the old concatenate-
+    then-repeat shape allocated twice per chunk); pass `out` — shaped
+    ``(width * repeat,) + arr.shape[1:]`` — to reuse a caller-owned
+    staging buffer (the donate_chunk_buffers double-buffer ring)."""
     with get_tracer().span("pad_chunk", lo=lo, hi=hi, width=width):
+        n = hi - lo
+        shape = (width * repeat,) + arr.shape[1:]
+        if out is None:
+            out = np.empty(shape, arr.dtype)
+        elif out.shape != shape or out.dtype != arr.dtype:
+            raise ValueError(
+                f"pad_chunk out buffer has shape {out.shape}/{out.dtype}, "
+                f"expected {shape}/{arr.dtype}")
         chunk = arr[lo:hi]
-        if len(chunk) != width:
-            chunk = np.concatenate(
-                [chunk, np.repeat(chunk[-1:], width - len(chunk), axis=0)])
-        if repeat > 1:
-            chunk = np.repeat(chunk, repeat, axis=0)
-        return chunk
+        if repeat == 1:
+            out[:n] = chunk
+        else:
+            # candidate-major fold axis: row c lands at [c*repeat,
+            # (c+1)*repeat) — identical to np.repeat(chunk, repeat, 0)
+            out[:n * repeat].reshape((n, repeat) + arr.shape[1:])[:] = \
+                chunk[:, None]
+        if n < width:
+            out[n * repeat:] = arr[hi - 1]
+        return out
 
 
 def split_range(lo: int, hi: int) -> Tuple[int, int, int]:
@@ -174,6 +193,271 @@ def freeze(v: Any, strict: bool = False):
 
 def _hashable(v: Any):
     return freeze(v)
+
+
+# ---------------------------------------------------------------------------
+# Waste-aware launch geometry
+# ---------------------------------------------------------------------------
+#
+# Chunk width used to be a fixed per-group constant (pad(nc) capped by
+# max_tasks_per_batch): every launch paid whatever padding that width
+# implied, regardless of the measured launch overhead or per-lane fit
+# cost the obs metrics already exposed (the `padding_waste` histogram).
+# `plan_geometry` instead chooses each group's width from power-of-two
+# buckets by minimizing
+#
+#     n_launches x launch_overhead  +  padded_lanes x lane_cost
+#
+# with the cost model fed from measured pipeline timelines
+# (`GeometryCostModel.observe`).  The planner is deterministic (same
+# inputs -> same plan); the engine additionally reuses the first plan
+# computed for a (group structure, constraints) key in-process so a
+# later search over the same shapes never recompiles at a new width
+# just because the cost model drifted, and pins the chosen plan into
+# the checkpoint journal so a resumed search replays the exact same
+# chunk ids.
+
+
+class GeometryMismatchError(RuntimeError):
+    """A checkpoint's journalled launch geometry is structurally
+    incompatible with the current search (different compile-group sizes
+    or sorted-chunking flags): resuming would mix chunk ids across
+    geometries.  Delete the checkpoint file or restore the original
+    configuration (``sort_candidates`` / the candidate grid)."""
+
+
+#: planner defaults before any measurement exists: ~10 ms of host-side
+#: overhead per launch (dispatch + gather + finalize) and ~1 ms of
+#: device compute per (candidate x fold) lane — deliberately
+#: padding-averse so the cold plan never inflates a launch by more than
+#: the cost of a handful of extra launches.
+DEFAULT_LAUNCH_OVERHEAD_S = 0.010
+DEFAULT_LANE_COST_S = 1e-3
+
+
+class GeometryCostModel:
+    """Measured per-launch overhead and per-lane cost, EMA-updated from
+    each search's pipeline timeline (`observe`).  One process-global
+    instance (:func:`geometry_cost_model`) feeds the planner."""
+
+    def __init__(self,
+                 launch_overhead_s: float = DEFAULT_LAUNCH_OVERHEAD_S,
+                 lane_cost_s: float = DEFAULT_LANE_COST_S):
+        self.launch_overhead_s = float(launch_overhead_s)
+        self.lane_cost_s = float(lane_cost_s)
+        self.compile_wall_s = 0.0
+        self.n_observations = 0
+
+    def observe(self, launches) -> None:
+        """Fold one search's per-launch timeline records (the
+        ``search_report["pipeline"]["launches"]`` series) into the
+        model.  Overhead is the MEDIAN per-launch host-side wall
+        (robust to the first launch's trace+compile landing in
+        dispatch_s); lane cost is total device compute over total real
+        lanes; the excess dispatch over the median is recorded as the
+        observed compile wall."""
+        recs = [r for r in (launches or []) if r.get("n_tasks", 0) > 0]
+        if not recs:
+            return
+        overheads = sorted(
+            r.get("stage_wait_s", 0.0) + r.get("dispatch_s", 0.0)
+            + r.get("gather_s", 0.0) + r.get("finalize_s", 0.0)
+            for r in recs)
+        # LOWER median: with few launches the upper median may itself
+        # be a trace+compile outlier
+        med_overhead = overheads[(len(overheads) - 1) // 2]
+        compute = sum(r.get("compute_s", 0.0) for r in recs)
+        lanes = sum(r["n_tasks"] for r in recs)
+        lane_cost = compute / lanes if lanes else self.lane_cost_s
+        compile_excess = sum(
+            max(0.0, o - med_overhead) for o in overheads)
+        alpha = 0.5 if self.n_observations else 1.0
+        self.launch_overhead_s += alpha * (
+            med_overhead - self.launch_overhead_s)
+        self.lane_cost_s += alpha * (lane_cost - self.lane_cost_s)
+        self.compile_wall_s += alpha * (
+            compile_excess - self.compile_wall_s)
+        self.n_observations += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "launch_overhead_s": round(self.launch_overhead_s, 6),
+            "lane_cost_s": round(self.lane_cost_s, 8),
+            "compile_wall_s": round(self.compile_wall_s, 6),
+            "n_observations": self.n_observations,
+            "source": "measured" if self.n_observations else "default",
+        }
+
+
+_COST_MODEL = GeometryCostModel()
+
+
+def geometry_cost_model() -> GeometryCostModel:
+    """The process-global cost model the engine observes into."""
+    return _COST_MODEL
+
+
+@dataclasses.dataclass
+class GroupGeometry:
+    """One compile group's planned launch shape."""
+
+    group: int
+    n_candidates: int
+    width: int               # uniform chunk width (padded lane count / fold)
+    n_chunks: int
+    sorted: bool             # convergence-sorted chunking active
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class GeometryPlan:
+    """The planned geometry of a whole search: per-group widths plus
+    the cost-model snapshot that produced them.  Serialized verbatim
+    into the checkpoint journal (``{"meta": "geometry_plan", ...}``
+    line) and rendered as ``search_report["geometry"]``."""
+
+    mode: str                              # "auto" | "fixed"
+    groups: List[GroupGeometry]
+    cost_model: Dict[str, Any]
+    source: str = "computed"               # computed | plan-cache | journal
+
+    def signature(self) -> Tuple:
+        """Structure identity for resume-mismatch detection: the widths
+        may legitimately differ across plans, the group sizes and
+        sorted flags may not."""
+        return tuple((g.n_candidates, g.sorted) for g in self.groups)
+
+    def widths(self) -> List[int]:
+        return [g.width for g in self.groups]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"mode": self.mode, "source": self.source,
+                "cost_model": dict(self.cost_model),
+                "groups": [g.to_dict() for g in self.groups]}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "GeometryPlan":
+        return cls(
+            mode=str(d.get("mode", "auto")),
+            groups=[GroupGeometry(**g) for g in d.get("groups", [])],
+            cost_model=dict(d.get("cost_model", {})),
+            source=str(d.get("source", "computed")))
+
+    def report_block(self) -> Dict[str, Any]:
+        """The ``search_report["geometry"]`` block (schema pinned in
+        ``obs.metrics.GEOMETRY_BLOCK_SCHEMA``)."""
+        lanes = sum(g.n_chunks * g.width for g in self.groups)
+        real = sum(g.n_candidates for g in self.groups)
+        return {
+            "mode": self.mode,
+            "source": self.source,
+            "planned_launches": sum(g.n_chunks for g in self.groups),
+            "planned_waste_frac": round(
+                (lanes - real) / lanes, 6) if lanes else 0.0,
+            "cost_model": dict(self.cost_model),
+            "groups": [g.to_dict() for g in self.groups],
+        }
+
+
+def _chunk_cost(nc: int, width: int, n_folds: int, overhead: float,
+                lane_cost: float) -> Tuple[float, int, int]:
+    """(cost, n_chunks, width) of running `nc` candidates at `width`:
+    launches pay `overhead` each, padded lanes pay `lane_cost` each."""
+    n_chunks = -(-nc // width)
+    waste_lanes = (n_chunks * width - nc) * n_folds
+    return (n_chunks * overhead + waste_lanes * lane_cost,
+            n_chunks, width)
+
+
+#: first plan computed for a (structure, constraints) key is reused for
+#: the process lifetime — cost-model drift must not re-plan identical
+#: searches onto new widths (each new width is a fresh XLA compile).
+_PLAN_CACHE: Dict[Any, GeometryPlan] = {}
+
+
+def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
+                  n_folds: int, n_task_shards: int, max_width: int,
+                  mode: str = "auto",
+                  cost_model: Optional[GeometryCostModel] = None,
+                  overhead_override: Optional[float] = None,
+                  lane_cost_override: Optional[float] = None,
+                  reuse: bool = False) -> GeometryPlan:
+    """Choose every compile group's chunk width.
+
+    ``sizes``: per-group candidate counts; ``sorted_caps``: per-group
+    convergence-sorted width (or None when the group is unsorted) —
+    sorted groups keep their graded width (the iteration-waste the
+    grading removes dominates any padding trade, and the grading IS the
+    family's own cost model).  Unsorted groups choose, in ``auto``
+    mode, the cheapest of {the legacy zero-padding width} ∪ {power-of-
+    two buckets of the task-shard count} under
+    ``n_launches x overhead + padded_lanes x lane_cost``; ``fixed``
+    reproduces the legacy widths exactly (the bit-compatible escape
+    hatch).  Deterministic: same inputs (including the model values)
+    -> same plan; ``reuse=True`` additionally serves the first plan
+    computed for this structure again for the process lifetime.
+    """
+    if mode not in ("auto", "fixed"):
+        raise ValueError(
+            f"geometry_mode must be 'auto' or 'fixed', got {mode!r}")
+    sizes = [int(n) for n in sizes]
+    sorted_caps = [None if c is None else int(c) for c in sorted_caps]
+    cache_key = (tuple(sizes), tuple(sorted_caps), int(n_folds),
+                 int(n_task_shards), int(max_width), mode,
+                 overhead_override, lane_cost_override)
+    if reuse:
+        hit = _PLAN_CACHE.get(cache_key)
+        if hit is not None:
+            return dataclasses.replace(hit, source="plan-cache")
+
+    model = cost_model or geometry_cost_model()
+    overhead = (overhead_override if overhead_override is not None
+                else model.launch_overhead_s)
+    lane_cost = (lane_cost_override if lane_cost_override is not None
+                 else model.lane_cost_s)
+    snap = model.snapshot()
+    if overhead_override is not None or lane_cost_override is not None:
+        snap = {**snap, "launch_overhead_s": overhead,
+                "lane_cost_s": lane_cost, "source": "override"}
+
+    groups = []
+    for gi, nc in enumerate(sizes):
+        base_w = min(_pad_up(nc, n_task_shards), max_width)
+        base_w = max(base_w, n_task_shards)
+        cap = sorted_caps[gi]
+        if cap is not None:
+            # convergence grading pins the width in both modes
+            width = cap
+        elif mode == "fixed":
+            width = base_w
+        else:
+            # power-of-two buckets of the shard count, capped by the
+            # HBM bound and by the first bucket able to hold the whole
+            # group (wider would only add padding); the legacy width
+            # competes too, so a zero-waste single launch is never lost
+            candidates = {base_w}
+            w = n_task_shards
+            hold_all = _pad_up(nc, n_task_shards)
+            while w <= max_width:
+                candidates.add(w)
+                if w >= hold_all:
+                    break
+                w *= 2
+            # total order (cost, n_chunks, width): ties prefer fewer
+            # launches, then the narrower (cheaper-HBM) width
+            width = min(
+                sorted(candidates),
+                key=lambda w_: _chunk_cost(nc, w_, n_folds, overhead,
+                                           lane_cost))
+        groups.append(GroupGeometry(
+            group=gi, n_candidates=nc, width=int(width),
+            n_chunks=-(-nc // int(width)), sorted=cap is not None))
+    plan = GeometryPlan(mode=mode, groups=groups, cost_model=snap)
+    if reuse:
+        _PLAN_CACHE[cache_key] = plan
+    return plan
 
 
 def build_fold_masks(

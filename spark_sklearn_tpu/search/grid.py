@@ -1080,6 +1080,21 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         repl = mesh_lib.replicated_sharding(mesh)
         task_shard = mesh_lib.task_sharding(mesh)
 
+        # device data plane: a fingerprint-keyed, sharding-aware LRU of
+        # device arrays shared by every search in the process — X/y and
+        # the fold masks upload ONCE per content+placement and are
+        # reused across chunks, compile groups, calibration and
+        # subsequent searches (the persistent sc.broadcast).  Disabled
+        # (dataplane_bytes=0) restores per-search device_put.
+        from spark_sklearn_tpu.parallel import dataplane as _dataplane
+        plane = _dataplane.plane_for(config)
+        dp_before = _dataplane.snapshot_counters(plane)
+
+        def _bput(v, sharding, label):
+            if plane is not None:
+                return plane.put(v, sharding, label=label)
+            return _dataplane.upload(v, sharding, label=label)
+
         _t_upload0 = time.perf_counter()
         if config.n_data_shards > 1:
             # large-X mode: shard samples over the "data" mesh axis instead
@@ -1109,22 +1124,26 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     train_masks = _padm(train_masks)
             sample_shard = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
             mask_shard = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
-            data_dev = {k: jax.device_put(v, sample_shard)
+            data_dev = {k: _bput(v, sample_shard, f"data.{k}")
                         for k, v in data.items()}
             put_masks = mask_shard
         else:
-            data_dev = {k: jax.device_put(v, repl) for k, v in data.items()}
+            data_dev = {k: _bput(v, repl, f"data.{k}")
+                        for k, v in data.items()}
             put_masks = repl
         # one device buffer per DISTINCT mask array: in the unweighted case
         # fit/train-scoring masks are the same object, so they share one
-        # upload and one HBM allocation
-        fit_dev = jax.device_put(fit_masks, put_masks)
-        test_dev = jax.device_put(test_sc_masks, put_masks)
+        # upload and one HBM allocation (the plane's content keys make
+        # the dedup hold even across separately-built equal arrays)
+        fit_dev = _bput(fit_masks, put_masks, "mask.fit")
+        test_dev = _bput(test_sc_masks, put_masks, "mask.test")
         train_sc_dev = (fit_dev if train_sc_masks is fit_masks
-                        else jax.device_put(train_sc_masks, put_masks))
+                        else _bput(train_sc_masks, put_masks,
+                                   "mask.train"))
         if need_unweighted:
-            test_unw_dev = jax.device_put(test_masks, put_masks)
-            train_unw_dev = jax.device_put(train_masks, put_masks)
+            test_unw_dev = _bput(test_masks, put_masks, "mask.test_unw")
+            train_unw_dev = _bput(train_masks, put_masks,
+                                  "mask.train_unw")
         else:
             test_unw_dev, train_unw_dev = test_dev, train_sc_dev
         get_tracer().record_span(
@@ -1302,6 +1321,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
+            # this search's broadcast-cache traffic (hits = arrays
+            # reused with zero transfer; bytes_uploaded = cacheable
+            # bytes actually shipped; bytes_staged = per-chunk dyn
+            # params) — schema in obs.metrics.DATAPLANE_BLOCK_SCHEMA
+            mask_tiling = ("n/a" if not hasattr(family, "fit_task_batched")
+                           else "device" if plane is not None else "host")
+            metrics.put("dataplane", _dataplane.report_block(
+                plane, dp_before, mask_tiling=mask_tiling))
         if preval_failed.any():
             # failed fits never ran: sklearn records 0.0 for their times
             fit_times[preval_failed, :] = 0.0
@@ -1405,6 +1432,26 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             tb_mask_shard = task_shard
         metrics = self._search_metrics
         donate = bool(config.donate_chunk_buffers)
+        # the session-scoped device data plane (same instance the
+        # broadcast uploads went through) serves the task-batched mask
+        # tiling on device and the cached all-static pad operand; the
+        # staging ring double-buffers per-chunk dynamic params behind
+        # donate_chunk_buffers (pad_chunk writes into reused host
+        # buffers instead of allocating per chunk)
+        from spark_sklearn_tpu.parallel import dataplane as _dataplane
+        plane = _dataplane.plane_for(config)
+        # multi-controller runs force depth 0 below; resolved here so
+        # the staging ring can size itself to the in-flight window
+        depth = config.pipeline_depth if jax.process_count() == 1 else 0
+        ring = _dataplane.StagingRing(depth + 2) if donate else None
+        #: the fold masks' content digest, hashed once per search (the
+        #: plane's tiled-mask keys need it; fit_masks never mutates)
+        _fm_fp: List[str] = []
+
+        def fit_masks_fp():
+            if not _fm_fp:
+                _fm_fp.append(_dataplane.fingerprint(fit_masks))
+            return _fm_fp[0]
 
         # score path: every registry scorer decomposes into model views
         # (pred/decision/proba) + a metric core, so views are computed
@@ -1465,16 +1512,88 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             for k, v in group.dynamic_params.items()}
                         sorted_chunks = True
 
-            nc_batch = min(mesh_lib.pad_to_multiple(nc, n_task_shards),
-                           max_cand_per_batch)
+            sorted_cap = None
             if sorted_chunks:
                 # ~8 difficulty-graded launches per group (bounded below
                 # by the task-shard multiple so sharding stays uniform)
-                nc_batch = min(nc_batch, max(
-                    n_task_shards,
-                    mesh_lib.pad_to_multiple(
-                        -(-nc // _SORTED_LAUNCHES), n_task_shards)))
+                sorted_cap = min(
+                    mesh_lib.pad_to_multiple(nc, n_task_shards),
+                    max_cand_per_batch,
+                    max(n_task_shards,
+                        mesh_lib.pad_to_multiple(
+                            -(-nc // _SORTED_LAUNCHES), n_task_shards)))
+            plans.append({
+                "gi": gi, "group": group, "static": static, "nc": nc,
+                "sorted": sorted_chunks, "sorted_cap": sorted_cap})
 
+        # ------------------------------------------------------------------
+        # waste-aware launch geometry (parallel/taskgrid.plan_geometry):
+        # per-group chunk widths from power-of-two bucketing over the
+        # measured cost model, minimizing launch overhead + padding
+        # waste.  The chosen plan is pinned into the checkpoint journal
+        # so a resumed search replays the EXACT same chunk ids; a
+        # structurally different journalled geometry is a hard error,
+        # never a silent mix of chunk ids.
+        # ------------------------------------------------------------------
+        from spark_sklearn_tpu.parallel.taskgrid import (
+            GeometryMismatchError, GeometryPlan, geometry_cost_model,
+            plan_geometry)
+        geo = plan_geometry(
+            sizes=[p["nc"] for p in plans],
+            sorted_caps=[p["sorted_cap"] for p in plans],
+            n_folds=n_folds, n_task_shards=n_task_shards,
+            max_width=max_cand_per_batch,
+            mode=getattr(config, "geometry_mode", "auto"),
+            cost_model=geometry_cost_model(),
+            overhead_override=getattr(config, "geometry_overhead_s", None),
+            lane_cost_override=getattr(config, "geometry_lane_cost_s",
+                                       None),
+            reuse=True)
+        if ckpt is not None:
+            journalled = ckpt.get_meta("geometry_plan")
+            if journalled is not None:
+                jplan = GeometryPlan.from_dict(journalled)
+                if jplan.signature() != geo.signature():
+                    raise GeometryMismatchError(
+                        "checkpoint was written under a different launch "
+                        "geometry (journalled per-group (n_candidates, "
+                        f"sorted) = {jplan.signature()}, current = "
+                        f"{geo.signature()}); resuming would mix chunk "
+                        "ids across geometries.  Delete "
+                        f"{ckpt.path!r} or restore the original "
+                        "sort_candidates/grid configuration.")
+                # the journalled widths must still be valid under the
+                # CURRENT mesh and HBM bound: every other width path
+                # guarantees shard-multiple widths within
+                # max_cand_per_batch, and replaying a stale plan would
+                # silently break that (e.g. resumed on a smaller mesh,
+                # or after lowering max_tasks_per_batch to dodge an OOM)
+                bad = [g.width for g in jplan.groups
+                       if g.width % n_task_shards != 0
+                       or g.width > max_cand_per_batch]
+                if bad:
+                    raise GeometryMismatchError(
+                        f"journalled chunk widths {bad} are invalid "
+                        f"under the current configuration (task shards="
+                        f"{n_task_shards}, max width per launch="
+                        f"{max_cand_per_batch}); the checkpoint was "
+                        "written on a different mesh or "
+                        "max_tasks_per_batch.  Delete "
+                        f"{ckpt.path!r} or restore the original "
+                        "configuration.")
+                # replay: widths come from the journal, so chunk ids —
+                # and therefore resume hits — match the original run
+                # even if the cost model has since drifted
+                import dataclasses as _dc
+                geo = _dc.replace(jplan, source="journal")
+            else:
+                ckpt.put_meta("geometry_plan", geo.to_dict())
+        metrics.put("geometry", geo.report_block())
+
+        for plan, gg in zip(plans, geo.groups):
+            gi, nc = plan["gi"], plan["nc"]
+            sorted_chunks = plan["sorted"]
+            nc_batch = plan["nc_batch"] = int(gg.width)
             # chunk resume state resolved up front: the calibration
             # structure (which chunk calibrates, which chunks fuse) must
             # be known before dispatch, not discovered mid-pipeline
@@ -1491,11 +1610,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         rec.get("train") is None:
                     rec = None  # written without train scores: recompute
                 chunks.append((lo, hi, chunk_id, rec))
-            plans.append({
-                "gi": gi, "group": group, "static": static, "nc": nc,
-                "nc_batch": nc_batch, "sorted": sorted_chunks,
-                "chunks": chunks,
-                "n_live": sum(1 for c in chunks if c[3] is None)})
+            plan["chunks"] = chunks
+            plan["n_live"] = sum(1 for c in chunks if c[3] is None)
 
         def build_programs(plan, width=None):
             """The group's jitted programs (cross-search cached); built
@@ -1660,16 +1776,30 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
         def group_masks(plan):
             """The group's fit-mask device buffer.  Task-batched families
-            consume the fold masks tiled to the launch width — built
-            lazily on the stage thread, once per group, so fully-resumed
-            groups never pay the tile or the upload."""
+            consume the fold masks tiled to the launch width — under the
+            data plane the tile is a cached ON-DEVICE broadcast of the
+            already-resident base masks (uploaded at most once per
+            search, reused across groups sharing a width, OOM relaunches
+            and subsequent searches); the legacy path host-tiles lazily
+            on the stage thread, once per group."""
             if not task_batched:
                 return fit_dev
+            if plane is not None:
+                # memoized per plan: stage() asks once per chunk, and
+                # re-hashing the full mask array every launch would put
+                # serial host work back on the stage thread
+                w = plan.get("w_task_dev")
+                if w is None:
+                    w = plan["w_task_dev"] = plane.tiled(
+                        fit_masks, fit_dev, plan["nc_batch"],
+                        tb_mask_shard, label="mask.fit.tiled",
+                        fp=fit_masks_fp())
+                return w
             w = plan.get("w_task_dev")
             if w is None:
-                w = jax.device_put(
+                w = _dataplane.upload(
                     np.tile(fit_masks, (plan["nc_batch"], 1)),
-                    tb_mask_shard)
+                    tb_mask_shard, label="mask.fit.tiled")
                 plan["w_task_dev"] = w
             return w
 
@@ -1685,7 +1815,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # threads would need every process to interleave them in the
         # same order as its peers; the synchronous schedule guarantees
         # that, the pipelined one does not — so multihost forces depth 0
-        depth = config.pipeline_depth if jax.process_count() == 1 else 0
+        # (`depth` was resolved with the data-plane setup above)
         pipe = ChunkPipeline(depth, verbose=self.verbose)
 
         def submit_precompile(plan):
@@ -1799,16 +1929,31 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 progs = build_programs(plan, width=width)
                 dyn = {}
                 for k, arr in group.dynamic_params.items():
-                    dyn[k] = jax.device_put(
+                    dyn[k] = _dataplane.upload(
                         pad_chunk(arr, lo, hi, width,
                                   n_folds if task_batched else 1),
-                        task_shard)
+                        task_shard, label="dyn.recover")
                 if not dyn and not task_batched:
-                    dyn["_pad"] = jax.device_put(
-                        np.zeros(width, dtype=dtype), task_shard)
-                w = (jax.device_put(np.tile(fit_masks, (width, 1)),
-                                    tb_mask_shard)
-                     if task_batched else fit_dev)
+                    dyn["_pad"] = (
+                        plane.zeros(width, dtype, task_shard)
+                        if plane is not None and not donate else
+                        _dataplane.upload(np.zeros(width, dtype=dtype),
+                                          task_shard, label="dyn.pad"))
+                if task_batched:
+                    # the bisected width's tiled masks come from the
+                    # same plane cache — a recovery revisiting a width
+                    # re-tiles on device at most once, never per
+                    # relaunch (the old per-relaunch host np.tile)
+                    w = (plane.tiled(fit_masks, fit_dev, width,
+                                     tb_mask_shard,
+                                     label="mask.fit.tiled",
+                                     fp=fit_masks_fp())
+                         if plane is not None else
+                         _dataplane.upload(
+                             np.tile(fit_masks, (width, 1)),
+                             tb_mask_shard, label="mask.fit.tiled"))
+                else:
+                    w = fit_dev
                 out = progs["fused"](dyn, data_dev, w, test_dev,
                                      train_sc_dev, test_unw_dev,
                                      train_unw_dev)
@@ -1952,18 +2097,45 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
                     def stage(lo=lo, hi=hi, plan=plan, chunk_id=chunk_id):
                         dyn = {}
+                        repeat = n_folds if task_batched else 1
                         for k, arr in plan["group"].dynamic_params.items():
-                            dyn[k] = jax.device_put(
-                                pad_chunk(arr, lo, hi, plan["nc_batch"],
-                                          n_folds if task_batched else 1),
-                                task_shard)
+                            if ring is not None:
+                                # donate mode: pad into a reused host
+                                # buffer (double-buffer ring) instead of
+                                # allocating per chunk; the slot blocks
+                                # on its previous consumer before reuse
+                                slot = ring.slot(
+                                    (plan["gi"], k),
+                                    (plan["nc_batch"] * repeat,)
+                                    + arr.shape[1:], arr.dtype)
+                                host = pad_chunk(
+                                    arr, lo, hi, plan["nc_batch"],
+                                    repeat, out=slot.array)
+                                dev = _dataplane.upload(
+                                    host, task_shard, label="dyn")
+                                slot.commit(dev)
+                            else:
+                                dev = _dataplane.upload(
+                                    pad_chunk(arr, lo, hi,
+                                              plan["nc_batch"], repeat),
+                                    task_shard, label="dyn")
+                            dyn[k] = dev
                         if not dyn and not task_batched:
                             # all-static group: vmap still needs a
                             # batched operand to define the candidate
-                            # axis (families ignore unknown keys)
-                            dyn["_pad"] = jax.device_put(
-                                np.zeros(plan["nc_batch"], dtype=dtype),
-                                task_shard)
+                            # axis (families ignore unknown keys).  The
+                            # plane caches the zeros across chunks AND
+                            # searches — except under donation, where a
+                            # cached operand would be invalidated by the
+                            # launch that consumed it
+                            dyn["_pad"] = (
+                                plane.zeros(plan["nc_batch"], dtype,
+                                            task_shard)
+                                if plane is not None and not donate else
+                                _dataplane.upload(
+                                    np.zeros(plan["nc_batch"],
+                                             dtype=dtype),
+                                    task_shard, label="dyn.pad"))
                         w = group_masks(plan)
                         # once the group's last live chunk has staged,
                         # drop the plan's tiled-mask reference (each
@@ -2204,6 +2376,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # the compile then ran on the AOT thread or at jit dispatch)
             pr["n_compiles"] = _program_build_count() - builds0
             metrics.put("pipeline", pr)
+            # feed the measured per-launch overhead / per-lane cost back
+            # into the geometry planner's cost model: the NEXT search
+            # over a new structure prices its widths from real walls
+            # (plans already computed this process keep their widths via
+            # the plan cache, so drift never forces recompiles)
+            geometry_cost_model().observe(pr.get("launches"))
 
     def _print_task_end_lines(self, candidates, idx, n_folds, scorer_names,
                               test_scores, train_scores, return_train,

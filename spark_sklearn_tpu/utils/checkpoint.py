@@ -44,6 +44,7 @@ class SearchCheckpoint:
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"search_{key}.jsonl")
         self._done: Dict[str, Dict[str, Any]] = {}
+        self._meta: Dict[str, Any] = {}
         self.faults: list = []
         if os.path.exists(self.path):
             with open(self.path) as f:
@@ -52,6 +53,12 @@ class SearchCheckpoint:
                         rec = json.loads(line)
                         if "fault_chunk_id" in rec:
                             self.faults.append(rec)
+                            continue
+                        if "meta" in rec and "chunk_id" not in rec:
+                            # journal metadata (e.g. the pinned launch-
+                            # geometry plan): last record wins; loaders
+                            # predating meta lines skip them on KeyError
+                            self._meta[rec["meta"]] = rec.get("value")
                             continue
                         self._done[rec["chunk_id"]] = rec
                     except (json.JSONDecodeError, KeyError):
@@ -80,6 +87,22 @@ class SearchCheckpoint:
             f.flush()
             os.fsync(f.fileno())
         self.faults.append(rec)
+
+    def get_meta(self, name: str) -> Any:
+        """Journal metadata written by :meth:`put_meta` (e.g. the
+        pinned launch-geometry plan a resumed search must replay)."""
+        return self._meta.get(name)
+
+    def put_meta(self, name: str, value: Any) -> None:
+        """Durably append a ``{"meta": name, "value": ...}`` record.
+        Written BEFORE any chunk it governs, so a resume always sees
+        the plan its chunk ids were generated under."""
+        rec = {"meta": name, "value": value}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._meta[name] = value
 
     @property
     def n_done(self) -> int:

@@ -45,6 +45,11 @@ class TpuSession:
             enable_persistent_cache(
                 self.config.resolved_cache_dir(),
                 self.config.persistent_cache_min_compile_s)
+            # size the device data plane (parallel/dataplane.py) now:
+            # every search this session runs shares the same resident
+            # X/y/mask uploads — the session-lifetime sc.broadcast
+            from spark_sklearn_tpu.parallel.dataplane import plane_for
+            self.dataplane = plane_for(self.config)
             # parse the fault-injection plan NOW so a typo in
             # TpuConfig(fault_plan=...) / SST_FAULT_PLAN fails loudly at
             # session construction, not halfway through a long search
@@ -56,6 +61,11 @@ class TpuSession:
                     dict(self.mesh.shape),
                     self.config.resolved_cache_dir(),
                     appName=appName, n_devices=self.mesh.size)
+        logger.info(
+            "data plane: %s (geometry_mode=%s)",
+            "disabled" if self.dataplane is None else
+            f"budget={self.dataplane.byte_budget // 2 ** 20} MiB",
+            getattr(self.config, "geometry_mode", "auto"))
         logger.info(
             "fault supervisor: max_launch_retries=%d "
             "max_search_retries=%d backoff=%.2fs timeout=%s "
@@ -69,6 +79,12 @@ class TpuSession:
     @property
     def n_devices(self) -> int:
         return self.mesh.size
+
+    def dataplane_stats(self) -> dict:
+        """Cumulative hit/miss/byte counters of the session's device
+        data plane (empty dict when ``dataplane_bytes=0`` disabled
+        it)."""
+        return {} if self.dataplane is None else self.dataplane.stats()
 
     def export_trace(self, path: Optional[str] = None) -> str:
         """Write the tracer's current buffer as a Chrome trace-event

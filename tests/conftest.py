@@ -118,6 +118,24 @@ def pytest_collection_modifyitems(config, items):
         items[:] = kept
 
 
+@pytest.fixture
+def clean_tracer():
+    """The global span tracer, guaranteed disabled+empty before and
+    after (shared by test_obs/test_dataplane; test_obs keeps its own
+    module-local twin for historical reasons)."""
+    from spark_sklearn_tpu.obs.trace import get_tracer
+    tr = get_tracer()
+    was = tr.enabled
+    tr.disable()
+    tr.clear()
+    yield tr
+    tr.clear()
+    if was:
+        tr.enable()
+    else:
+        tr.disable()
+
+
 @pytest.fixture(scope="session")
 def digits():
     from sklearn.datasets import load_digits

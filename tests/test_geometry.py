@@ -1,0 +1,238 @@
+"""Waste-aware launch geometry (parallel/taskgrid.plan_geometry).
+
+Contracts under test:
+  - the planner is deterministic (same inputs -> same plan) and
+    ``fixed`` mode reproduces the legacy width rule exactly;
+  - the cost model moves widths the right way (overhead-dominated ->
+    wider/fewer launches, waste-dominated -> zero-padding width);
+  - ``search_report["geometry"]`` renders the pinned schema block and
+    ``cv_results_`` stays exactly equal between auto and fixed when
+    they agree on widths;
+  - checkpoint interplay: the plan is journalled BEFORE any chunk, a
+    resume replays it (source "journal", chunk ids match), and a
+    structurally different geometry raises GeometryMismatchError
+    instead of silently mixing chunk ids;
+  - OOM bisection under the planned geometry still re-pads correctly
+    (fault-plan run, exact parity).
+"""
+
+import glob
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.parallel.taskgrid import (
+    GeometryCostModel, GeometryMismatchError, GeometryPlan, plan_geometry)
+
+
+def _non_time_results(gs):
+    return {k: v for k, v in gs.cv_results_.items()
+            if "time" not in k and k != "params"}
+
+
+def _assert_exact_equal(ra, rb):
+    assert set(ra) == set(rb)
+    for k in ra:
+        np.testing.assert_array_equal(
+            np.asarray(ra[k]), np.asarray(rb[k]), err_msg=k)
+
+
+def _fit(X, y, grid, **cfg_kw):
+    from sklearn.linear_model import LogisticRegression
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.GridSearchCV(
+            LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+            backend="tpu", config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+class TestPlannerUnit:
+    def test_deterministic_and_fixed_reproduces_legacy(self):
+        model = GeometryCostModel()
+        kw = dict(sizes=[40, 3], sorted_caps=[8, None], n_folds=2,
+                  n_task_shards=8, max_width=1024, cost_model=model)
+        a = plan_geometry(mode="auto", **kw)
+        b = plan_geometry(mode="auto", **kw)
+        assert a.to_dict() == b.to_dict()
+        fixed = plan_geometry(mode="fixed", **kw)
+        # legacy rule: sorted cap pins group 0; group 1 pads to shards
+        assert fixed.widths() == [8, 8]
+        # sorted groups keep their graded width in auto mode too
+        assert a.widths()[0] == 8
+        assert a.signature() == ((40, True), (3, False))
+
+    def test_cost_model_moves_the_width(self):
+        # a zero-waste single launch beats any padded pow2 bucket
+        single = plan_geometry(
+            sizes=[20], sorted_caps=[None], n_folds=2, n_task_shards=1,
+            max_width=4096, mode="auto",
+            cost_model=GeometryCostModel(launch_overhead_s=1.0,
+                                         lane_cost_s=1e-9))
+        assert single.widths() == [20]
+        assert single.groups[0].n_chunks == 1
+        # multi-chunk group, overhead-dominated: fewest launches win
+        wide = plan_geometry(
+            sizes=[20], sorted_caps=[None], n_folds=2, n_task_shards=1,
+            max_width=16, mode="auto",
+            cost_model=GeometryCostModel(launch_overhead_s=1.0,
+                                         lane_cost_s=1e-9))
+        assert wide.widths() == [16]
+        assert wide.groups[0].n_chunks == 2
+        # same group, waste-dominated: the zero-padding bucket wins
+        # even at more launches
+        tight = plan_geometry(
+            sizes=[20], sorted_caps=[None], n_folds=2, n_task_shards=1,
+            max_width=16, mode="auto",
+            cost_model=GeometryCostModel(launch_overhead_s=1e-9,
+                                         lane_cost_s=1.0))
+        assert tight.widths() == [4]
+        assert tight.groups[0].n_chunks == 5
+
+    def test_widths_are_shard_multiples_within_cap(self):
+        plan = plan_geometry(
+            sizes=[100, 7, 1], sorted_caps=[None, None, None], n_folds=3,
+            n_task_shards=8, max_width=24, mode="auto",
+            cost_model=GeometryCostModel())
+        for g in plan.groups:
+            assert g.width % 8 == 0
+            assert g.width <= 24
+            assert g.n_chunks == -(-g.n_candidates // g.width)
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError, match="geometry_mode"):
+            plan_geometry(sizes=[4], sorted_caps=[None], n_folds=2,
+                          n_task_shards=1, max_width=64, mode="turbo")
+
+    def test_round_trip_and_report_block(self):
+        plan = plan_geometry(
+            sizes=[40], sorted_caps=[8], n_folds=2, n_task_shards=8,
+            max_width=1024, mode="auto", cost_model=GeometryCostModel())
+        back = GeometryPlan.from_dict(
+            json.loads(json.dumps(plan.to_dict())))
+        assert back.widths() == plan.widths()
+        assert back.signature() == plan.signature()
+        block = plan.report_block()
+        from spark_sklearn_tpu.obs.metrics import GEOMETRY_BLOCK_SCHEMA
+        assert set(block) == {d.name for d in GEOMETRY_BLOCK_SCHEMA}
+        assert block["planned_launches"] == 5
+        assert 0.0 <= block["planned_waste_frac"] < 1.0
+
+    def test_cost_model_observes_timelines(self):
+        model = GeometryCostModel()
+        assert model.snapshot()["source"] == "default"
+        model.observe([
+            {"n_tasks": 10, "stage_wait_s": 0.01, "dispatch_s": 0.02,
+             "gather_s": 0.01, "finalize_s": 0.0, "compute_s": 0.5},
+            {"n_tasks": 10, "stage_wait_s": 0.01, "dispatch_s": 0.9,
+             "gather_s": 0.01, "finalize_s": 0.0, "compute_s": 0.5},
+        ])
+        snap = model.snapshot()
+        assert snap["source"] == "measured"
+        assert snap["n_observations"] == 1
+        assert snap["lane_cost_s"] == pytest.approx(1.0 / 20)
+        # the compile-looking dispatch outlier lands in compile_wall_s,
+        # not in the (median) launch overhead
+        assert snap["launch_overhead_s"] < 0.1
+        assert snap["compile_wall_s"] > 0.5
+
+
+class TestGeometrySearchIntegration:
+    #: explicit cost overrides so widths are process-order independent
+    _OVR = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3)
+
+    def test_report_and_auto_vs_fixed_exact_parity(self, digits):
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        grid = {"C": np.logspace(-2, 1, 16).tolist()}   # pow2 grid:
+        auto = _fit(Xs, ys, grid, geometry_mode="auto", **self._OVR)
+        fixed = _fit(Xs, ys, grid, geometry_mode="fixed", **self._OVR)
+        ga = auto.search_report["geometry"]
+        gf = fixed.search_report["geometry"]
+        assert ga["mode"] == "auto" and gf["mode"] == "fixed"
+        # 16 candidates pad to the same width under both rules -> the
+        # compiled programs are identical and scores exact-equal
+        assert [g["width"] for g in ga["groups"]] == \
+            [g["width"] for g in gf["groups"]]
+        _assert_exact_equal(_non_time_results(auto),
+                            _non_time_results(fixed))
+
+    def test_plan_journalled_and_replayed_on_resume(self, digits,
+                                                    tmp_path):
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        grid = {"C": np.logspace(-2, 1, 40).tolist()}
+        full = _fit(Xs, ys, grid, checkpoint_dir=str(tmp_path),
+                    **self._OVR)
+        assert full.search_report["geometry"]["source"] in (
+            "computed", "plan-cache")
+        ckpt_file = glob.glob(str(tmp_path / "search_*.jsonl"))[0]
+        lines = open(ckpt_file).read().strip().splitlines()
+        recs = [json.loads(ln) for ln in lines]
+        # the plan is journalled BEFORE any chunk record
+        assert recs[0].get("meta") == "geometry_plan"
+        assert all("chunk_id" in r for r in recs[1:])
+        journalled = GeometryPlan.from_dict(recs[0]["value"])
+        # drop some chunks, keep the plan: the resume must replay it
+        open(ckpt_file, "w").write(
+            "\n".join([lines[0]] + lines[1:3]) + "\n")
+        resumed = _fit(Xs, ys, grid, checkpoint_dir=str(tmp_path),
+                       **self._OVR)
+        geo = resumed.search_report["geometry"]
+        assert geo["source"] == "journal"
+        assert [g["width"] for g in geo["groups"]] == journalled.widths()
+        assert resumed.search_report["n_chunks_resumed"] == 2
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))
+
+    def test_mismatched_geometry_raises_clear_error(self, digits,
+                                                    tmp_path):
+        """A checkpoint written under sorted chunking must refuse to
+        resume into an unsorted search (different chunk-id universes) —
+        detected, never silently mixed."""
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        grid = {"C": np.logspace(-2, 1, 40).tolist()}
+        _fit(Xs, ys, grid, checkpoint_dir=str(tmp_path), **self._OVR)
+        with pytest.raises(GeometryMismatchError, match="geometry"):
+            _fit(Xs, ys, grid, checkpoint_dir=str(tmp_path),
+                 sort_candidates=False, **self._OVR)
+
+    def test_legacy_checkpoint_without_plan_still_resumes(self, digits,
+                                                          tmp_path):
+        """Pre-planner checkpoints have no geometry_plan line: the
+        resume keeps working (fresh plan, matching chunk ids when the
+        widths agree)."""
+        X, y = digits
+        Xs, ys = X[:300], y[:300]
+        grid = {"C": np.logspace(-2, 1, 40).tolist()}
+        full = _fit(Xs, ys, grid, checkpoint_dir=str(tmp_path),
+                    **self._OVR)
+        ckpt_file = glob.glob(str(tmp_path / "search_*.jsonl"))[0]
+        lines = open(ckpt_file).read().strip().splitlines()
+        chunk_lines = [ln for ln in lines
+                       if "chunk_id" in json.loads(ln)]
+        open(ckpt_file, "w").write("\n".join(chunk_lines[:2]) + "\n")
+        resumed = _fit(Xs, ys, grid, checkpoint_dir=str(tmp_path),
+                       **self._OVR)
+        assert resumed.search_report["n_chunks_resumed"] == 2
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))
+
+    def test_oom_bisection_under_planned_geometry(self, digits):
+        """Satellite: a fault-plan oom@k under the new geometry — the
+        bisected halves re-pad via pad_chunk and keep cv_results_
+        exact."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        grid = {"C": np.logspace(-2, 1, 40).tolist()}
+        base = _fit(Xs, ys, grid, **self._OVR)
+        faulted = _fit(Xs, ys, grid, fault_plan="oom@4",
+                       retry_backoff_s=0.01, **self._OVR)
+        f = faulted.search_report["faults"]
+        assert f["bisections"] >= 1, f
+        assert faulted.search_report["geometry"]["groups"]
+        _assert_exact_equal(_non_time_results(base),
+                            _non_time_results(faulted))

@@ -97,7 +97,29 @@ def summarize(events: List[Dict[str, Any]], top: int = 12) -> Dict[str, Any]:
     # its top self-time spans are where optimization effort goes
     bottleneck = max(threads.items(), key=lambda kv: kv[1]["busy_ms"],
                      default=(None, None))[0]
+    # host->device transfer digest from the data plane's upload spans
+    # (each carries its byte count in args): per-launch bytes make
+    # transfer regressions visible without loading Perfetto
+    h2d_bytes = 0
+    h2d_uploads = 0
+    tiled_bytes = 0
+    for e in spans:
+        args = e.get("args", {}) or {}
+        if e.get("name") == "dataplane.upload":
+            h2d_bytes += int(args.get("bytes", 0) or 0)
+            h2d_uploads += 1
+        elif e.get("name") == "dataplane.tile":
+            tiled_bytes += int(args.get("bytes", 0) or 0)
+    n_launches = asyncs.get("launch", 0)
+    h2d = {
+        "bytes_total": h2d_bytes,
+        "n_uploads": h2d_uploads,
+        "bytes_tiled_on_device": tiled_bytes,
+        "bytes_per_launch": round(h2d_bytes / n_launches, 1)
+        if n_launches else 0.0,
+    }
     return {
+        "h2d": h2d,
         "n_events": len(events),
         "n_spans": len(spans),
         "wall_ms": round(wall_ms, 3),
@@ -136,6 +158,15 @@ def format_summary(s: Dict[str, Any]) -> str:
         counts = ", ".join(f"{k}={v}"
                            for k, v in sorted(s["async_tracks"].items()))
         out.append(f"\nasync spans: {counts}")
+    h2d = s.get("h2d") or {}
+    if h2d.get("n_uploads"):
+        out.append(
+            f"\nbytes host->device: "
+            f"{h2d['bytes_total'] / 1e6:.3f} MB over "
+            f"{h2d['n_uploads']} uploads "
+            f"({h2d['bytes_per_launch'] / 1e6:.3f} MB per launch); "
+            f"{h2d['bytes_tiled_on_device'] / 1e6:.3f} MB tiled "
+            "on-device (no transfer)")
     return "\n".join(out)
 
 
