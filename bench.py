@@ -336,6 +336,20 @@ def _dataplane_summary(report):
     return out
 
 
+def leg_sstlint():
+    """Run the sstlint static-analysis gate in-process and record its
+    cost (rule count, finding counts, wall) — the gate rides tier-1,
+    so successive BENCH_r*.json files keep its price visible."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.sstlint import run_lint
+
+    res = run_lint(root=os.path.dirname(os.path.abspath(__file__)))
+    return {"n_rules": res["n_rules"],
+            "n_findings": res["n_findings"],
+            "n_baselined": res["n_baselined"],
+            "duration_s": res["duration_s"]}
+
+
 def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
                  max_iter=100, measure_bf16=False, serial_subsample=20):
     """BASELINE config #1 at north-star scale: LogReg C-grid on digits.
@@ -834,6 +848,15 @@ def run_child(platform):
         detail["trace_file"] = headline_trace
     if cache_reused:
         detail["compile_cache_reused"] = True  # cold wall excludes compile
+
+    # the static-analysis gate's cost, recorded next to the numbers it
+    # protects (cheap: pure-AST pass, no device work)
+    try:
+        detail["sstlint_gate"] = leg_sstlint()
+    except (Exception, SystemExit) as exc:
+        # gate-cost probe only — collect_modules raises SystemExit on
+        # an unparseable module, which must not kill the bench payload
+        detail["sstlint_gate_error"] = repr(exc)[:300]
 
     label = "TPU" if on_tpu else "CPU-fallback"
     payload = {

@@ -18,8 +18,19 @@ if [[ "${1:-}" == "--fast" ]]; then
     MARK=()
 fi
 
+echo "== sstlint (static analysis gate) =="
+# new (non-baselined) findings exit nonzero and fail the gate
+python -m tools.sstlint spark_sklearn_tpu/
+
 echo "== own tests (${1:---full}) =="
 python -m pytest tests/ -q "${MARK[@]}"
+
+echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
+# re-run the concurrency-heavy tests with every named lock
+# instrumented: the conftest hook fails the shard on any recorded
+# acquisition-order inversion
+SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
+    tests/test_faults.py tests/test_sstlint.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)

@@ -228,7 +228,14 @@ class KeyedEstimator(BaseEstimator):
         y_all = None if unsupervised else np.asarray(work[self.yCol])
         try:
             _, meta = family.prepare_data(X_all, y_all)
-        except Exception:
+        except Exception as exc:
+            # unsupported data shape/labels for the compiled family —
+            # fall back to per-key host fits, but leave a trace of why
+            # instead of a silent swallow
+            from spark_sklearn_tpu.obs.log import get_logger
+            get_logger(__name__).debug(
+                "keyed fleet: prepare_data rejected the stacked data "
+                "(%r); using per-key host fits", exc)
             return None, pairs
         static = family.extract_params(self.sklearnEstimator)
         min_needed = (family.min_group_size(static)

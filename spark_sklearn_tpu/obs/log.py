@@ -24,6 +24,7 @@ import logging
 from typing import Any, Dict
 
 from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.utils import locks as _locks
 
 __all__ = ["StructuredLogger", "get_logger"]
 
@@ -69,11 +70,13 @@ class StructuredLogger:
 
 
 _LOGGERS: Dict[str, StructuredLogger] = {}
+_LOGGERS_LOCK = _locks.named_lock("log._LOGGERS_LOCK")
 
 
 def get_logger(name: str) -> StructuredLogger:
     """Cached StructuredLogger for a dotted module name."""
     lg = _LOGGERS.get(name)
     if lg is None:
-        lg = _LOGGERS[name] = StructuredLogger(name)
+        with _LOGGERS_LOCK:
+            lg = _LOGGERS.setdefault(name, StructuredLogger(name))
     return lg

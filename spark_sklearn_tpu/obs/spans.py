@@ -1,0 +1,168 @@
+"""Span-name vocabulary — the single source of truth for trace names.
+
+Every span, instant and async track the engine records is declared
+here, so the three consumers can never drift from each other:
+
+  - the instrumentation sites (``tracer.span("...")`` across the
+    package) are linted against this table by ``tools/sstlint``'s
+    ``span-unknown-name`` rule — a typo'd or ad-hoc span name fails the
+    static-analysis gate instead of silently fragmenting the timeline;
+  - ``tools/trace_summary.py`` aggregates exported traces with the
+    same table (async spans group by their registered prefix) and
+    warns on names it has never heard of;
+  - ``dev/build_api_docs.py`` renders the vocabulary into
+    ``docs/API.md`` so the trace names users grep for are documented
+    from the definitions the code records through.
+
+This module is deliberately import-light (stdlib only): trace_summary
+loads it by file path so digesting a trace never pays the jax import.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+__all__ = [
+    "SpanDef",
+    "SPAN_VOCABULARY",
+    "ASYNC_PREFIXES",
+    "KNOWN_TRACKS",
+    "known_span_names",
+    "async_prefix",
+    "is_known_span",
+    "vocabulary_markdown",
+]
+
+
+class SpanDef(NamedTuple):
+    """One registered trace name.
+
+    ``kind``: "span" (complete X event), "instant" (zero-duration
+    marker), or "async" (b/e pair on a virtual track; ``name`` is the
+    PREFIX — the recorded name may append an identifier, e.g.
+    ``launch g0c1:fused``).
+    """
+
+    name: str
+    kind: str
+    module: str
+    description: str
+
+
+#: the registered vocabulary, grouped by recording module.
+SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
+    # search/grid.py
+    SpanDef("search.fit", "span", "search.grid",
+            "One whole GridSearchCV/RandomizedSearchCV fit."),
+    SpanDef("prevalidate", "span", "search.grid",
+            "Candidate-param constraint validation before any launch."),
+    SpanDef("refit", "span", "search.grid",
+            "The best_estimator_ refit after the sweep."),
+    SpanDef("host.fit_and_score", "span", "search.grid",
+            "Host-tier per-candidate sklearn _fit_and_score fan-out."),
+    # parallel/taskgrid.py
+    SpanDef("build_compile_groups", "span", "parallel.taskgrid",
+            "Partitioning candidates into static-signature groups."),
+    SpanDef("pad_chunk", "span", "parallel.taskgrid",
+            "Slicing + padding one chunk to its launch width."),
+    # parallel/mesh.py
+    SpanDef("build_mesh", "span", "parallel.mesh",
+            "Mesh construction over the visible devices."),
+    SpanDef("device_put.replicate", "span", "parallel.mesh",
+            "Replicated device_put (the TPU-native sc.broadcast)."),
+    SpanDef("device_put.shard", "span", "parallel.mesh",
+            "Leading-axis sharded device_put."),
+    SpanDef("device_put.broadcast", "span", "search.grid",
+            "The search's whole X/y + fold-mask broadcast phase "
+            "(plane-cached uploads; recorded retroactively)."),
+    SpanDef("device_get", "span", "parallel.mesh",
+            "Blocking device->host transfer."),
+    SpanDef("device_get.allgather", "span", "parallel.mesh",
+            "Multi-controller device_get via process_allgather."),
+    # parallel/dataplane.py
+    SpanDef("dataplane.upload", "span", "parallel.dataplane",
+            "One host->device transfer (carries `bytes`)."),
+    SpanDef("dataplane.tile", "span", "parallel.dataplane",
+            "On-device fold-mask tiling (no host transfer)."),
+    # parallel/pipeline.py
+    SpanDef("stage", "span", "parallel.pipeline",
+            "Chunk staging (host prep + device_put) on sst-stage."),
+    SpanDef("dispatch", "span", "parallel.pipeline",
+            "Async launch enqueue (first dispatch includes compile)."),
+    SpanDef("compute.wait", "span", "parallel.pipeline",
+            "Blocking wait for a launch's outputs on sst-gather."),
+    SpanDef("compute", "span", "parallel.pipeline",
+            "Device-occupancy estimate on the virtual `device` track."),
+    SpanDef("gather", "span", "parallel.pipeline",
+            "Blocking device->host result transfer."),
+    SpanDef("finalize", "span", "parallel.pipeline",
+            "Result writes / checkpoint append, dispatch order."),
+    SpanDef("compile", "span", "parallel.pipeline",
+            "AOT lower+compile on the sst-compile thread."),
+    # parallel/faults.py
+    SpanDef("launch.retry", "span", "parallel.faults",
+            "Transient-fault retry of a launch's phases."),
+    SpanDef("launch.bisect", "span", "parallel.faults",
+            "OOM recovery: chunk bisected into half-width launches."),
+    SpanDef("launch.host_fallback", "span", "parallel.faults",
+            "OOM recovery bottomed out into per-candidate host runs."),
+    # utils/session.py
+    SpanDef("session.init", "span", "utils.session",
+            "TpuSession bootstrap (mesh, caches, fault plan)."),
+    # obs/log.py
+    SpanDef("log", "instant", "obs.log",
+            "A stdout-parity verbose line mirrored onto the timeline."),
+    # async virtual tracks (name prefixes)
+    SpanDef("launch", "async", "parallel.pipeline",
+            "Whole-launch span (dispatch..finalize) per chunk, on the "
+            "`launches` track."),
+    SpanDef("compile-group", "async", "parallel.pipeline",
+            "Compile-group boundary span on the `compile-groups` "
+            "track."),
+)
+
+#: async-span name prefixes, longest first so `compile-group 3` never
+#: matches a shorter prefix by accident.
+ASYNC_PREFIXES: Tuple[str, ...] = tuple(sorted(
+    (d.name for d in SPAN_VOCABULARY if d.kind == "async"),
+    key=len, reverse=True))
+
+#: virtual track names the exporter lays spans out on.
+KNOWN_TRACKS: Tuple[str, ...] = ("device", "launches", "compile-groups")
+
+
+def known_span_names() -> frozenset:
+    """Exact (non-async) registered names."""
+    return frozenset(d.name for d in SPAN_VOCABULARY if d.kind != "async")
+
+
+def async_prefix(name: str) -> Optional[str]:
+    """The registered async prefix `name` falls under, or None."""
+    for p in ASYNC_PREFIXES:
+        if name == p or name.startswith(p + " "):
+            return p
+    return None
+
+
+def is_known_span(name: str) -> bool:
+    """Is `name` (exact span/instant, or a registered async prefix
+    form) part of the vocabulary?"""
+    return name in known_span_names() or async_prefix(name) is not None
+
+
+def vocabulary_markdown() -> str:
+    """The span-vocabulary table ``dev/build_api_docs.py`` renders into
+    ``docs/API.md`` — defined here, next to the vocabulary, so
+    sstlint's ``docs-stale`` rule can compare the docs against it
+    without importing the (jax-heavy) rest of the package."""
+    out = [
+        "## Span vocabulary\n",
+        "\nEvery trace name the engine records, pinned in "
+        "`spark_sklearn_tpu/obs/spans.py` (async entries are name "
+        "PREFIXES on virtual tracks).\n",
+        "\n| name | kind | module | description |\n|---|---|---|---|\n",
+    ]
+    for d in SPAN_VOCABULARY:
+        out.append(f"| `{d.name}` | {d.kind} | {d.module} | "
+                   f"{d.description} |\n")
+    return "".join(out)

@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 
 from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
 
 __all__ = [
     "DataPlane",
@@ -79,7 +80,7 @@ DEFAULT_BYTE_BUDGET = 256 * 2 ** 20
 #: cacheable or not) — the pipeline's per-launch ``stage_bytes`` and the
 #: trace digest read this.
 _TOTALS = {"bytes": 0, "uploads": 0}
-_TOTALS_LOCK = threading.Lock()
+_TOTALS_LOCK = named_lock("dataplane._TOTALS_LOCK")
 
 
 def bytes_uploaded() -> int:
@@ -147,7 +148,7 @@ class DataPlane:
     """
 
     def __init__(self, byte_budget: int = DEFAULT_BYTE_BUDGET):
-        self._lock = threading.RLock()
+        self._lock = named_rlock("dataplane.DataPlane._lock")
         #: key -> (device array, nbytes)
         self._entries: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
         self._bytes = 0
@@ -172,18 +173,22 @@ class DataPlane:
         return self
 
     def _evict_over_budget(self, keep: Any = None) -> None:
-        while self._bytes > self.byte_budget and len(self._entries) > 1:
-            key = next(iter(self._entries))
-            if key == keep:
-                # never evict the entry being returned; rotate it to
-                # the MRU end and take the next-oldest instead
-                self._entries.move_to_end(key)
+        # every caller already holds the (reentrant) plane lock; taking
+        # it again makes the helper safe on its own rather than by
+        # call-site convention
+        with self._lock:
+            while self._bytes > self.byte_budget and len(self._entries) > 1:
                 key = next(iter(self._entries))
                 if key == keep:
-                    break
-            _, nbytes = self._entries.pop(key)
-            self._bytes -= nbytes
-            self.evictions += 1
+                    # never evict the entry being returned; rotate it to
+                    # the MRU end and take the next-oldest instead
+                    self._entries.move_to_end(key)
+                    key = next(iter(self._entries))
+                    if key == keep:
+                        break
+                _, nbytes = self._entries.pop(key)
+                self._bytes -= nbytes
+                self.evictions += 1
         # a single oversized entry may exceed the budget on its own; it
         # stays (dropping it would force a re-upload every search) and
         # becomes the next LRU victim
@@ -301,7 +306,7 @@ class DataPlane:
 
 
 _PLANE: Optional[DataPlane] = None
-_PLANE_LOCK = threading.Lock()
+_PLANE_LOCK = named_lock("dataplane._PLANE_LOCK")
 
 
 def get_dataplane() -> DataPlane:
@@ -412,7 +417,7 @@ class StagingRing:
 
     def __init__(self, slots: int = 3):
         self._n = max(2, int(slots))
-        self._lock = threading.Lock()
+        self._lock = named_lock("dataplane.StagingRing._lock")
         self._rings: Dict[Any, Dict[str, Any]] = {}
 
     def slot(self, key, shape: Tuple[int, ...], dtype) -> "_Slot":
@@ -438,6 +443,11 @@ class StagingRing:
         if slot.consumer is not None:
             try:
                 jax.block_until_ready(slot.consumer)
+            # a donated-and-deleted consumer raises on the readiness
+            # probe, which PROVES the buffer was consumed — exactly the
+            # condition the wait establishes, so the error is the
+            # success case here, not a hidden failure
+            # sstlint: disable=swallowed-exception
             except Exception:   # donated-and-deleted: consumed for sure
                 pass
             slot.consumer = None

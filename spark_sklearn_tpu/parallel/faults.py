@@ -56,6 +56,7 @@ import jax
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.parallel.pipeline import LaunchItem
+from spark_sklearn_tpu.utils.locks import named_lock
 
 _slog = get_logger(__name__)
 
@@ -354,10 +355,14 @@ class LaunchSupervisor:
         self.verbose = int(verbose)
         self._ckpt = ckpt
         self._tracer = get_tracer()
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.LaunchSupervisor._lock")
         self._seq = 0
         self._retries_used = 0
-        self._sticky_oom = False
+        # count of in-flight sticky (oom_deep) recoveries, not a bool:
+        # concurrent recoveries on the dispatch and gather threads each
+        # enter/leave independently, and a saved-prev restore would let
+        # one recovery clobber the other's flag
+        self._sticky_oom = 0
         self.faults: Dict[str, Any] = faults if faults is not None else {}
         self.faults.update({
             "retries": 0, "bisections": 0, "host_fallbacks": 0,
@@ -463,6 +468,10 @@ class LaunchSupervisor:
         def blocker():
             try:
                 box["out"] = _block_until_ready(out)
+            # nothing is swallowed here: the watchdog thread marshals
+            # EVERY exception (KeyboardInterrupt included) back to the
+            # waiting caller, which re-raises it below
+            # sstlint: disable=broad-except-swallow,launch-except-taxonomy
             except BaseException as exc:       # re-raised on the caller
                 box["exc"] = exc
             finally:
@@ -638,12 +647,19 @@ class LaunchSupervisor:
         if item.bisect is not None:
             with self._tracer.span("launch.bisect", key=item.key,
                                    group=item.group):
-                prev = self._sticky_oom
-                self._sticky_oom = prev or sticky
+                # the sticky count is shared supervisor state read by
+                # every bisected sub-launch; recoveries can run on the
+                # dispatch AND gather threads concurrently, so each
+                # sticky recovery holds its own +1 for its duration
+                if sticky:
+                    with self._lock:
+                        self._sticky_oom += 1
                 try:
                     return _Recovered(item.bisect(self))
                 finally:
-                    self._sticky_oom = prev
+                    if sticky:
+                        with self._lock:
+                            self._sticky_oom -= 1
         if item.host_fallback is not None:
             self.record_host_fallback(item.key, item.group, item.n_tasks)
             with self._tracer.span("launch.host_fallback", key=item.key,

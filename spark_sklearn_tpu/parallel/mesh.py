@@ -121,6 +121,11 @@ class TpuConfig:
     # everywhere.  Applies to the wide score path only (custom scorers
     # keep separate launches).
     fuse_fit_score: bool = True
+    # force the nested per-(candidate, fold) score path even when every
+    # scorer exposes a task-batched core — the A/B control arm
+    # (tools/score_ab.py).  None/False keeps the wide path; the
+    # SST_NESTED_SCORE env var is the process-wide spelling.
+    nested_score: bool = False
     # ---- fault tolerance (parallel/faults.py LaunchSupervisor) ----
     # transient device errors retry with exponential backoff + jitter;
     # budgets are per launch AND per search (a flapping device must not

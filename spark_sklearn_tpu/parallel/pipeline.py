@@ -50,6 +50,7 @@ import jax
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.parallel import dataplane as _dataplane
+from spark_sklearn_tpu.utils.locks import named_lock
 
 _slog = get_logger(__name__)
 
@@ -71,7 +72,7 @@ __all__ = [
 #: (compiler.py records /jax/compilation_cache/cache_{hits,misses} on
 #: every compile request once a cache dir is configured)
 _CACHE_EVENTS = {"hits": 0, "misses": 0}
-_LISTENER_LOCK = threading.Lock()
+_LISTENER_LOCK = named_lock("pipeline._LISTENER_LOCK")
 _LISTENER_INSTALLED = False
 
 
@@ -87,10 +88,15 @@ def _install_cache_listener() -> None:
             return
 
         def _on_event(event: str, **kwargs) -> None:
+            # jax may fire this from whichever thread compiles (the
+            # sst-compile worker or the dispatching main thread), so
+            # the read-modify-write increments need the lock
             if event == "/jax/compilation_cache/cache_hits":
-                _CACHE_EVENTS["hits"] += 1
+                with _LISTENER_LOCK:
+                    _CACHE_EVENTS["hits"] += 1
             elif event == "/jax/compilation_cache/cache_misses":
-                _CACHE_EVENTS["misses"] += 1
+                with _LISTENER_LOCK:
+                    _CACHE_EVENTS["misses"] += 1
 
         monitoring.register_event_listener(_on_event)
         _LISTENER_INSTALLED = True

@@ -25,6 +25,7 @@ import numpy as np
 
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.parallel.mesh import pad_to_multiple as _pad_up
+from spark_sklearn_tpu.utils.locks import named_lock
 
 
 @dataclasses.dataclass
@@ -243,6 +244,10 @@ class GeometryCostModel:
     def __init__(self,
                  launch_overhead_s: float = DEFAULT_LAUNCH_OVERHEAD_S,
                  lane_cost_s: float = DEFAULT_LANE_COST_S):
+        #: the process-global instance is observed into at the end of
+        #: every search — concurrent searches on different threads
+        #: update it through this lock
+        self._lock = named_lock("taskgrid.GeometryCostModel._lock")
         self.launch_overhead_s = float(launch_overhead_s)
         self.lane_cost_s = float(lane_cost_s)
         self.compile_wall_s = 0.0
@@ -268,25 +273,27 @@ class GeometryCostModel:
         med_overhead = overheads[(len(overheads) - 1) // 2]
         compute = sum(r.get("compute_s", 0.0) for r in recs)
         lanes = sum(r["n_tasks"] for r in recs)
-        lane_cost = compute / lanes if lanes else self.lane_cost_s
         compile_excess = sum(
             max(0.0, o - med_overhead) for o in overheads)
-        alpha = 0.5 if self.n_observations else 1.0
-        self.launch_overhead_s += alpha * (
-            med_overhead - self.launch_overhead_s)
-        self.lane_cost_s += alpha * (lane_cost - self.lane_cost_s)
-        self.compile_wall_s += alpha * (
-            compile_excess - self.compile_wall_s)
-        self.n_observations += 1
+        with self._lock:
+            lane_cost = compute / lanes if lanes else self.lane_cost_s
+            alpha = 0.5 if self.n_observations else 1.0
+            self.launch_overhead_s += alpha * (
+                med_overhead - self.launch_overhead_s)
+            self.lane_cost_s += alpha * (lane_cost - self.lane_cost_s)
+            self.compile_wall_s += alpha * (
+                compile_excess - self.compile_wall_s)
+            self.n_observations += 1
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
-            "launch_overhead_s": round(self.launch_overhead_s, 6),
-            "lane_cost_s": round(self.lane_cost_s, 8),
-            "compile_wall_s": round(self.compile_wall_s, 6),
-            "n_observations": self.n_observations,
-            "source": "measured" if self.n_observations else "default",
-        }
+        with self._lock:
+            return {
+                "launch_overhead_s": round(self.launch_overhead_s, 6),
+                "lane_cost_s": round(self.lane_cost_s, 8),
+                "compile_wall_s": round(self.compile_wall_s, 6),
+                "n_observations": self.n_observations,
+                "source": "measured" if self.n_observations else "default",
+            }
 
 
 _COST_MODEL = GeometryCostModel()
@@ -375,6 +382,7 @@ def _chunk_cost(nc: int, width: int, n_folds: int, overhead: float,
 #: the process lifetime — cost-model drift must not re-plan identical
 #: searches onto new widths (each new width is a fresh XLA compile).
 _PLAN_CACHE: Dict[Any, GeometryPlan] = {}
+_PLAN_CACHE_LOCK = named_lock("taskgrid._PLAN_CACHE_LOCK")
 
 
 def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
@@ -408,7 +416,8 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                  int(n_task_shards), int(max_width), mode,
                  overhead_override, lane_cost_override)
     if reuse:
-        hit = _PLAN_CACHE.get(cache_key)
+        with _PLAN_CACHE_LOCK:
+            hit = _PLAN_CACHE.get(cache_key)
         if hit is not None:
             return dataclasses.replace(hit, source="plan-cache")
 
@@ -456,7 +465,11 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
             n_chunks=-(-nc // int(width)), sorted=cap is not None))
     plan = GeometryPlan(mode=mode, groups=groups, cost_model=snap)
     if reuse:
-        _PLAN_CACHE[cache_key] = plan
+        with _PLAN_CACHE_LOCK:
+            # first plan computed for a structure wins: a concurrent
+            # search that raced this one keeps serving the earlier
+            # entry so widths never flap mid-process
+            plan = _PLAN_CACHE.setdefault(cache_key, plan)
     return plan
 
 

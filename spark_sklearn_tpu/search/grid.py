@@ -1462,8 +1462,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # scorers without a core (family default_scorer like KMeans
         # -inertia) keep the nested path.
         import os as _os
+        # same boolean spelling as the other SST_* switches: "0"/"off"
+        # must NOT force the nested control arm
+        _nested_env = _os.environ.get(
+            "SST_NESTED_SCORE", "").strip().lower() in (
+                "1", "true", "on", "yes")
         all_cores = all(hasattr(fn, "core") for fn in scorers.values()) \
-            and not _os.environ.get("SST_NESTED_SCORE")
+            and not getattr(config, "nested_score", False) \
+            and not _nested_env
         needed_views = frozenset(
             v for fn in scorers.values()
             for v in getattr(fn, "views", ()))
@@ -1806,7 +1812,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         #: guards the per-plan staged-chunk bookkeeping: stage normally
         #: runs on the single stage thread, but supervisor retries
         #: re-stage on whichever thread is recovering
-        stage_lock = threading.Lock()
+        from spark_sklearn_tpu.utils.locks import named_lock
+        stage_lock = named_lock("grid.stage_lock")
 
         cache0 = persistent_cache_counts()
         builds0 = _program_build_count()
@@ -1851,6 +1858,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     progs["fused"], dyn_spec, data_dev, w_spec,
                     test_dev, train_sc_dev, test_unw_dev, train_unw_dev,
                     label=f"fused group {plan['gi']}")
+            # sstlint: disable=launch-except-taxonomy — AOT compile-ahead
+            # is an optimization only: any failure here means the jit
+            # path compiles at first dispatch, exactly as it always did
             except Exception as exc:   # AOT is an optimization only
                 logger.debug("fused precompile submission failed: %r", exc)
 
@@ -1880,6 +1890,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             # context lost
                             _plan["fused_call"] = _jit
                             return _jit(*args)
+                # sstlint: disable=launch-except-taxonomy — consuming a
+                # failed AOT future: the plain jit program below is the
+                # sanctioned identical-results fallback
                 except Exception as exc:
                     logger.debug("fused precompile failed (%r); "
                                  "falling back to jit", exc)
@@ -2697,6 +2710,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             tags.input_tags.pairwise = sub.input_tags.pairwise
             tags.input_tags.sparse = sub.input_tags.sparse
             tags.array_api_support = sub.array_api_support
+        # sstlint: disable=swallowed-exception — sklearn-version compat
+        # shim: tag surfaces moved repeatedly across 1.x; missing
+        # attributes simply leave the default tags in place
         except Exception:
             pass
         return tags

@@ -118,6 +118,53 @@ def pytest_collection_modifyitems(config, items):
         items[:] = kept
 
 
+# ---------------------------------------------------------------------------
+# SST_LOCKCHECK=1: the runtime lock-order recorder
+# (spark_sklearn_tpu/utils/locks.py).  The suite runs with every named
+# lock instrumented; any recorded acquisition-order INVERSION (the
+# deadlock precondition) fails the session, long holds are printed as
+# warnings.  dev/run-tests.sh runs a dedicated shard in this mode.
+# ---------------------------------------------------------------------------
+
+
+def _lockcheck_recorder():
+    from spark_sklearn_tpu.utils import locks
+    return locks.get_recorder() if locks.lockcheck_enabled() else None
+
+
+def pytest_terminal_summary(terminalreporter):
+    rec = _lockcheck_recorder()
+    if rec is None:
+        return
+    rep = rec.report()
+    terminalreporter.write_line(
+        f"lockcheck: {rep['n_edges']} acquisition-order edge(s), "
+        f"{len(rep['inversions'])} inversion(s), "
+        f"{len(rep['long_holds'])} long hold(s)")
+    for edge in rep["edges"]:
+        terminalreporter.write_line(f"  order: {edge[0]} -> {edge[1]}")
+    for lh in rep["long_holds"][:10]:
+        terminalreporter.write_line(
+            f"  long hold: {lh['lock']} held {lh['held_s']}s "
+            f"on {lh['thread']}")
+    for inv in rep["inversions"]:
+        a, b = inv["locks"]
+        terminalreporter.write_line(
+            f"  INVERSION: {a} <-> {b} "
+            f"({inv['thread_a']} vs {inv['thread_b']})")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    rec = _lockcheck_recorder()
+    if rec is None:
+        return
+    if rec.report()["inversions"] and exitstatus == 0:
+        # a green suite that recorded a lock-order inversion is NOT
+        # green: two threads interleaving those paths can deadlock.
+        # 1 == ExitCode.TESTS_FAILED (3 would read as INTERNAL_ERROR)
+        session.exitstatus = 1
+
+
 @pytest.fixture
 def clean_tracer():
     """The global span tracer, guaranteed disabled+empty before and

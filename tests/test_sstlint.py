@@ -1,0 +1,676 @@
+"""sstlint's own suite: fixture trees per rule (positive + negative +
+suppression), baseline round-trip, the runtime lock-order recorder,
+and the real-tree gate (the package must lint clean)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.sstlint import Project, run_lint, save_baseline  # noqa: E402
+from tools.sstlint.core import load_baseline  # noqa: E402
+
+
+def make_project(root: Path, **kw) -> Project:
+    pkg = root / "pkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    defaults = dict(root=root, package=pkg)
+    defaults.update(kw)
+    return Project(**defaults)
+
+
+def write(root: Path, rel: str, text: str) -> Path:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+    return p
+
+
+def lint(project, rules):
+    return run_lint(project, rules=rules,
+                    baseline_path=project.root / "baseline.json")
+
+
+def rule_hits(result, rule):
+    return [f for f in result["findings"] if f["rule"] == rule]
+
+
+# ---------------------------------------------------------------------------
+# exception hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestExceptRules:
+    def test_bare_except_flagged_and_suppressed(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except:\n"
+            "        return None\n"
+            "def g():\n"
+            "    try:\n"
+            "        work()\n"
+            "    # justified: legacy shim\n"
+            "    # sstlint: disable=bare-except\n"
+            "    except:\n"
+            "        return None\n"
+            "def h():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError:\n"
+            "        return None\n"))
+        r = lint(make_project(tmp_path), ["bare-except"])
+        hits = rule_hits(r, "bare-except")
+        assert len(hits) == 1 and hits[0]["line"] == 4
+
+    def test_broad_baseexception_requires_reraise(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def bad():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except BaseException as exc:\n"
+            "        log(exc)\n"
+            "def ok():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except BaseException:\n"
+            "        raise\n"))
+        r = lint(make_project(tmp_path), ["broad-except-swallow"])
+        hits = rule_hits(r, "broad-except-swallow")
+        assert len(hits) == 1 and hits[0]["line"] == 4
+
+    def test_swallowed_exception(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "import warnings\n"
+            "def bad():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "def ok_logs():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception as exc:\n"
+            "        warnings.warn(f'fallback: {exc}')\n"))
+        r = lint(make_project(tmp_path), ["swallowed-exception"])
+        hits = rule_hits(r, "swallowed-exception")
+        assert len(hits) == 1 and hits[0]["line"] == 5
+
+    def test_raise_without_cause(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def bad():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError as exc:\n"
+            "        raise RuntimeError('translated')\n"
+            "def ok():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except ValueError as exc:\n"
+            "        raise RuntimeError('translated') from exc\n"))
+        r = lint(make_project(tmp_path), ["raise-without-cause"])
+        hits = rule_hits(r, "raise-without-cause")
+        assert len(hits) == 1 and hits[0]["line"] == 5
+
+    def test_launch_taxonomy(self, tmp_path):
+        write(tmp_path, "pkg/launchy.py", (
+            "def classify_error(e):\n"
+            "    return 'fatal'\n"
+            "def bad_handler():\n"
+            "    try:\n"
+            "        launch()\n"
+            "    except Exception as exc:\n"
+            "        return None\n"
+            "def ok_handler():\n"
+            "    try:\n"
+            "        launch()\n"
+            "    except Exception as exc:\n"
+            "        if classify_error(exc) == 'fatal':\n"
+            "            raise\n"))
+        proj = make_project(tmp_path, launch_paths=("launchy.py",))
+        r = lint(proj, ["launch-except-taxonomy"])
+        hits = rule_hits(r, "launch-except-taxonomy")
+        assert len(hits) == 1 and hits[0]["line"] == 6
+
+
+# ---------------------------------------------------------------------------
+# lock order / shared state
+# ---------------------------------------------------------------------------
+
+
+class TestLockRules:
+    def test_lock_order_cycle(self, tmp_path):
+        write(tmp_path, "pkg/locksmod.py", (
+            "A = named_lock('m.A')\n"
+            "B = named_lock('m.B')\n"
+            "def one():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def two():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n"))
+        r = lint(make_project(tmp_path), ["lock-order-cycle"])
+        assert rule_hits(r, "lock-order-cycle")
+
+    def test_consistent_order_clean(self, tmp_path):
+        write(tmp_path, "pkg/locksmod.py", (
+            "A = named_lock('m.A')\n"
+            "B = named_lock('m.B')\n"
+            "def one():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def two():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"))
+        r = lint(make_project(tmp_path), ["lock-order-cycle"])
+        assert not rule_hits(r, "lock-order-cycle")
+
+    def test_deferred_callback_is_not_under_the_lock(self, tmp_path):
+        # a callback DEFINED under lock A runs in whatever frame later
+        # invokes it: acquiring B in its body is no A->B edge, and a
+        # shared-state mutation in its body is NOT guarded by A
+        from tools.sstlint.project import SharedState
+        write(tmp_path, "pkg/locksmod.py", (
+            "A = named_lock('m.A')\n"
+            "B = named_lock('m.B')\n"
+            "TOTALS = {'n': 0}\n"
+            "def install():\n"
+            "    with A:\n"
+            "        def cb():\n"
+            "            with B:\n"
+            "                pass\n"
+            "        register(cb)\n"
+            "def other():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n"
+            "def install2():\n"
+            "    with A:\n"
+            "        def cb2():\n"
+            "            TOTALS['n'] += 1\n"
+            "        register(cb2)\n"))
+        proj = make_project(tmp_path, shared_state=(
+            SharedState("locksmod.py", "m.A", name="TOTALS"),))
+        r = lint(proj, ["lock-order-cycle", "unlocked-shared-mutation"])
+        # no false A->B edge from cb, so B->A in other() is no cycle
+        assert not rule_hits(r, "lock-order-cycle")
+        # and cb2's mutation is correctly seen as unguarded
+        assert [f["line"] for f in
+                rule_hits(r, "unlocked-shared-mutation")] == [17]
+
+    def test_cross_module_lock_including_call_through(self, tmp_path):
+        # nested with across module prefixes, via a one-hop call
+        write(tmp_path, "pkg/other.py", (
+            "L2 = named_lock('other.L2')\n"
+            "def locked_op():\n"
+            "    with L2:\n"
+            "        pass\n"))
+        write(tmp_path, "pkg/main.py", (
+            "from pkg.other import locked_op\n"
+            "L1 = named_lock('main.L1')\n"
+            "def f():\n"
+            "    with L1:\n"
+            "        locked_op()\n"))
+        proj = make_project(tmp_path)
+        r = lint(proj, ["cross-module-lock"])
+        hits = rule_hits(r, "cross-module-lock")
+        assert len(hits) == 1
+        assert "other.L2" in hits[0]["message"]
+        # the allowlist silences the pair
+        proj2 = make_project(tmp_path,
+                             allowed_cross_module=(("main", "other"),))
+        r2 = lint(proj2, ["cross-module-lock"])
+        assert not rule_hits(r2, "cross-module-lock")
+
+    def test_unlocked_shared_mutation(self, tmp_path):
+        from tools.sstlint.project import SharedState
+        write(tmp_path, "pkg/state.py", (
+            "TOTALS = {'bytes': 0}\n"
+            "LOCK = named_lock('state.LOCK')\n"
+            "def bad(n):\n"
+            "    TOTALS['bytes'] += n\n"
+            "def good(n):\n"
+            "    with LOCK:\n"
+            "        TOTALS['bytes'] += n\n"
+            "def bad_taint(plan, cid):\n"
+            "    done = plan.setdefault('staged_ids', set())\n"
+            "    done.add(cid)\n"
+            "def good_taint(plan, cid):\n"
+            "    done = plan.setdefault('staged_ids', set())\n"
+            "    with LOCK:\n"
+            "        done.add(cid)\n"))
+        proj = make_project(tmp_path, shared_state=(
+            SharedState("state.py", "state.LOCK", name="TOTALS"),
+            SharedState("state.py", "state.LOCK",
+                        taint_key="staged_ids"),
+        ))
+        r = lint(proj, ["unlocked-shared-mutation"])
+        lines = sorted(f["line"] for f in
+                       rule_hits(r, "unlocked-shared-mutation"))
+        assert lines == [4, 10]
+
+    def test_unnamed_lock(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "import threading\n"
+            "GOOD = named_lock('a.GOOD')\n"
+            "BAD = threading.Lock()\n"))
+        r = lint(make_project(tmp_path), ["unnamed-lock"])
+        hits = rule_hits(r, "unnamed-lock")
+        assert len(hits) == 1 and hits[0]["line"] == 3
+
+
+# ---------------------------------------------------------------------------
+# spans + schema + docs
+# ---------------------------------------------------------------------------
+
+_FIXTURE_SPANS = (
+    "KNOWN = {'stage', 'dispatch'}\n"
+    "ASYNC = ('launch',)\n"
+    "def known_span_names():\n"
+    "    return frozenset(KNOWN)\n"
+    "def async_prefix(name):\n"
+    "    for p in ASYNC:\n"
+    "        if name == p or name.startswith(p + ' '):\n"
+    "            return p\n"
+    "    return None\n"
+    "def is_known_span(name):\n"
+    "    return name in KNOWN or async_prefix(name) is not None\n")
+
+
+class TestSpanRules:
+    def test_span_vocabulary(self, tmp_path):
+        spans = write(tmp_path, "pkg/spans.py", _FIXTURE_SPANS)
+        write(tmp_path, "pkg/a.py", (
+            "def f(tracer, key):\n"
+            "    with tracer.span('stage', key=key):\n"
+            "        pass\n"
+            "    with tracer.span('stag', key=key):\n"
+            "        pass\n"
+            "    tracer.record_async(f'launch {key}', 0, 1, track='t')\n"
+            "    tracer.record_async(f'lunch {key}', 0, 1, track='t')\n"))
+        proj = make_project(tmp_path, spans_path=spans)
+        r = lint(proj, ["span-unknown-name"])
+        syms = sorted(f["message"] for f in
+                      rule_hits(r, "span-unknown-name"))
+        assert len(syms) == 2
+        assert any("'stag'" in s for s in syms)
+        assert any("'lunch'" in s for s in syms)
+
+    def test_span_context_manager(self, tmp_path):
+        spans = write(tmp_path, "pkg/spans.py", _FIXTURE_SPANS)
+        write(tmp_path, "pkg/a.py", (
+            "def f(tracer):\n"
+            "    s = tracer.span('stage')\n"
+            "    s.__enter__()\n"
+            "def g(tracer):\n"
+            "    with tracer.span('stage'):\n"
+            "        pass\n"))
+        proj = make_project(tmp_path, spans_path=spans)
+        r = lint(proj, ["span-not-context-managed"])
+        hits = rule_hits(r, "span-not-context-managed")
+        assert len(hits) == 1 and hits[0]["line"] == 2
+
+    def test_schema_block_drift_both_directions(self, tmp_path):
+        # schema misses a produced key ('extra') AND declares one
+        # nothing produces ('missing') — the ISSUE's drift fixture
+        metrics = write(tmp_path, "pkg/metrics.py", (
+            "from collections import namedtuple\n"
+            "MetricDef = namedtuple('MetricDef', 'name kind')\n"
+            "DATAPLANE_BLOCK_SCHEMA = (\n"
+            "    MetricDef('hits', 'counter'),\n"
+            "    MetricDef('missing', 'gauge'),\n"
+            ")\n"))
+        write(tmp_path, "pkg/plane.py", (
+            "def report_block(plane):\n"
+            "    return {'hits': plane.hits, 'extra': 1}\n"))
+        from tools.sstlint.project import BlockSpec, Producer
+        proj = make_project(
+            tmp_path, metrics_path=metrics,
+            blocks=(BlockSpec("dataplane", "DATAPLANE_BLOCK_SCHEMA", (
+                Producer("dict-keys", "plane.py", "report_block"),)),))
+        r = lint(proj, ["schema-block-drift"])
+        msgs = " | ".join(f["message"] for f in
+                          rule_hits(r, "schema-block-drift"))
+        assert "'extra'" in msgs and "'missing'" in msgs
+        assert len(rule_hits(r, "schema-block-drift")) == 2
+
+    def test_report_key_undeclared(self, tmp_path):
+        metrics = write(tmp_path, "pkg/metrics.py", (
+            "from collections import namedtuple\n"
+            "MetricDef = namedtuple('MetricDef', 'name kind')\n"
+            "SEARCH_REPORT_SCHEMA = (MetricDef('n_launches', "
+            "'counter'),)\n"))
+        write(tmp_path, "pkg/engine.py", (
+            "def run(metrics):\n"
+            "    metrics.counter('n_launches').inc()\n"
+            "    metrics.counter('nope').inc()\n"))
+        proj = make_project(tmp_path, metrics_path=metrics)
+        r = lint(proj, ["report-key-undeclared"])
+        hits = rule_hits(r, "report-key-undeclared")
+        assert len(hits) == 1 and "'nope'" in hits[0]["message"]
+
+    def test_docs_stale(self, tmp_path):
+        from tools.sstlint import catalog_markdown
+        metrics = write(tmp_path, "pkg/metrics.py", (
+            "def schema_markdown():\n"
+            "    return '## schema\\n| a | b |\\n'\n"))
+        spans = write(tmp_path, "pkg/spans.py", (
+            "def vocabulary_markdown():\n"
+            "    return '## spans\\n| s |\\n'\n"))
+        docs = write(tmp_path, "docs/API.md", "# API\nstale text\n")
+        proj = make_project(tmp_path, metrics_path=metrics,
+                            spans_path=spans, docs_api=docs)
+        r = lint(proj, ["docs-stale"])
+        # one finding per drifted generated section
+        assert sorted(f["key"].rsplit("::", 1)[-1]
+                      for f in rule_hits(r, "docs-stale")) == [
+            "catalog-section", "schema-section", "spans-section"]
+        docs.write_text("# API\n## schema\n| a | b |\nmore\n"
+                        "## spans\n| s |\n" + catalog_markdown())
+        r2 = lint(proj, ["docs-stale"])
+        assert not rule_hits(r2, "docs-stale")
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+_FIXTURE_CONFIG = (
+    "import dataclasses\n"
+    "@dataclasses.dataclass\n"
+    "class TpuConfig:\n"
+    "    used_knob: int = 1\n"
+    "    dead_knob: int = 2\n")
+
+
+class TestKnobRules:
+    def test_config_knob_unread(self, tmp_path):
+        write(tmp_path, "pkg/mesh.py", _FIXTURE_CONFIG)
+        write(tmp_path, "pkg/engine.py",
+              "def f(config):\n    return config.used_knob\n")
+        docs = write(tmp_path, "docs/API.md",
+                     "used_knob dead_knob\n")
+        proj = make_project(tmp_path, docs_api=docs)
+        r = lint(proj, ["config-knob-unread"])
+        hits = rule_hits(r, "config-knob-unread")
+        assert [f["message"] for f in hits] == \
+            ["TpuConfig.dead_knob is never read by the package"]
+
+    def test_config_knob_undocumented(self, tmp_path):
+        write(tmp_path, "pkg/mesh.py", _FIXTURE_CONFIG)
+        write(tmp_path, "pkg/engine.py",
+              "def f(c):\n    return c.used_knob + c.dead_knob\n")
+        # the match wants the rendered-signature form (`name=` / `name:`)
+        # — prose mentioning "dead_knob settings" must NOT count
+        docs = write(tmp_path, "docs/API.md",
+                     "TpuConfig(used_knob: int = 1)\n"
+                     "prose about dead_knob settings\n")
+        proj = make_project(tmp_path, docs_api=docs)
+        r = lint(proj, ["config-knob-undocumented"])
+        hits = rule_hits(r, "config-knob-undocumented")
+        assert len(hits) == 1 and "dead_knob" in hits[0]["message"]
+
+    def test_env_knob_unregistered(self, tmp_path):
+        write(tmp_path, "pkg/mesh.py", _FIXTURE_CONFIG)
+        write(tmp_path, "pkg/engine.py", (
+            "import os\n"
+            "def f():\n"
+            "    a = os.environ.get('SST_USED_KNOB')\n"
+            "    b = os.environ.get('SST_ROGUE')\n"
+            "    c = os.environ.get('SST_JUSTIFIED')\n"
+            "    return a, b, c\n"))
+        # knob-table rows: exact | `VAR` | cells (prose doesn't count)
+        readme = write(tmp_path, "README.md",
+                       "| `SST_USED_KNOB` | x |\n"
+                       "| `SST_JUSTIFIED` | y |\n")
+        proj = make_project(
+            tmp_path, readme=readme,
+            env_field_exceptions={"SST_JUSTIFIED": "test harness"})
+        r = lint(proj, ["env-knob-unregistered"])
+        syms = {f["message"] for f in
+                rule_hits(r, "env-knob-unregistered")}
+        # SST_ROGUE: no field AND no README row; others clean
+        assert len(syms) == 2
+        assert all("SST_ROGUE" in m for m in syms)
+
+
+# ---------------------------------------------------------------------------
+# jit purity
+# ---------------------------------------------------------------------------
+
+
+class TestPurityRules:
+    def test_impure_sites_flagged(self, tmp_path):
+        write(tmp_path, "pkg/progs.py", (
+            "import time, random\n"
+            "import jax\n"
+            "import numpy as np\n"
+            "CAPTURED = np.zeros(4)\n"
+            "def impure(x):\n"
+            "    t = time.perf_counter()\n"
+            "    r = random.random()\n"
+            "    y = jax.device_put(x)\n"
+            "    CAPTURED[0] = 1.0\n"
+            "    return x + t + r + y\n"
+            "fn = jax.jit(impure)\n"
+            "def pure(x):\n"
+            "    return x * 2\n"
+            "gn = jax.jit(pure)\n"))
+        proj = make_project(tmp_path)
+        rules = ["jit-impure-time", "jit-impure-random",
+                 "jit-unplaned-upload", "jit-host-mutation"]
+        r = lint(proj, rules)
+        got = {f["rule"] for f in r["findings"]}
+        assert got == set(rules)
+        # nothing points at the pure function
+        assert all("impure" in f["message"] for f in r["findings"])
+
+    def test_vmap_wrapped_and_one_hop(self, tmp_path):
+        write(tmp_path, "pkg/progs.py", (
+            "import time\n"
+            "import jax\n"
+            "def helper(x):\n"
+            "    return x + time.time()\n"
+            "def outer(x):\n"
+            "    return helper(x)\n"
+            "fn = jax.jit(jax.vmap(outer))\n"))
+        r = lint(make_project(tmp_path), ["jit-impure-time"])
+        assert rule_hits(r, "jit-impure-time")
+
+
+# ---------------------------------------------------------------------------
+# hygiene + baseline + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestHygieneBaselineCli:
+    def test_gitignore_rule(self, tmp_path):
+        write(tmp_path, "pkg/a.py", "x = 1\n")
+        proj = make_project(tmp_path)
+        r = lint(proj, ["gitignore-bytecode"])
+        assert rule_hits(r, "gitignore-bytecode")
+        write(tmp_path, ".gitignore", "__pycache__/\n*.pyc\n")
+        r2 = lint(proj, ["gitignore-bytecode"])
+        assert not rule_hits(r2, "gitignore-bytecode")
+
+    def test_baseline_roundtrip(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except:\n"
+            "        return None\n"))
+        proj = make_project(tmp_path)
+        bl = tmp_path / "baseline.json"
+        r = run_lint(proj, rules=["bare-except"], baseline_path=bl)
+        assert r["n_findings"] == 1 and r["n_baselined"] == 0
+        save_baseline(bl, r["_finding_objs"], r["_baseline"])
+        entries = load_baseline(bl)
+        assert len(entries) == 1
+        r2 = run_lint(proj, rules=["bare-except"], baseline_path=bl)
+        assert r2["n_findings"] == 0 and r2["n_baselined"] == 1
+        # baselines key on symbols, not line numbers: shifting the
+        # function down must not un-baseline the finding
+        src = (tmp_path / "pkg/a.py").read_text()
+        (tmp_path / "pkg/a.py").write_text("# moved\n\n" + src)
+        r3 = run_lint(proj, rules=["bare-except"], baseline_path=bl)
+        assert r3["n_findings"] == 0 and r3["n_baselined"] == 1
+
+    def test_cli_real_tree_exits_zero(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.sstlint", "--format", "json",
+             "spark_sklearn_tpu/"],
+            capture_output=True, text=True, cwd=str(REPO), timeout=180)
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["n_findings"] == 0
+        assert payload["n_rules"] >= 20
+
+    def test_cli_seeded_violation_exits_nonzero(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except:\n"
+            "        return None\n"))
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.sstlint", "--format", "json",
+             str(tmp_path / "pkg")],
+            capture_output=True, text=True, cwd=str(REPO), timeout=180)
+        assert out.returncode == 1
+        payload = json.loads(out.stdout)
+        assert any(f["rule"] == "bare-except"
+                   for f in payload["findings"])
+
+    def test_real_tree_lints_clean_in_process(self):
+        r = run_lint(root=REPO)
+        assert r["n_findings"] == 0, r["findings"]
+        assert r["n_baselined"] == 0, \
+            "the committed baseline should stay empty"
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order recorder (SST_LOCKCHECK)
+# ---------------------------------------------------------------------------
+
+
+class TestLockcheckRuntime:
+    def _locks(self):
+        from spark_sklearn_tpu.utils.locks import (CheckedLock,
+                                                   LockOrderRecorder)
+        return CheckedLock, LockOrderRecorder
+
+    def test_inversion_detected(self):
+        CheckedLock, LockOrderRecorder = self._locks()
+        rec = LockOrderRecorder()
+        A = CheckedLock(threading.Lock(), "m.A", rec)
+        B = CheckedLock(threading.Lock(), "m.B", rec)
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+        rep = rec.report()
+        assert rep["n_edges"] == 2
+        assert len(rep["inversions"]) == 1
+        assert set(rep["inversions"][0]["locks"]) == {"m.A", "m.B"}
+
+    def test_consistent_order_clean(self):
+        CheckedLock, LockOrderRecorder = self._locks()
+        rec = LockOrderRecorder()
+        A = CheckedLock(threading.Lock(), "m.A", rec)
+        B = CheckedLock(threading.Lock(), "m.B", rec)
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        rep = rec.report()
+        assert rep["edges"] == [("m.A", "m.B")]
+        assert not rep["inversions"]
+
+    def test_rlock_reentry_records_no_self_edge(self):
+        CheckedLock, LockOrderRecorder = self._locks()
+        rec = LockOrderRecorder()
+        R = CheckedLock(threading.RLock(), "m.R", rec)
+        with R:
+            with R:
+                pass
+        rep = rec.report()
+        assert rep["n_edges"] == 0 and not rep["inversions"]
+
+    def test_long_hold_recorded(self, monkeypatch):
+        monkeypatch.setenv("SST_LOCKCHECK_HOLD_S", "0.01")
+        CheckedLock, LockOrderRecorder = self._locks()
+        rec = LockOrderRecorder()
+        A = CheckedLock(threading.Lock(), "m.A", rec)
+        with A:
+            time.sleep(0.05)
+        rep = rec.report()
+        assert rep["long_holds"] and \
+            rep["long_holds"][0]["lock"] == "m.A"
+
+    def test_named_lock_factories_honor_env(self, monkeypatch):
+        from spark_sklearn_tpu.utils import locks
+        monkeypatch.delenv("SST_LOCKCHECK", raising=False)
+        assert not isinstance(locks.named_lock("t.x"),
+                              locks.CheckedLock)
+        monkeypatch.setenv("SST_LOCKCHECK", "1")
+        lk = locks.named_lock("t.x")
+        assert isinstance(lk, locks.CheckedLock)
+        rk = locks.named_rlock("t.y")
+        assert isinstance(rk, locks.CheckedLock)
+
+    def test_engine_search_clean_under_lockcheck(self):
+        """End-to-end: a real compiled search in a subprocess with
+        SST_LOCKCHECK=1 must record zero inversions (and at least the
+        plane->totals edge)."""
+        code = (
+            "import os\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from sklearn.linear_model import LogisticRegression\n"
+            "import spark_sklearn_tpu as sst\n"
+            "from spark_sklearn_tpu.utils import locks\n"
+            "X = np.random.RandomState(0).randn(64, 4)"
+            ".astype(np.float32)\n"
+            "y = (X[:, 0] > 0).astype(np.int64)\n"
+            "cfg = sst.TpuConfig(fault_plan='transient@1,oom@3',\n"
+            "                    retry_backoff_s=0.01)\n"
+            "gs = sst.GridSearchCV(LogisticRegression(max_iter=5),\n"
+            "    {'C': [0.1, 1.0, 10.0]}, cv=2, refit=False,\n"
+            "    backend='tpu', config=cfg).fit(X, y)\n"
+            "rep = locks.get_recorder().report()\n"
+            "assert not rep['inversions'], rep['inversions']\n"
+            "print('EDGES', rep['n_edges'])\n")
+        env = dict(__import__("os").environ,
+                   SST_LOCKCHECK="1", JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, env=env,
+                             cwd=str(REPO), timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "EDGES" in out.stdout

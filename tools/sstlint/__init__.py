@@ -1,0 +1,131 @@
+"""sstlint — project-native static analysis for spark_sklearn_tpu.
+
+Checkers (see ``docs/API.md`` for the full rule catalog, rendered from
+the rule docstrings by ``dev/build_api_docs.py``):
+
+  - **lock order / races**: static acquisition graph over the named
+    locks (cycles, cross-module nesting, shared-state mutation outside
+    the owning lock), paired with the ``SST_LOCKCHECK=1`` runtime
+    recorder in ``spark_sklearn_tpu/utils/locks.py``;
+  - **exception hygiene**: bare/BaseException swallows, silent broad
+    handlers, cause-less re-raises, taxonomy-dropping launch handlers;
+  - **span & schema drift**: span names pinned to ``obs/spans.py``,
+    ``search_report`` keys pinned to the ``*_BLOCK_SCHEMA`` constants,
+    ``docs/API.md`` freshness;
+  - **config-knob audit**: every ``TpuConfig`` field read + documented,
+    every ``SST_*`` env var config-backed + in the README knob table;
+  - **jit purity**: no clocks, host RNG, uploads, or in-place host
+    mutation inside traced functions;
+  - **repo hygiene**: no committed bytecode, ``.gitignore`` coverage.
+
+Usage::
+
+    python -m tools.sstlint [--format json] [path]
+    python -m tools.sstlint --list-rules
+    python -m tools.sstlint --update-baseline
+
+Findings are suppressed inline with ``# sstlint: disable=<rule>`` (on
+the line or a justification comment up to three lines above) or
+grandfathered in ``tools/sstlint/baseline.json`` with a written
+justification.  Exit status: 0 = clean (baselined findings allowed),
+1 = new findings, 2 = internal error.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from tools.sstlint.core import (  # noqa: F401  (public API re-exports)
+    Context, Finding, ModuleInfo, RULES, load_baseline, rule,
+    save_baseline)
+from tools.sstlint.project import Project
+
+# rule modules register themselves on import
+from tools.sstlint import excepts as _excepts          # noqa: F401
+from tools.sstlint import knobs as _knobs              # noqa: F401
+from tools.sstlint import lockorder as _lockorder      # noqa: F401
+from tools.sstlint import purity as _purity            # noqa: F401
+from tools.sstlint import spanrules as _spanrules      # noqa: F401
+
+__all__ = ["Project", "RULES", "catalog_markdown", "collect_modules",
+           "run_lint"]
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+
+def catalog_markdown() -> str:
+    """The rule-catalog table ``dev/build_api_docs.py`` renders into
+    ``docs/API.md`` — defined here, next to the registry, so the
+    ``docs-stale`` rule can hold the docs to the same definitions the
+    gate runs."""
+    out = [
+        "## `tools.sstlint` rule catalog\n",
+        "\nProject-native static analysis (`python -m tools.sstlint`),"
+        " run as a tier-1 gate by `dev/run-tests.sh`.  Rendered from "
+        "the rule registry docstrings.  Suppress inline with "
+        "`# sstlint: disable=<rule>`; grandfather with a justified "
+        "entry in `tools/sstlint/baseline.json`.\n",
+        "\n| rule | rationale |\n|---|---|\n",
+    ]
+    for name in sorted(RULES):
+        out.append(f"| `{name}` | {RULES[name].rationale} |\n")
+    return "".join(out)
+
+
+def collect_modules(project: Project) -> List[ModuleInfo]:
+    mods: List[ModuleInfo] = []
+    for path in sorted(project.package.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel_pkg = str(path.relative_to(project.package)).replace(
+            "\\", "/")
+        if rel_pkg in project.exclude:
+            continue
+        rel_repo = str(path.resolve().relative_to(project.root)
+                       ).replace("\\", "/")
+        try:
+            mods.append(ModuleInfo(path, rel_pkg, path.read_text()))
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            raise SystemExit(
+                f"sstlint: cannot parse {rel_repo}: {exc}") from exc
+    return mods
+
+
+def run_lint(project: Optional[Project] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[Path] = None,
+             root: Optional[Path] = None) -> Dict[str, Any]:
+    """Run the suite; returns the machine-readable result dict the
+    CLI serializes with ``--format json``."""
+    t0 = time.perf_counter()
+    if project is None:
+        project = Project.default(root or Path.cwd())
+    ctx = Context(project, collect_modules(project))
+    selected = list(rules) if rules else sorted(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise SystemExit(f"sstlint: unknown rule(s): {unknown}")
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(RULES[name].fn(ctx) or ())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    bpath = baseline_path if baseline_path is not None else \
+        DEFAULT_BASELINE
+    baseline = load_baseline(bpath)
+    new = [f for f in findings if f.key not in baseline]
+    grandfathered = [f for f in findings if f.key in baseline]
+    return {
+        "n_rules": len(selected),
+        "rules": selected,
+        "n_findings": len(new),
+        "n_baselined": len(grandfathered),
+        "findings": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in grandfathered],
+        "duration_s": round(time.perf_counter() - t0, 3),
+        "_finding_objs": findings,
+        "_baseline": baseline,
+        "_baseline_path": str(bpath),
+    }

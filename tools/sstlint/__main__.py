@@ -1,0 +1,91 @@
+"""CLI: ``python -m tools.sstlint [path]``.
+
+Exit status: 0 = clean (baselined findings allowed), 1 = new
+findings, 2 = internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.sstlint import (DEFAULT_BASELINE, RULES, Project, run_lint,
+                           save_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sstlint",
+        description="project-native static analysis for "
+                    "spark_sklearn_tpu")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="the ONE package dir to lint (default: "
+                         "spark_sklearn_tpu/ next to tools/); the "
+                         "project-level rules key off its repo root")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to grandfather every "
+                         "current finding (justifications carried "
+                         "forward; new entries get TODO markers)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:<28} {RULES[name].rationale}")
+        return 0
+
+    repo_root = Path(__file__).resolve().parents[2]
+    if args.path:
+        pkg = Path(args.path).resolve()
+        if not pkg.is_dir():
+            print(f"sstlint: not a directory: {pkg}", file=sys.stderr)
+            return 2
+        # the package's repo root is its parent (project files like
+        # README/.gitignore/docs live there)
+        project = Project.default(pkg.parent)
+        project.package = pkg
+    else:
+        project = Project.default(repo_root)
+
+    rules = [r.strip() for r in args.rules.split(",")] \
+        if args.rules else None
+    baseline_path = Path(args.baseline) if args.baseline else None
+    result = run_lint(project, rules=rules, baseline_path=baseline_path)
+
+    if args.update_baseline:
+        bpath = Path(result["_baseline_path"])
+        save_baseline(bpath, result["_finding_objs"],
+                      result["_baseline"])
+        print(f"sstlint: wrote {len(result['_finding_objs'])} "
+              f"finding(s) to {bpath}")
+        return 0
+
+    if args.format == "json":
+        clean = {k: v for k, v in result.items()
+                 if not k.startswith("_")}
+        print(json.dumps(clean, indent=2))
+    else:
+        for f in result["findings"]:
+            print(f"{f['path']}:{f['line']}: [{f['rule']}] "
+                  f"{f['message']}")
+        for f in result["baselined"]:
+            print(f"{f['path']}:{f['line']}: [{f['rule']}] (baselined) "
+                  f"{f['message']}")
+        print(f"sstlint: {result['n_rules']} rules, "
+              f"{result['n_findings']} new finding(s), "
+              f"{result['n_baselined']} baselined, "
+              f"{result['duration_s']}s")
+    return 1 if result["n_findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

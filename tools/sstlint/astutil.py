@@ -1,0 +1,243 @@
+"""Shared AST plumbing for the sstlint checkers."""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.sstlint.core import ModuleInfo
+
+__all__ = [
+    "attr_chain",
+    "call_name",
+    "dict_literal_keys_in",
+    "import_aliases",
+    "iter_functions",
+    "literal_str",
+    "mutator_methods",
+    "subscript_store_keys",
+    "with_lock_ids",
+]
+
+#: container methods that mutate their receiver
+MUTATOR_METHODS = frozenset({
+    "add", "append", "clear", "discard", "extend", "insert",
+    "move_to_end", "pop", "popitem", "remove", "setdefault", "update",
+})
+
+
+def mutator_methods() -> frozenset:
+    return MUTATOR_METHODS
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    """The value of a string Constant, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain ("jax.jit",
+    "self._tracer.span"), or None for anything fancier."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return attr_chain(call.func)
+
+
+def iter_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def import_aliases(mod: ModuleInfo, package_name: str) -> Dict[str, str]:
+    """Local name -> project-module relpath, for imports of project
+    modules (``from pkg.parallel import dataplane as _dataplane`` maps
+    ``_dataplane`` to ``parallel/dataplane.py``)."""
+    out: Dict[str, str] = {}
+    prefix = package_name + "."
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(prefix):
+                    rel = a.name[len(prefix):].replace(".", "/") + ".py"
+                    out[a.asname or a.name.split(".")[0]] = rel
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == package_name or \
+                    node.module.startswith(prefix):
+                base = node.module[len(package_name):].lstrip(".")
+                for a in node.names:
+                    cand = (base + "/" if base else "") + a.name
+                    rel = cand.replace(".", "/") + ".py"
+                    out[a.asname or a.name] = rel
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Named-lock discovery and resolution
+# ---------------------------------------------------------------------------
+
+
+class LockTable:
+    """Lock aliases of one module, built from the
+    ``named_lock``/``named_rlock`` factory calls."""
+
+    def __init__(self):
+        #: module-global var name -> lock id
+        self.module: Dict[str, str] = {}
+        #: (class name, attr) -> lock id, for self.<attr>
+        self.cls: Dict[Tuple[str, str], str] = {}
+        #: (enclosing function qualname, var) -> lock id
+        self.local: Dict[Tuple[str, str], str] = {}
+
+    @classmethod
+    def build(cls, mod: ModuleInfo) -> "LockTable":
+        table = cls()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call) and call_name(v) in (
+                    "named_lock", "named_rlock",
+                    "locks.named_lock", "locks.named_rlock",
+                    "_locks.named_lock", "_locks.named_rlock")):
+                continue
+            if not v.args:
+                continue
+            lock_id = literal_str(v.args[0])
+            if lock_id is None:
+                continue
+            fn = mod.enclosing_function(node)
+            klass = mod.enclosing_class(node)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if fn is None:
+                        table.module[tgt.id] = lock_id
+                    else:
+                        table.local[(mod.qualname(fn), tgt.id)] = lock_id
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and klass is not None:
+                    table.cls[(klass.name, tgt.attr)] = lock_id
+        return table
+
+    def resolve(self, mod: ModuleInfo, expr: ast.AST) -> Optional[str]:
+        """Lock id of `expr` (a with-item / receiver), or None."""
+        if isinstance(expr, ast.Name):
+            fn = mod.enclosing_function(expr)
+            qn = mod.qualname(fn) if fn is not None else ""
+            # walk outward through enclosing function scopes
+            while True:
+                hit = self.local.get((qn, expr.id))
+                if hit is not None:
+                    return hit
+                if "." not in qn:
+                    break
+                qn = qn.rsplit(".", 1)[0]
+            return self.module.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            klass = mod.enclosing_class(expr)
+            if klass is not None:
+                return self.cls.get((klass.name, expr.attr))
+        return None
+
+
+def with_lock_ids(mod: ModuleInfo, table: LockTable,
+                  node: ast.AST) -> List[str]:
+    """Lock ids held at `node` by lexically-enclosing ``with``
+    statements (innermost last)."""
+    chain: List[str] = []
+    cur = mod.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            # frame boundary: a `with` outside this def is NOT held
+            # when the def's body eventually runs
+            break
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                lock = table.resolve(mod, item.context_expr)
+                if lock is not None:
+                    chain.append(lock)
+        cur = mod.parents.get(cur)
+    chain.reverse()
+    return chain
+
+
+# ---------------------------------------------------------------------------
+# Report-block key extraction
+# ---------------------------------------------------------------------------
+
+
+def dict_literal_keys_in(mod: ModuleInfo, qualname: str) -> Set[str]:
+    """Every string key of every dict literal (and every literal
+    ``.update({...})`` argument) inside the function `qualname`."""
+    keys: Set[str] = set()
+    for fn in iter_functions(mod.tree):
+        if mod.qualname(fn) != qualname:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    s = literal_str(k) if k is not None else None
+                    if s is not None:
+                        keys.add(s)
+    return keys
+
+
+def subscript_store_keys(mod: ModuleInfo, var: str) -> Set[str]:
+    """Every literal key K stored via ``<var>["K"] = ...`` (or
+    augmented-assigned) anywhere in the module."""
+    keys: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == var:
+                s = literal_str(tgt.slice)
+                if s is not None:
+                    keys.add(s)
+    return keys
+
+
+def load_module_by_path(path: Path, alias: str):
+    """Import an import-light module directly by file path (no package
+    __init__ chain — digesting schemas must never pay the jax
+    import)."""
+    import importlib.util
+    import sys
+
+    cached = sys.modules.get(alias)
+    if cached is not None and getattr(
+            cached, "__file__", None) == str(path):
+        return cached
+    spec = importlib.util.spec_from_file_location(alias, str(path))
+    module = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: dataclass machinery looks itself up in
+    # sys.modules while the module body runs
+    sys.modules[alias] = module
+    try:
+        spec.loader.exec_module(module)
+    except BaseException:
+        sys.modules.pop(alias, None)
+        raise
+    return module

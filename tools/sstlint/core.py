@@ -1,0 +1,245 @@
+"""sstlint core — findings, the rule registry, suppressions, baseline.
+
+A *rule* is a function ``fn(ctx) -> iterable[Finding]`` registered with
+the :func:`rule` decorator; its docstring's first paragraph is the
+rationale rendered into ``docs/API.md`` by ``dev/build_api_docs.py``.
+
+A *finding* identifies itself by a stable ``key`` (rule + file +
+symbol), NOT by line number, so baselines survive unrelated edits.
+Findings can be silenced two ways:
+
+  - a suppression comment ``# sstlint: disable=<rule>[,<rule>...]`` on
+    the flagged line or on one of the three lines above it (so the
+    justification comment block sits naturally above the construct);
+  - a committed baseline file (``tools/sstlint/baseline.json``) of
+    grandfathered keys, each carrying a human justification — the
+    escape hatch for findings that are understood and deliberate.
+    An empty baseline is the goal; ``--update-baseline`` rewrites it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "rule",
+    "ModuleInfo",
+    "Context",
+    "load_baseline",
+    "save_baseline",
+]
+
+
+@dataclasses.dataclass
+class Finding:
+    """One reported violation."""
+
+    rule: str
+    path: str              # repo-relative, forward slashes
+    line: int
+    message: str
+    #: stable identity token within (rule, path): the lock name, span
+    #: name, config field, function qualname... — line numbers are NOT
+    #: part of a finding's identity
+    symbol: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.symbol or self.message}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """A registered checker."""
+
+    name: str
+    fn: Callable[["Context"], Iterable[Finding]]
+    rationale: str
+
+
+#: the registry `python -m tools.sstlint --list-rules` and the docs
+#: build render; populated by the @rule decorator at import time.
+RULES: "Dict[str, Rule]" = {}
+
+
+def rule(name: str):
+    """Register a checker under `name` (kebab-case).  The decorated
+    function's docstring first paragraph becomes the rule's documented
+    rationale."""
+
+    def deco(fn):
+        doc = (fn.__doc__ or "").strip()
+        rationale = re.split(r"\n\s*\n", doc)[0].replace("\n", " ")
+        rationale = re.sub(r"\s+", " ", rationale)
+        if name in RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        RULES[name] = Rule(name, fn, rationale)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Parsed-module model
+# ---------------------------------------------------------------------------
+
+
+_SUPPRESS_RE = re.compile(r"#\s*sstlint:\s*disable=([\w\-, ]+)")
+
+
+class ModuleInfo:
+    """One parsed source file plus lint-relevant derived data."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        #: short module name ("dataplane" for .../parallel/dataplane.py)
+        self.short = Path(relpath).stem
+        self._suppressions: Optional[Dict[int, set]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # -- suppression comments -------------------------------------------
+    @property
+    def suppressions(self) -> Dict[int, set]:
+        """lineno -> set of rule names disabled on that line (from
+        ``# sstlint: disable=...`` comments, found via tokenize so
+        string literals can never fake one)."""
+        if self._suppressions is None:
+            sup: Dict[int, set] = {}
+            try:
+                tokens = tokenize.generate_tokens(
+                    iter(self.source.splitlines(True)).__next__)
+                for tok in tokens:
+                    if tok.type == tokenize.COMMENT:
+                        m = _SUPPRESS_RE.search(tok.string)
+                        if m:
+                            rules = {r.strip() for r in
+                                     m.group(1).split(",") if r.strip()}
+                            sup.setdefault(tok.start[0], set()).update(
+                                rules)
+            except tokenize.TokenError:
+                pass
+            self._suppressions = sup
+        return self._suppressions
+
+    def suppressed(self, rule_name: str, line: int) -> bool:
+        """Is `rule_name` disabled at `line`?  The comment may sit on
+        the line itself or up to three lines above (a justification
+        block)."""
+        for ln in range(max(1, line - 3), line + 1):
+            if rule_name in self.suppressions.get(ln, ()):
+                return True
+        return False
+
+    # -- AST helpers -----------------------------------------------------
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            p: Dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+            self._parents = p
+        return self._parents
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted def/class path enclosing `node` (inclusive)."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+class Context:
+    """Everything a rule sees: the project map, parsed modules, and
+    the target paths."""
+
+    def __init__(self, project, modules: List[ModuleInfo]):
+        self.project = project
+        self.modules = modules
+        self.by_relpath = {m.relpath: m for m in modules}
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        return self.by_relpath.get(relpath)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> Dict[str, Dict[str, Any]]:
+    """key -> entry (with its justification).  Missing file = empty."""
+    if not path or not Path(path).is_file():
+        return {}
+    data = json.loads(Path(path).read_text())
+    out = {}
+    for entry in data.get("findings", []):
+        out[entry["key"]] = entry
+    return out
+
+
+def save_baseline(path: Path, findings: List[Finding],
+                  old: Optional[Dict[str, Dict[str, Any]]] = None) -> None:
+    """Write the baseline for `findings`, carrying forward any existing
+    justifications and defaulting new entries to TODO markers that a
+    reviewer is expected to replace."""
+    old = old or {}
+    entries = []
+    for f in sorted(findings, key=lambda f: f.key):
+        prev = old.get(f.key, {})
+        entries.append({
+            "key": f.key,
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "justification": prev.get(
+                "justification", "TODO: justify or fix"),
+        })
+    payload = {
+        "comment": (
+            "Grandfathered sstlint findings.  Every entry needs a "
+            "justification; an empty list is the goal.  Regenerate "
+            "with: python -m tools.sstlint --update-baseline"),
+        "findings": entries,
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")

@@ -1,0 +1,232 @@
+"""Exception-hygiene checkers.
+
+PR 3's review caught a ``_dispatch`` guard that would have traded a
+``KeyboardInterrupt`` for a silent whole-grid host re-run; these rules
+make that class of bug mechanical:
+
+  - handlers broad enough to catch ``KeyboardInterrupt``/``SystemExit``
+    (bare ``except:`` / ``except BaseException``) must re-raise;
+  - broad handlers must not swallow silently (no re-raise, no use of
+    the exception, no logging) — a fallback is fine, an invisible one
+    is not;
+  - a new exception raised inside a handler must chain its cause
+    (``raise X(...) from exc``) so ``LaunchTimeoutError``-style
+    failures keep the original context;
+  - on the launch path, broad handlers must stay taxonomy-aware
+    (``classify_error``/``is_oom``/the supervisor's recovery funnel) —
+    dropping the taxonomy turns a recoverable OOM into a dead search.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.sstlint import astutil
+from tools.sstlint.core import Context, Finding, ModuleInfo, rule
+
+#: calls that make a broad handler "visible" (the failure is recorded
+#: somewhere a human or the fault journal can see)
+_VISIBILITY_CALLS = frozenset({
+    "warn", "warning", "debug", "info", "error", "exception", "print",
+})
+
+#: calls that make a launch-path handler taxonomy-aware
+_TAXONOMY_CALLS = frozenset({
+    "classify_error", "is_oom", "_recover", "_recover_oom",
+    "_retry_gate", "register_classifier",
+})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """Catches Exception or wider?"""
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [astutil.attr_chain(e) or "" for e in t.elts]
+    else:
+        names = [astutil.attr_chain(t) or ""]
+    return any(n.split(".")[-1] in ("Exception", "BaseException")
+               for n in names)
+
+
+def _catches_base(handler: ast.ExceptHandler) -> bool:
+    """Catches KeyboardInterrupt/SystemExit (bare or BaseException)?"""
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [astutil.attr_chain(e) or "" for e in t.elts]
+    else:
+        names = [astutil.attr_chain(t) or ""]
+    return any(n.split(".")[-1] == "BaseException" for n in names)
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _uses_exc(handler: ast.ExceptHandler) -> bool:
+    if handler.name is None:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == handler.name
+               for stmt in handler.body for n in ast.walk(stmt))
+
+
+def _calls_any(node: ast.AST, names: frozenset) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            chain = astutil.call_name(n)
+            if chain and chain.split(".")[-1] in names:
+                return True
+    return False
+
+
+def _handlers(mod: ModuleInfo):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Try):
+            for h in node.handlers:
+                yield h
+
+
+@rule("bare-except")
+def check_bare_except(ctx: Context) -> Iterable[Finding]:
+    """``except:`` catches ``KeyboardInterrupt`` and ``SystemExit`` —
+    an interactive abort or interpreter shutdown silently becomes
+    whatever the handler does.  Name the exceptions, or catch
+    ``Exception``."""
+    for mod in ctx.modules:
+        for h in _handlers(mod):
+            if h.type is not None:
+                continue
+            if mod.suppressed("bare-except", h.lineno):
+                continue
+            yield Finding(
+                "bare-except", mod.relpath, h.lineno,
+                "bare `except:` swallows KeyboardInterrupt/SystemExit; "
+                "catch Exception (or narrower)",
+                symbol=f"{mod.qualname(h) or '<module>'}")
+
+
+@rule("broad-except-swallow")
+def check_broad_swallow(ctx: Context) -> Iterable[Finding]:
+    """``except BaseException`` (or bare) without a re-raise swallows
+    ``KeyboardInterrupt``/``SystemExit`` — the PR-3 ``_dispatch`` bug
+    class.  Handlers that wide must re-raise (directly, or by
+    marshalling the exception to a caller that does)."""
+    for mod in ctx.modules:
+        for h in _handlers(mod):
+            if not _catches_base(h):
+                continue
+            if _has_raise(h):
+                continue
+            if mod.suppressed("broad-except-swallow", h.lineno):
+                continue
+            yield Finding(
+                "broad-except-swallow", mod.relpath, h.lineno,
+                "handler catches BaseException but never re-raises — "
+                "KeyboardInterrupt/SystemExit die here",
+                symbol=f"{mod.qualname(h) or '<module>'}")
+
+
+@rule("swallowed-exception")
+def check_swallowed(ctx: Context) -> Iterable[Finding]:
+    """A broad ``except Exception`` handler that neither re-raises,
+    nor uses the caught exception, nor logs/warns, makes failures
+    invisible — fallbacks are fine, silent ones hide real bugs (and
+    can eat a ``LaunchTimeoutError`` meant to fail the search)."""
+    for mod in ctx.modules:
+        for h in _handlers(mod):
+            if not _is_broad(h):
+                continue
+            if _has_raise(h) or _uses_exc(h):
+                continue
+            if _calls_any(h, _VISIBILITY_CALLS):
+                continue
+            if mod.suppressed("swallowed-exception", h.lineno):
+                continue
+            yield Finding(
+                "swallowed-exception", mod.relpath, h.lineno,
+                "broad handler swallows the exception with no re-raise,"
+                " no use, and no log/warn — make the failure visible "
+                "or narrow the except",
+                symbol=f"{mod.qualname(h) or '<module>'}")
+
+
+@rule("raise-without-cause")
+def check_raise_cause(ctx: Context) -> Iterable[Finding]:
+    """Raising a NEW exception inside an ``except E as exc`` handler
+    without ``from exc`` discards the original traceback — recovery
+    errors (LaunchTimeoutError, GeometryMismatchError) must keep the
+    failure they translate."""
+    for mod in ctx.modules:
+        for h in _handlers(mod):
+            if h.name is None:
+                continue
+            for node in ast.walk(h):
+                if not isinstance(node, ast.Raise):
+                    continue
+                if node.exc is None:          # bare re-raise
+                    continue
+                if isinstance(node.exc, ast.Name):   # raise exc
+                    continue
+                if node.cause is not None:
+                    continue
+                # `raise X(...)` with no cause — unless X is the bound
+                # exception passed through a call like raise exc.with_…
+                if mod.suppressed("raise-without-cause", node.lineno):
+                    continue
+                yield Finding(
+                    "raise-without-cause", mod.relpath, node.lineno,
+                    "new exception raised in a handler without "
+                    "`from " + h.name + "` — the original cause is "
+                    "lost",
+                    symbol=f"{mod.qualname(node) or '<module>'}")
+
+
+@rule("launch-except-taxonomy")
+def check_launch_taxonomy(ctx: Context) -> Iterable[Finding]:
+    """On the launch path (the fault supervisor, the chunk pipeline,
+    and grid.py's launch closures) a broad handler must re-raise or
+    stay taxonomy-aware (``classify_error``/``is_oom``/the recovery
+    funnel) — handling a device error without classifying it turns a
+    retryable TRANSIENT or a bisectable OOM into a dead search."""
+    scoped_mods = set()
+    scoped_fns = {}
+    for entry in ctx.project.launch_paths:
+        if "::" in entry:
+            rel, fn = entry.split("::", 1)
+            scoped_fns.setdefault(rel, set()).add(fn)
+        else:
+            scoped_mods.add(entry)
+    for mod in ctx.modules:
+        whole = mod.relpath in scoped_mods
+        fns = scoped_fns.get(mod.relpath, set())
+        if not whole and not fns:
+            continue
+        for h in _handlers(mod):
+            if not _is_broad(h):
+                continue
+            encl = mod.enclosing_function(h)
+            if not whole:
+                if encl is None or not (
+                        {encl.name} | set(
+                            mod.qualname(encl).split("."))) & fns:
+                    continue
+            if _has_raise(h):
+                continue
+            if _calls_any(h, _TAXONOMY_CALLS):
+                continue
+            if encl is not None and _calls_any(encl, _TAXONOMY_CALLS):
+                continue     # the enclosing loop/function classifies
+            if mod.suppressed("launch-except-taxonomy", h.lineno):
+                continue
+            yield Finding(
+                "launch-except-taxonomy", mod.relpath, h.lineno,
+                "broad handler on the launch path neither re-raises "
+                "nor consults the fault taxonomy (classify_error / "
+                "is_oom / supervisor recovery)",
+                symbol=f"{mod.qualname(h) or '<module>'}")

@@ -1,0 +1,220 @@
+"""Config-knob registry audit.
+
+``TpuConfig`` is the engine's whole configuration surface and the
+``SST_*`` env vars are its process-wide spellings.  These rules keep
+the three views consistent: every field is actually read by the code,
+every field is documented, and every env knob has a config-field twin
+(or a justified exception in the project map) plus a row in the README
+knob table.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.sstlint import astutil
+from tools.sstlint.core import Context, Finding, ModuleInfo, rule
+
+
+def _find_config_class(ctx: Context) -> Optional[Tuple[ModuleInfo,
+                                                       ast.ClassDef]]:
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name == "TpuConfig":
+                return mod, node
+    return None
+
+
+def _config_fields(cls: ast.ClassDef) -> Dict[str, int]:
+    """field name -> lineno, from the dataclass's annotated
+    assignments."""
+    out: Dict[str, int] = {}
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            out[node.target.id] = node.lineno
+    return out
+
+
+def _attribute_reads(ctx: Context) -> Set[str]:
+    """Every attribute name read (``x.field``) plus every literal
+    passed to getattr() anywhere in the target tree.  Field
+    DEFINITIONS are AnnAssign Name targets, never Attribute loads, so
+    the config class needs no special casing — its own methods reading
+    ``self.field`` are legitimate reads."""
+    reads: Set[str] = set()
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                reads.add(node.attr)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in ("getattr", "hasattr") and \
+                    len(node.args) >= 2:
+                s = astutil.literal_str(node.args[1])
+                if s is not None:
+                    reads.add(s)
+    return reads
+
+
+@rule("config-knob-unread")
+def check_fields_read(ctx: Context) -> Iterable[Finding]:
+    """Every ``TpuConfig`` field must be read somewhere in the package
+    — a field nothing consumes is a knob users can set with zero
+    effect, the most confusing kind of API surface."""
+    hit = _find_config_class(ctx)
+    if hit is None:
+        return
+    mod, cls = hit
+    reads = _attribute_reads(ctx)
+    for field, line in _config_fields(cls).items():
+        if field in reads:
+            continue
+        if mod.suppressed("config-knob-unread", line):
+            continue
+        yield Finding(
+            "config-knob-unread", mod.relpath, line,
+            f"TpuConfig.{field} is never read by the package",
+            symbol=field)
+
+
+@rule("config-knob-undocumented")
+def check_fields_documented(ctx: Context) -> Iterable[Finding]:
+    """Every ``TpuConfig`` field must appear in ``docs/API.md`` — the
+    generated reference renders the constructor signature, so a
+    missing name means the docs were not regenerated after the config
+    surface changed."""
+    hit = _find_config_class(ctx)
+    if hit is None:
+        return
+    docs = ctx.project.docs_api
+    if not docs or not docs.is_file():
+        return          # docs-stale already reports the missing file
+    text = docs.read_text()
+    mod, cls = hit
+    for field, line in _config_fields(cls).items():
+        # word-boundary match: a common-word field name (`trace`,
+        # `verbose`) must not pass on incidental prose, and prose must
+        # not mask a removed signature entry
+        if re.search(rf"\b{re.escape(field)}\b[=:]", text):
+            continue
+        if mod.suppressed("config-knob-undocumented", line):
+            continue
+        yield Finding(
+            "config-knob-undocumented", mod.relpath, line,
+            f"TpuConfig.{field} does not appear in docs/API.md; "
+            "regenerate with `python dev/build_api_docs.py`",
+            symbol=field)
+
+
+def _env_reads(ctx: Context) -> Dict[str, Tuple[str, int]]:
+    """env var name -> (relpath, line) of first read, for vars with
+    the project's prefix."""
+    prefix = ctx.project.env_prefix
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("get", "getenv") and node.args:
+                chain = astutil.attr_chain(node.func.value) or ""
+                if chain.endswith("environ") or chain == "os":
+                    name = astutil.literal_str(node.args[0])
+            elif isinstance(node, ast.Subscript):
+                chain = astutil.attr_chain(node.value) or ""
+                if chain.endswith("environ"):
+                    name = astutil.literal_str(node.slice)
+            if name and name.startswith(prefix):
+                out.setdefault(name, (mod.relpath, node.lineno))
+    return out
+
+
+@rule("env-knob-unregistered")
+def check_env_knobs(ctx: Context) -> Iterable[Finding]:
+    """Every ``SST_*`` env var the package reads must have a matching
+    ``TpuConfig`` field (derived name: ``SST_FAULT_PLAN`` ->
+    ``fault_plan``) or a justified exception in the project map, and a
+    row in the README knob table — env-only switches that bypass the
+    config system are how behavior becomes untestable and
+    undocumented."""
+    hit = _find_config_class(ctx)
+    fields = _config_fields(hit[1]) if hit else {}
+    readme_text = ""
+    if ctx.project.readme and ctx.project.readme.is_file():
+        readme_text = ctx.project.readme.read_text()
+    exceptions = ctx.project.env_field_exceptions
+    prefix = ctx.project.env_prefix
+    for var, (rel, line) in sorted(_env_reads(ctx).items()):
+        mod = ctx.module(rel)
+        if mod is not None and mod.suppressed(
+                "env-knob-unregistered", line):
+            continue
+        derived = var[len(prefix):].lower()
+        if derived not in fields and var not in exceptions:
+            yield Finding(
+                "env-knob-unregistered", rel, line,
+                f"env var {var} has no matching TpuConfig field "
+                f"({derived!r}) and no justified exception in the "
+                "project map",
+                symbol=f"{var}:field")
+        if readme_text and not re.search(
+                rf"\|\s*`{re.escape(var)}`", readme_text):
+            # exact `VAR` table-row match: prose mentions and prefix
+            # overlaps (SST_LOCKCHECK_HOLD_S vs SST_LOCKCHECK) don't
+            # satisfy the knob-table contract
+            yield Finding(
+                "env-knob-unregistered", rel, line,
+                f"env var {var} is missing from the README knob table",
+                symbol=f"{var}:readme")
+
+
+# ---------------------------------------------------------------------------
+# Repo hygiene
+# ---------------------------------------------------------------------------
+
+
+@rule("tracked-bytecode")
+def check_tracked_bytecode(ctx: Context) -> Iterable[Finding]:
+    """Compiled bytecode (``__pycache__``/``*.pyc``) must never be
+    committed — it bloats diffs, leaks machine paths, and goes stale
+    the moment the source changes."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(ctx.project.root), "ls-files"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return
+    if out.returncode != 0:
+        return
+    for path in out.stdout.splitlines():
+        if "__pycache__" in path or path.endswith(".pyc"):
+            yield Finding(
+                "tracked-bytecode", path, 1,
+                "compiled bytecode is committed; `git rm -r --cached` "
+                "it (the .gitignore rules keep it out)",
+                symbol=path)
+
+
+@rule("gitignore-bytecode")
+def check_gitignore(ctx: Context) -> Iterable[Finding]:
+    """``.gitignore`` must cover ``__pycache__/`` and ``*.pyc`` so
+    bytecode cannot re-enter the tree."""
+    gi = ctx.project.root / ".gitignore"
+    if not gi.is_file():
+        yield Finding("gitignore-bytecode", ".gitignore", 1,
+                      ".gitignore is missing", symbol="missing")
+        return
+    lines = {ln.strip() for ln in gi.read_text().splitlines()}
+    for pat in ("__pycache__/", "*.pyc"):
+        if pat not in lines:
+            yield Finding(
+                "gitignore-bytecode", ".gitignore", 1,
+                f".gitignore lacks the {pat!r} pattern",
+                symbol=pat)

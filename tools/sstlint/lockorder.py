@@ -1,0 +1,369 @@
+"""Lock-order / shared-state checkers.
+
+The engine's threads (main dispatcher, sst-stage, sst-gather,
+sst-compile, watchdog, recovery) contend on a small set of NAMED locks
+created through ``spark_sklearn_tpu.utils.locks``.  These rules build
+the static acquisition graph over those names and enforce the three
+invariants the PR-review cycles kept re-checking by hand:
+
+  1. the graph is acyclic (a cycle is the deadlock precondition);
+  2. no lock is taken while holding another module's lock, unless the
+     pair is explicitly allowed in the project map (cross-module
+     nesting is how unrelated subsystems accidentally couple);
+  3. every registered shared container (dataplane byte totals, plane
+     LRU state, supervisor fault counters, stage bookkeeping sets,
+     geometry caches, logger cache) is only mutated under its owning
+     lock.
+
+The companion RUNTIME recorder (``SST_LOCKCHECK=1``,
+``spark_sklearn_tpu/utils/locks.py``) checks the same order property
+against actual executions during the test suite.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.sstlint import astutil
+from tools.sstlint.astutil import LockTable
+from tools.sstlint.core import Context, Finding, ModuleInfo, rule
+
+_PKG_FALLBACK = "spark_sklearn_tpu"
+
+
+def _package_name(ctx: Context) -> str:
+    return ctx.project.package.name or _PKG_FALLBACK
+
+
+class _Graph:
+    """Static acquisition graph over lock ids."""
+
+    def __init__(self):
+        #: (held, acquired) -> (relpath, line, how)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add(self, held: str, acquired: str, relpath: str, line: int,
+            how: str) -> None:
+        if held == acquired:      # reentrant RLock use: no self-edges
+            return
+        self.edges.setdefault((held, acquired), (relpath, line, how))
+
+    def cycles(self) -> List[List[str]]:
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        out, state = [], {}
+
+        def dfs(n, path):
+            state[n] = 1
+            for m in adj.get(n, ()):
+                if state.get(m) == 1:
+                    out.append(path[path.index(m):] + [m])
+                elif state.get(m) is None:
+                    dfs(m, path + [m])
+            state[n] = 2
+
+        for n in sorted(adj):
+            if state.get(n) is None:
+                dfs(n, [n])
+        return out
+
+
+def _walk_same_frame(root: ast.AST):
+    """Yield nodes under `root` WITHOUT descending into nested
+    function/lambda bodies — a callback defined under a lock runs in
+    whatever frame later invokes it, so its acquisitions must not be
+    attributed to this lock hold."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _build(ctx: Context):
+    """(per-module LockTable, acquisition graph, per-function acquire
+    sets) for the whole target tree — memoized on the Context, since
+    three rules share the same derived data."""
+    cached = getattr(ctx, "_lockorder_build", None)
+    if cached is not None:
+        return cached
+    pkg = _package_name(ctx)
+    tables: Dict[str, LockTable] = {}
+    acquires: Dict[Tuple[str, str], Set[str]] = {}
+    for mod in ctx.modules:
+        tables[mod.relpath] = LockTable.build(mod)
+    # pass 1: what each function acquires directly
+    for mod in ctx.modules:
+        table = tables[mod.relpath]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            fn = mod.enclosing_function(node)
+            qn = mod.qualname(fn) if fn is not None else ""
+            for item in node.items:
+                lock = table.resolve(mod, item.context_expr)
+                if lock is not None:
+                    acquires.setdefault((mod.relpath, qn), set()).add(lock)
+    # flatten to lookup keys callees can be resolved against
+    by_name: Dict[Tuple[str, str], Set[str]] = {}
+    for (relpath, qn), locks in acquires.items():
+        by_name[(relpath, qn)] = locks
+        # also index by trailing name so `self.m()` / `mod.f()` resolve
+        tail = qn.rsplit(".", 1)[-1] if qn else qn
+        by_name.setdefault((relpath, "~" + tail), set()).update(locks)
+
+    graph = _Graph()
+    known_rels = {m.relpath for m in ctx.modules}
+    for mod in ctx.modules:
+        table = tables[mod.relpath]
+        aliases = astutil.import_aliases(mod, pkg)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            held = [table.resolve(mod, i.context_expr)
+                    for i in node.items]
+            held = [h for h in held if h is not None]
+            if not held:
+                continue
+            for inner in _walk_same_frame(node):
+                # direct nested acquisition
+                if isinstance(inner, ast.With):
+                    for item in inner.items:
+                        lock = table.resolve(mod, item.context_expr)
+                        if lock is not None:
+                            for h in held:
+                                graph.add(h, lock, mod.relpath,
+                                          inner.lineno, "with")
+                # one-hop call-through to a project function
+                elif isinstance(inner, ast.Call):
+                    chain = astutil.call_name(inner)
+                    if not chain:
+                        continue
+                    for lock in _callee_locks(chain, mod, aliases,
+                                              by_name, known_rels):
+                        for h in held:
+                            graph.add(h, lock, mod.relpath,
+                                      inner.lineno, f"call {chain}")
+    ctx._lockorder_build = (tables, graph)
+    return ctx._lockorder_build
+
+
+def _callee_locks(chain: str, mod: ModuleInfo, aliases: Dict[str, str],
+                  by_name: Dict[Tuple[str, str], Set[str]],
+                  known_rels: Set[str]) -> Set[str]:
+    parts = chain.split(".")
+    if len(parts) == 1:
+        hits = by_name.get((mod.relpath, "~" + parts[0]), set())
+        if hits:
+            return hits
+        # `from pkg.mod import func`: the alias maps func to the
+        # non-existent "mod/func.py" — re-split into (mod.py, func)
+        rel = aliases.get(parts[0])
+        if rel and rel not in known_rels and "/" in rel:
+            base, leaf = rel.rsplit("/", 1)
+            return by_name.get((base + ".py", "~" + leaf[:-3]), set())
+        return set()
+    if parts[0] == "self" and len(parts) == 2:
+        return by_name.get((mod.relpath, "~" + parts[1]), set())
+    rel = aliases.get(parts[0])
+    if rel is not None and len(parts) == 2:
+        return by_name.get((rel, "~" + parts[1]), set())
+    return set()
+
+
+@rule("lock-order-cycle")
+def check_lock_order(ctx: Context) -> Iterable[Finding]:
+    """The static lock-acquisition graph over the engine's named locks
+    must be acyclic — a cycle means two threads can each hold the lock
+    the other needs, the deadlock precondition.
+
+    Edges come from lexically nested ``with`` acquisitions plus a
+    one-hop call-through to project functions that acquire locks."""
+    graph = _build(ctx)[1]
+    for cyc in graph.cycles():
+        first_edge = (cyc[0], cyc[1]) if len(cyc) > 1 else None
+        rel, line = "", 1
+        if first_edge and first_edge in graph.edges:
+            rel, line, _ = graph.edges[first_edge]
+        m = ctx.module(rel) if rel else None
+        if m is not None and m.suppressed("lock-order-cycle", line):
+            continue
+        yield Finding(
+            "lock-order-cycle", rel or "<graph>", line,
+            "lock acquisition cycle: " + " -> ".join(cyc),
+            symbol="->".join(sorted(set(cyc))))
+
+
+@rule("cross-module-lock")
+def check_cross_module(ctx: Context) -> Iterable[Finding]:
+    """A lock must not be acquired while holding a DIFFERENT module's
+    lock unless the pair is explicitly allowed in the project map —
+    cross-module nesting silently couples subsystems into one ordering
+    domain and is how independent changes start deadlocking."""
+    graph = _build(ctx)[1]
+    allowed = set(ctx.project.allowed_cross_module)
+    for (a, b), (rel, line, how) in sorted(graph.edges.items()):
+        mod_a, mod_b = a.split(".", 1)[0], b.split(".", 1)[0]
+        if mod_a == mod_b:
+            continue
+        if (mod_a, mod_b) in allowed or (a, b) in allowed:
+            continue
+        m = ctx.module(rel)
+        if m is not None and m.suppressed("cross-module-lock", line):
+            continue
+        yield Finding(
+            "cross-module-lock", rel, line,
+            f"{b} acquired while holding {a} (via {how}); allow the "
+            "pair in the project map or restructure",
+            symbol=f"{a}->{b}")
+
+
+def _is_mutation_call(node: ast.Call) -> bool:
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr in astutil.MUTATOR_METHODS
+
+
+def _expr_mentions(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+@rule("unlocked-shared-mutation")
+def check_shared_state(ctx: Context) -> Iterable[Finding]:
+    """Every registered shared container (the data plane's byte totals
+    and LRU state, the supervisor's fault counters, the stage
+    bookkeeping sets, the geometry caches, the logger cache) must only
+    be mutated under its owning lock — unlocked read-modify-write on
+    these is exactly the double-upload / lost-count class of race the
+    PR-4 review caught by hand.
+
+    ``__init__`` bodies and module-level initialization are exempt
+    (the object is not shared yet)."""
+    tables = _build(ctx)[0]
+    for spec in ctx.project.shared_state:
+        mod = ctx.module(spec.relpath)
+        if mod is None:
+            continue
+        table = tables[mod.relpath]
+        for node, desc in _mutations(mod, spec):
+            fn = mod.enclosing_function(node)
+            if fn is None:
+                continue                      # module-level init
+            if fn.name == "__init__":
+                continue
+            held = astutil.with_lock_ids(mod, table, node)
+            if spec.lock in held:
+                continue
+            line = getattr(node, "lineno", 1)
+            if mod.suppressed("unlocked-shared-mutation", line):
+                continue
+            yield Finding(
+                "unlocked-shared-mutation", mod.relpath, line,
+                f"{desc} mutated outside its owning lock {spec.lock}",
+                symbol=f"{desc}@{mod.qualname(fn) or '<module>'}")
+
+
+def _mutations(mod: ModuleInfo, spec):
+    """(node, description) pairs mutating the spec's container."""
+
+    def base_is_guarded(n: ast.AST) -> bool:
+        if spec.name and isinstance(n, ast.Name) and n.id == spec.name:
+            return True
+        if spec.attrs and isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id == "self" \
+                and n.attr in spec.attrs:
+            klass = mod.enclosing_class(n)
+            return klass is not None and klass.name == spec.cls
+        return False
+
+    # light per-function taint: names assigned from guarded expressions
+    tainted: Dict[Tuple[str, str], bool] = {}
+    if spec.taint_key or spec.name or spec.attrs:
+        for fn in astutil.iter_functions(mod.tree):
+            qn = mod.qualname(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                src_guarded = _expr_mentions(node.value, base_is_guarded)
+                if spec.taint_key and _expr_mentions(
+                        node.value,
+                        lambda n: astutil.literal_str(n)
+                        == spec.taint_key):
+                    src_guarded = True
+                if not src_guarded:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted[(qn, tgt.id)] = True
+
+    def is_guarded(n: ast.AST) -> bool:
+        if base_is_guarded(n):
+            return True
+        if isinstance(n, ast.Name):
+            fn = mod.enclosing_function(n)
+            if fn is not None and tainted.get(
+                    (mod.qualname(fn), n.id)):
+                return True
+        if spec.taint_key and isinstance(n, ast.Subscript) and \
+                astutil.literal_str(n.slice) == spec.taint_key:
+            return True
+        return False
+
+    def describe(n: ast.AST) -> str:
+        return astutil.attr_chain(n) or spec.name or spec.taint_key \
+            or "<shared>"
+
+    for node in ast.walk(mod.tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            base = tgt
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if is_guarded(base):
+                if isinstance(tgt, ast.Name):
+                    # rebinding a NAME is only a shared mutation for
+                    # the registered global itself (e.g. `_PLANE =
+                    # DataPlane()`), and only inside a function —
+                    # module-level init is the definition, and
+                    # rebinding a TAINTED local is just a new local
+                    # binding, not a container write
+                    if not (spec.name and tgt.id == spec.name):
+                        continue
+                    if mod.enclosing_function(node) is None:
+                        continue
+                yield node, describe(base)
+        if isinstance(node, ast.Call) and _is_mutation_call(node):
+            recv = node.func.value
+            if is_guarded(recv):
+                yield node, describe(recv)
+
+
+@rule("unnamed-lock")
+def check_unnamed_locks(ctx: Context) -> Iterable[Finding]:
+    """Package code must create locks through the
+    ``utils.locks.named_lock``/``named_rlock`` factories, never raw
+    ``threading.Lock()``/``RLock()`` — unnamed locks are invisible to
+    both the static acquisition graph and the SST_LOCKCHECK runtime
+    recorder, so their ordering bugs go unchecked."""
+    for mod in ctx.modules:
+        if mod.relpath.endswith("utils/locks.py"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and astutil.call_name(node) in (
+                    "threading.Lock", "threading.RLock"):
+                if mod.suppressed("unnamed-lock", node.lineno):
+                    continue
+                yield Finding(
+                    "unnamed-lock", mod.relpath, node.lineno,
+                    "raw threading lock; use utils.locks.named_lock / "
+                    "named_rlock so sstlint and SST_LOCKCHECK can see "
+                    "it",
+                    symbol=f"{astutil.call_name(node)}"
+                           f"@{mod.qualname(node) or '<module>'}")

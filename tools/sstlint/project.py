@@ -1,0 +1,186 @@
+"""The project model — what sstlint knows about THIS codebase.
+
+sstlint is project-native by design: instead of generic heuristics it
+carries an explicit map of the engine's concurrency and interface
+contracts — which named locks exist (discovered from the
+``named_lock``/``named_rlock`` factory calls in the source), which
+shared containers each lock owns, which ``search_report`` blocks are
+produced where, and which env knobs are deliberately config-field-less.
+Tests point a :class:`Project` at fixture trees with their own maps;
+the CLI uses :meth:`Project.default` for the real repository layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["BlockSpec", "Producer", "Project", "SharedState"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SharedState:
+    """A container mutated by more than one thread, and the lock that
+    owns it.  ``name`` is a module-global variable; ``cls``/``attrs``
+    cover instance attributes of a class; ``taint_key`` additionally
+    guards local variables derived from a subscript/``setdefault`` of
+    that literal key (e.g. the staged-chunk id set living inside a
+    plan dict)."""
+
+    relpath: str
+    lock: str
+    name: str = ""
+    cls: str = ""
+    attrs: Tuple[str, ...] = ()
+    taint_key: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Producer:
+    """One place a report block's keys are written.
+
+    ``kind``:
+      - "dict-keys": every string key of every dict literal inside the
+        function ``qualname`` of ``relpath``;
+      - "subscript-var": every literal key stored via
+        ``<var>["key"] = ...`` anywhere in ``relpath``.
+    """
+
+    kind: str
+    relpath: str
+    target: str            # qualname (dict-keys) or var name (subscript-var)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSpec:
+    """One pinned ``search_report`` sub-block: the schema constant in
+    the metrics module vs. the producers that render it."""
+
+    block: str             # report key ("pipeline", "dataplane", ...)
+    schema_attr: str       # constant name in the metrics module
+    producers: Tuple[Producer, ...]
+
+
+@dataclasses.dataclass
+class Project:
+    """Paths + contract map for one lintable tree."""
+
+    root: Path                          # repo root
+    package: Path                       # package dir to lint
+    readme: Optional[Path] = None
+    docs_api: Optional[Path] = None
+    metrics_path: Optional[Path] = None   # obs/metrics.py (import-light)
+    spans_path: Optional[Path] = None     # obs/spans.py (import-light)
+    #: (lock-prefix, lock-prefix) pairs allowed to nest across modules
+    allowed_cross_module: Tuple[Tuple[str, str], ...] = ()
+    shared_state: Tuple[SharedState, ...] = ()
+    blocks: Tuple[BlockSpec, ...] = ()
+    #: modules/functions on the launch path, where broad handlers must
+    #: stay taxonomy-aware (relpaths, or "relpath::funcname")
+    launch_paths: Tuple[str, ...] = ()
+    #: env vars deliberately WITHOUT a TpuConfig field, with the reason
+    env_field_exceptions: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    #: env var name prefix the knob audit owns
+    env_prefix: str = "SST_"
+    #: relpaths excluded from source rules (the lock shim itself, ...)
+    exclude: Tuple[str, ...] = ()
+
+    @classmethod
+    def default(cls, root) -> "Project":
+        """The real spark_sklearn_tpu layout and contract map."""
+        root = Path(root).resolve()
+        pkg = root / "spark_sklearn_tpu"
+        return cls(
+            root=root,
+            package=pkg,
+            readme=root / "README.md",
+            docs_api=root / "docs" / "API.md",
+            metrics_path=pkg / "obs" / "metrics.py",
+            spans_path=pkg / "obs" / "spans.py",
+            allowed_cross_module=(),
+            shared_state=(
+                # dataplane: process-wide transfer totals + the plane
+                SharedState("parallel/dataplane.py",
+                            "dataplane._TOTALS_LOCK", name="_TOTALS"),
+                SharedState("parallel/dataplane.py",
+                            "dataplane._PLANE_LOCK", name="_PLANE"),
+                SharedState("parallel/dataplane.py",
+                            "dataplane.DataPlane._lock", cls="DataPlane",
+                            attrs=("_entries", "_bytes", "_tile_programs",
+                                   "hits", "misses", "evictions",
+                                   "bytes_uploaded", "bytes_tiled",
+                                   "byte_budget")),
+                SharedState("parallel/dataplane.py",
+                            "dataplane.StagingRing._lock",
+                            cls="StagingRing", attrs=("_rings",)),
+                # pipeline: persistent-cache event counters
+                SharedState("parallel/pipeline.py",
+                            "pipeline._LISTENER_LOCK",
+                            name="_CACHE_EVENTS"),
+                # faults: the supervisor's recovery bookkeeping
+                SharedState("parallel/faults.py",
+                            "faults.LaunchSupervisor._lock",
+                            cls="LaunchSupervisor",
+                            attrs=("faults", "_retries_used",
+                                   "_sticky_oom")),
+                # taskgrid: the geometry plan cache + cost model
+                SharedState("parallel/taskgrid.py",
+                            "taskgrid._PLAN_CACHE_LOCK",
+                            name="_PLAN_CACHE"),
+                SharedState("parallel/taskgrid.py",
+                            "taskgrid.GeometryCostModel._lock",
+                            cls="GeometryCostModel",
+                            attrs=("launch_overhead_s", "lane_cost_s",
+                                   "compile_wall_s", "n_observations")),
+                # grid: per-plan staged-chunk id sets
+                SharedState("search/grid.py", "grid.stage_lock",
+                            taint_key="staged_ids"),
+                # obs/log: the logger cache
+                SharedState("obs/log.py", "log._LOGGERS_LOCK",
+                            name="_LOGGERS"),
+            ),
+            blocks=(
+                BlockSpec("pipeline", "PIPELINE_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/pipeline.py",
+                             "ChunkPipeline.report"),
+                    Producer("subscript-var", "search/grid.py", "pr"),
+                )),
+                BlockSpec("dataplane", "DATAPLANE_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/dataplane.py",
+                             "report_block"),
+                )),
+                BlockSpec("geometry", "GEOMETRY_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/taskgrid.py",
+                             "GeometryPlan.report_block"),
+                )),
+                BlockSpec("faults", "FAULTS_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/faults.py",
+                             "LaunchSupervisor.__init__"),
+                    Producer("subscript-var", "search/grid.py",
+                             "faults"),
+                )),
+            ),
+            launch_paths=(
+                "parallel/faults.py",
+                "parallel/pipeline.py",
+                "search/grid.py::_dispatch",
+                "search/grid.py::submit_precompile",
+                "search/grid.py::resolve_fused",
+                "search/grid.py::exec_fused_range",
+                "search/grid.py::attempt",
+                "search/grid.py::guarded_launch",
+                "search/grid.py::guarded_wait",
+                "search/grid.py::host_eval",
+            ),
+            env_field_exceptions={
+                "SST_LOCKCHECK": (
+                    "process-wide test-harness toggle; the lock shim "
+                    "must exist before any TpuConfig is constructed"),
+                "SST_LOCKCHECK_HOLD_S": (
+                    "tuning companion of SST_LOCKCHECK; same "
+                    "pre-config lifetime"),
+            },
+            exclude=(),
+        )

@@ -1,0 +1,223 @@
+"""jit-purity checkers.
+
+Functions handed to ``jax.jit`` (or AOT-compiled via
+``precompile``/``submit_precompile``) trace ONCE and replay as XLA
+programs: host-side effects inside them either burn at trace time only
+(wall-clock reads, RNG draws — silently constant thereafter) or
+corrupt the engine's accounting (a ``device_put`` inside a traced
+function bypasses the data plane's byte counters and cache).  These
+rules walk every jitted function (plus one hop into local helpers it
+calls) and flag the host effects.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.sstlint import astutil
+from tools.sstlint.core import Context, Finding, ModuleInfo, rule
+
+
+def _unwrap_transform(node: ast.AST) -> ast.AST:
+    """Strip jax.vmap/pmap/grad wrappers: jax.jit(jax.vmap(f)) targets
+    f."""
+    while isinstance(node, ast.Call):
+        chain = astutil.call_name(node) or ""
+        if chain.split(".")[-1] in ("vmap", "pmap", "grad",
+                                    "value_and_grad") and node.args:
+            node = node.args[0]
+        else:
+            break
+    return node
+
+
+def _jit_targets(mod: ModuleInfo):
+    """(node, kind) for every function object handed to jax.jit:
+    lambdas, local function names, and decorated defs."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            chain = astutil.call_name(node) or ""
+            is_jit = chain in ("jax.jit", "jit") or \
+                chain.endswith(".jit")
+            if is_jit and node.args:
+                yield _unwrap_transform(node.args[0]), node.lineno
+            # functools.partial(jax.jit, ...) used as decorator is
+            # handled below via the decorator list
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                chain = None
+                if isinstance(dec, ast.Call):
+                    chain = astutil.call_name(dec)
+                    if chain in ("partial", "functools.partial") and \
+                            dec.args:
+                        chain = astutil.attr_chain(dec.args[0])
+                else:
+                    chain = astutil.attr_chain(dec)
+                if chain in ("jax.jit", "jit") or \
+                        (chain or "").endswith(".jit"):
+                    yield node, node.lineno
+
+
+def _local_defs(mod: ModuleInfo) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for fn in astutil.iter_functions(mod.tree):
+        out.setdefault(fn.name, []).append(fn)
+    return out
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Parameters + names assigned inside `fn` — everything else is a
+    closure/global capture."""
+    bound: Set[str] = set()
+    if isinstance(fn, ast.Lambda):
+        args = fn.args
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        args = fn.args
+    else:
+        return bound
+    for a in list(args.args) + list(args.posonlyargs) \
+            + list(args.kwonlyargs):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
+def _walk_jitted(mod: ModuleInfo, target: ast.AST,
+                 defs: Dict[str, List[ast.AST]]):
+    """The target function plus one hop into local helpers it calls
+    by bare name."""
+    seen: List[ast.AST] = []
+    if isinstance(target, ast.Name):
+        seen.extend(defs.get(target.id, ()))
+    elif isinstance(target, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+        seen.append(target)
+    hop: List[ast.AST] = []
+    for fn in seen:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                for helper in defs.get(node.func.id, ()):
+                    if helper not in seen and helper not in hop:
+                        hop.append(helper)
+    return seen + hop
+
+
+def _findings_in(mod: ModuleInfo, fn: ast.AST, jit_line: int):
+    bound = _bound_names(fn)
+    label = getattr(fn, "name", "<lambda>")
+    for node in ast.walk(fn):
+        chain = None
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            chain = astutil.attr_chain(node)
+        if chain:
+            root = chain.split(".")[0]
+            if root == "time" and root not in bound and \
+                    isinstance(node, ast.Attribute):
+                yield ("jit-impure-time", node.lineno,
+                       f"wall-clock read ({chain}) inside jitted "
+                       f"{label!r}: evaluated once at trace time, "
+                       "constant forever after", label)
+            if (chain.startswith("random.")
+                    or ".random." in chain
+                    or chain.endswith(".random")) and \
+                    root not in bound and \
+                    root in ("random", "np", "numpy"):
+                yield ("jit-impure-random", node.lineno,
+                       f"host RNG ({chain}) inside jitted {label!r}: "
+                       "draws at trace time only; thread jax.random "
+                       "keys instead", label)
+        if isinstance(node, ast.Call):
+            cchain = astutil.call_name(node) or ""
+            tail = cchain.split(".")[-1]
+            if tail == "device_put" or (
+                    tail == "upload" and (
+                        "dataplane" in cchain or cchain.startswith(
+                            "_dataplane"))):
+                yield ("jit-unplaned-upload", node.lineno,
+                       f"{cchain} inside jitted {label!r}: transfers "
+                       "must go through the data plane OUTSIDE traced "
+                       "code (the plane is the only sanctioned upload "
+                       "point)", label)
+        # host-side in-place mutation of a captured array
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id not in bound:
+                yield ("jit-host-mutation", node.lineno,
+                       f"in-place subscript write to captured "
+                       f"{tgt.value.id!r} inside jitted {label!r}: "
+                       "traced code must be functional (use .at[].set)",
+                       label)
+
+
+_PURITY_RULES = ("jit-impure-time", "jit-impure-random",
+                 "jit-unplaned-upload", "jit-host-mutation")
+
+
+def _run_purity(ctx: Context, only_rule: str):
+    for mod in ctx.modules:
+        defs = _local_defs(mod)
+        reported = set()
+        for target, jit_line in _jit_targets(mod):
+            for fn in _walk_jitted(mod, target, defs):
+                for rname, line, msg, label in _findings_in(
+                        mod, fn, jit_line):
+                    if rname != only_rule:
+                        continue
+                    key = (rname, mod.relpath, line)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    if mod.suppressed(rname, line):
+                        continue
+                    yield Finding(
+                        rname, mod.relpath, line, msg,
+                        symbol=f"{label}"
+                               f"@{mod.qualname(fn) or '<module>'}")
+
+
+@rule("jit-impure-time")
+def check_jit_time(ctx: Context) -> Iterable[Finding]:
+    """No ``time.*`` reads inside functions traced by ``jax.jit`` —
+    the clock is read once at trace time and baked into the program as
+    a constant."""
+    return _run_purity(ctx, "jit-impure-time")
+
+
+@rule("jit-impure-random")
+def check_jit_random(ctx: Context) -> Iterable[Finding]:
+    """No Python/NumPy RNG inside jitted functions — draws happen at
+    trace time only; randomness must thread explicit ``jax.random``
+    keys."""
+    return _run_purity(ctx, "jit-impure-random")
+
+
+@rule("jit-unplaned-upload")
+def check_jit_upload(ctx: Context) -> Iterable[Finding]:
+    """No ``device_put``/``dataplane.upload`` inside jitted functions
+    — the data plane outside traced code is the only sanctioned
+    host->device upload point (byte accounting and the broadcast cache
+    both depend on it)."""
+    return _run_purity(ctx, "jit-unplaned-upload")
+
+
+@rule("jit-host-mutation")
+def check_jit_mutation(ctx: Context) -> Iterable[Finding]:
+    """No in-place writes to captured host arrays inside jitted
+    functions — traced code must stay functional (``.at[].set`` is the
+    jax spelling)."""
+    return _run_purity(ctx, "jit-host-mutation")

@@ -9,17 +9,44 @@ Perfetto:
 Works on any Trace Event Format file (object form with "traceEvents"
 or bare array form).  Exits nonzero when the trace holds no spans —
 the CI smoke leg uses that as its assertion.
+
+Span names are interpreted through the registered vocabulary
+(``spark_sklearn_tpu/obs/spans.py`` — the same single source of truth
+``tools/sstlint`` enforces at the instrumentation sites): async spans
+group by their registered prefix, and names the vocabulary has never
+heard of produce a stderr warning so a drifting producer is visible
+even from a bare trace file.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
-__all__ = ["load_events", "summarize", "format_summary", "main"]
+__all__ = ["load_events", "load_vocabulary", "summarize",
+           "format_summary", "main"]
+
+
+def load_vocabulary():
+    """The span-vocabulary module, loaded directly by file path so the
+    digest never pays the package (jax) import; None when the source
+    tree is not alongside this tool."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "spark_sklearn_tpu", "obs", "spans.py")
+    if not os.path.isfile(path):
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_sst_spans", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_sst_spans"] = module
+    spec.loader.exec_module(module)
+    return module
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -49,8 +76,13 @@ def _self_times(spans: List[Dict[str, Any]]) -> Dict[int, float]:
     return self_us
 
 
-def summarize(events: List[Dict[str, Any]], top: int = 12) -> Dict[str, Any]:
-    """Aggregate a trace into the printed digest's data structure."""
+def summarize(events: List[Dict[str, Any]], top: int = 12,
+              vocab=None) -> Dict[str, Any]:
+    """Aggregate a trace into the printed digest's data structure.
+    `vocab` is the registered span vocabulary (load_vocabulary());
+    unknown names land in the digest's "unknown_names" list."""
+    if vocab is None:
+        vocab = load_vocabulary()
     thread_names: Dict[Any, str] = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "thread_name":
@@ -58,10 +90,23 @@ def summarize(events: List[Dict[str, Any]], top: int = 12) -> Dict[str, Any]:
                 e.get("args", {}).get("name", "")
 
     spans = [e for e in events if e.get("ph") == "X"]
+    unknown: set = set()
     asyncs = defaultdict(int)
     for e in events:
         if e.get("ph") == "b":
-            asyncs[e.get("name", "").split(" ")[0] or "?"] += 1
+            name = e.get("name", "")
+            prefix = vocab.async_prefix(name) if vocab else None
+            if prefix is None:
+                # ad-hoc grouping for vocabulary-less / foreign traces
+                prefix = name.split(" ")[0] or "?"
+                if vocab is not None:
+                    unknown.add(name)
+            asyncs[prefix] += 1
+    if vocab is not None:
+        for e in spans:
+            name = e.get("name", "")
+            if name and not vocab.is_known_span(name):
+                unknown.add(name)
 
     by_thread: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
     for e in spans:
@@ -120,6 +165,7 @@ def summarize(events: List[Dict[str, Any]], top: int = 12) -> Dict[str, Any]:
     }
     return {
         "h2d": h2d,
+        "unknown_names": sorted(unknown),
         "n_events": len(events),
         "n_spans": len(spans),
         "wall_ms": round(wall_ms, 3),
@@ -187,6 +233,10 @@ def main(argv=None) -> int:
             print(format_summary(s))
     except BrokenPipeError:      # `... | head` is a legitimate use
         pass
+    for name in s.get("unknown_names", []):
+        print(f"warning: span name {name!r} is not in the registered "
+              "vocabulary (spark_sklearn_tpu/obs/spans.py)",
+              file=sys.stderr)
     if s["n_spans"] == 0:
         print("error: trace contains no complete spans", file=sys.stderr)
         return 2
