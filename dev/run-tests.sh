@@ -96,6 +96,50 @@ print("dataplane smoke:", {k: d2[k] for k in
       "geometry:", geo["source"], [g["width"] for g in geo["groups"]])
 PY
 
+echo "== program-store smoke (cold process B hits what process A published) =="
+PS_DIR=$(mktemp -d /tmp/sst_ps_smoke_XXXX)
+for PS_MODE in populate replay; do
+JAX_PLATFORMS=cpu SST_PS_MODE="$PS_MODE" SST_PS_DIR="$PS_DIR" python - <<'PY'
+import json
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+mode, d = os.environ["SST_PS_MODE"], os.environ["SST_PS_DIR"]
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+cfg = sst.TpuConfig(program_store_dir=os.path.join(d, "store"))
+gs = sst.GridSearchCV(LogisticRegression(max_iter=10),
+                      {"C": [0.1, 1.0, 10.0]}, cv=2, refit=False,
+                      backend="tpu", config=cfg).fit(X, y)
+ps = gs.search_report["programstore"]
+scores = gs.cv_results_["mean_test_score"].tolist()
+score_file = os.path.join(d, "scores.json")
+if mode == "populate":
+    # cold process A against an empty store: publishes every program
+    assert ps["enabled"] and ps["publishes"] > 0, ps
+    with open(score_file, "w") as f:
+        json.dump(scores, f)
+else:
+    # cold process B: every compile group serves from the store —
+    # zero traces, zero XLA compilations, exact cv_results_ parity
+    assert ps["hits"] > 0 and ps["misses"] == 0, ps
+    n_compiles = gs.search_report["pipeline"]["n_compiles"]
+    assert n_compiles == 0, gs.search_report["pipeline"]
+    with open(score_file) as f:
+        np.testing.assert_array_equal(np.array(json.load(f)),
+                                      gs.cv_results_["mean_test_score"])
+print(f"program-store smoke [{mode}]:",
+      {k: ps[k] for k in ("hits", "misses", "publishes",
+                          "bytes_loaded", "bytes_saved")})
+PY
+done
+rm -rf "$PS_DIR"
+
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'
 import numpy as np

@@ -31,6 +31,7 @@ __all__ = [
     "FAULTS_BLOCK_SCHEMA",
     "DATAPLANE_BLOCK_SCHEMA",
     "GEOMETRY_BLOCK_SCHEMA",
+    "PROGRAMSTORE_BLOCK_SCHEMA",
     "search_registry",
     "schema_markdown",
 ]
@@ -128,9 +129,16 @@ SEARCH_REPORT_SCHEMA = (
         "The waste-aware launch-geometry plan this search ran under "
         "(see the geometry-block schema below): per-group chunk "
         "widths, the cost model that chose them, and whether the plan "
-        "was computed, served from the in-process plan cache, or "
-        "replayed from the checkpoint journal "
-        "(parallel/taskgrid.plan_geometry)."),
+        "was computed, served from the in-process plan cache, seeded "
+        "from the persistent program store, or replayed from the "
+        "checkpoint journal (parallel/taskgrid.plan_geometry)."),
+    MetricDef(
+        "programstore", "struct",
+        "The persistent AOT program store's traffic during this "
+        "search (see the programstore-block schema below): artifact "
+        "hits/misses/publishes, bytes loaded vs saved, quarantines, "
+        "and the store's end-of-search state "
+        "(parallel/programstore.py)."),
     MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
@@ -241,8 +249,11 @@ GEOMETRY_BLOCK_SCHEMA = (
     MetricDef("source", "label",
               "Where the plan came from: 'computed' (fresh), "
               "'plan-cache' (first in-process plan for this structure "
-              "reused), or 'journal' (replayed from the checkpoint so "
-              "resume reuses the exact same chunk ids)."),
+              "reused), 'store' (seeded from the persistent program "
+              "store's plans.json, so a fresh process replays the "
+              "publishing process's widths), or 'journal' (replayed "
+              "from the checkpoint so resume reuses the exact same "
+              "chunk ids)."),
     MetricDef("planned_launches", "gauge",
               "Total chunk launches the plan schedules across all "
               "compile groups."),
@@ -258,6 +269,51 @@ GEOMETRY_BLOCK_SCHEMA = (
               "Per compile group: group index, n_candidates, chosen "
               "width, n_chunks, and whether convergence-sorted "
               "chunking pinned the width."),
+)
+
+#: sub-keys of ``search_report["programstore"]`` (written by
+#: ``parallel.programstore.report_block``) — this search's persistent
+#: AOT-artifact traffic plus the store's end-of-search state.
+PROGRAMSTORE_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Whether a persistent program store was active "
+              "(TpuConfig.program_store_dir / SST_PROGRAM_STORE_DIR)."),
+    MetricDef("hits", "counter",
+              "Programs served from serialized AOT artifacts this "
+              "search — each one skipped the whole python->jaxpr->"
+              "StableHLO walk.  Covering every compile group makes a "
+              "cold process's n_compiles zero."),
+    MetricDef("misses", "counter",
+              "Store lookups that found no artifact this search (the "
+              "program traced, was exported, and published for the "
+              "next process)."),
+    MetricDef("publishes", "counter",
+              "Artifacts serialized and atomically written this "
+              "search."),
+    MetricDef("bytes_loaded", "gauge",
+              "Artifact bytes read from disk this search (memory-"
+              "cache and prewarmed hits read nothing and count "
+              "zero)."),
+    MetricDef("bytes_saved", "gauge",
+              "Artifact bytes published this search."),
+    MetricDef("quarantined", "counter",
+              "Corrupt artifacts moved to the store's quarantine "
+              "directory this search (each fell back to a clean jit "
+              "recompile; never a failed search)."),
+    MetricDef("evictions", "counter",
+              "Oldest artifacts dropped this search to respect the "
+              "store byte budget (TpuConfig.program_store_bytes)."),
+    MetricDef("prewarmed", "counter",
+              "Artifacts loaded by manifest prewarm this PROCESS "
+              "(TpuSession.prewarm; cumulative, not per-search)."),
+    MetricDef("n_entries", "gauge",
+              "Artifacts resident on disk for this environment after "
+              "the search."),
+    MetricDef("store_bytes", "gauge",
+              "Artifact bytes resident on disk for this environment "
+              "after the search."),
+    MetricDef("dir", "label",
+              "The store's root directory."),
 )
 
 #: sub-keys of ``search_report["faults"]`` (written by

@@ -84,6 +84,15 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "One host->device transfer (carries `bytes`)."),
     SpanDef("dataplane.tile", "span", "parallel.dataplane",
             "On-device fold-mask tiling (no host transfer)."),
+    # parallel/programstore.py
+    SpanDef("programstore.load", "span", "parallel.programstore",
+            "One AOT-artifact store lookup (carries `bytes`, `hit` and "
+            "the serving `source`: memory/disk/miss)."),
+    SpanDef("programstore.save", "span", "parallel.programstore",
+            "Serialize + atomic publish of one AOT artifact (carries "
+            "`bytes`)."),
+    SpanDef("programstore.prewarm", "span", "parallel.programstore",
+            "Manifest-driven artifact preload at session init."),
     # parallel/pipeline.py
     SpanDef("stage", "span", "parallel.pipeline",
             "Chunk staging (host prep + device_put) on sst-stage."),

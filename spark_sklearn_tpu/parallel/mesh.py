@@ -165,6 +165,27 @@ class TpuConfig:
     # geometry in tests and for operators who know their launch costs.
     geometry_overhead_s: Optional[float] = None
     geometry_lane_cost_s: Optional[float] = None
+    # ---- persistent AOT program store (parallel/programstore.py) ----
+    # directory of the versioned artifact store: compiled search
+    # programs are jax.export-serialized there and a later process
+    # (bench cold runs, checkpoint-resume restarts, fleet workers)
+    # loads them instead of re-tracing — with the geometry plan cache
+    # and cost-model state persisted alongside, so a fresh process
+    # plans the same chunk widths and its first chunk launches without
+    # compiling anything.  None defers to SST_PROGRAM_STORE_DIR; unset
+    # disables the store (the in-process and persistent-XLA caches
+    # still apply).
+    program_store_dir: Optional[str] = None
+    # prewarm manifest (written by TpuSession.write_prewarm_manifest):
+    # a session constructed with this set loads the manifest's
+    # artifacts into memory at init, so the first search's programs
+    # resolve without touching disk mid-pipeline.  None defers to
+    # SST_PREWARM_MANIFEST; a missing file is skipped, never an error.
+    prewarm_manifest: Optional[str] = None
+    # store byte budget: oldest artifacts evict beyond it.  None defers
+    # to SST_PROGRAM_STORE_BYTES (default 512 MiB); 0 disables the
+    # store entirely.
+    program_store_bytes: Optional[int] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

@@ -143,7 +143,19 @@ def precompile(jit_fn, *args):
     concrete) arguments; returns the compiled executable, which produces
     bit-identical results to calling `jit_fn` (same jaxpr, same compile
     options).  Raises whatever tracing/compilation raises — callers fall
-    back to the plain jit path."""
+    back to the plain jit path.
+
+    Store-backed programs (parallel/programstore.StoredProgram, exposed
+    via their ``resolve`` hook) consult the persistent artifact store
+    BEFORE any lowering: a hit substitutes the deserialized artifact's
+    wrapper — no python->jaxpr walk at all — and a miss exports and
+    publishes the program so the next cold process hits.  Either way
+    the lower+compile below still AOT-compiles the resulting callable
+    on this (compile) thread, so group boundaries never stall the
+    device, and the persistent XLA cache covers the binary."""
+    resolve = getattr(jit_fn, "resolve", None)
+    if resolve is not None:
+        jit_fn = resolve(*args)
     return jit_fn.lower(*args).compile()
 
 

@@ -295,6 +295,42 @@ class GeometryCostModel:
                 "source": "measured" if self.n_observations else "default",
             }
 
+    def state_dict(self) -> Dict[str, Any]:
+        """Unrounded EMA state for cross-process persistence (the
+        program store's ``plans.json``)."""
+        with self._lock:
+            return {
+                "launch_overhead_s": self.launch_overhead_s,
+                "lane_cost_s": self.lane_cost_s,
+                "compile_wall_s": self.compile_wall_s,
+                "n_observations": self.n_observations,
+            }
+
+    def load_state(self, state: Mapping[str, Any]) -> bool:
+        """Adopt a persisted EMA state when it has seen MORE searches
+        than this process — a fresh worker prices its launch geometry
+        from the fleet's measured walls instead of the padding-averse
+        defaults, while a process with its own (newer) measurements
+        keeps them.  Returns whether the state was adopted."""
+        try:
+            n = int(state["n_observations"])
+            overhead = float(state["launch_overhead_s"])
+            lane = float(state["lane_cost_s"])
+            compile_wall = float(state.get("compile_wall_s", 0.0))
+        except (KeyError, TypeError, ValueError):
+            return False
+        if not (np.isfinite(overhead) and np.isfinite(lane)
+                and overhead >= 0.0 and lane >= 0.0):
+            return False
+        with self._lock:
+            if n <= self.n_observations:
+                return False
+            self.launch_overhead_s = overhead
+            self.lane_cost_s = lane
+            self.compile_wall_s = compile_wall
+            self.n_observations = n
+            return True
+
 
 _COST_MODEL = GeometryCostModel()
 
@@ -419,7 +455,12 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)
         if hit is not None:
-            return dataclasses.replace(hit, source="plan-cache")
+            # plans seeded from the persistent program store keep their
+            # provenance so search_report["geometry"] shows the fresh
+            # process replayed the fleet's widths, not its own pricing
+            return dataclasses.replace(
+                hit, source="store" if hit.source == "store"
+                else "plan-cache")
 
     model = cost_model or geometry_cost_model()
     overhead = (overhead_override if overhead_override is not None
@@ -471,6 +512,65 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
             # entry so widths never flap mid-process
             plan = _PLAN_CACHE.setdefault(cache_key, plan)
     return plan
+
+
+# ---------------------------------------------------------------------------
+# Cross-process plan persistence (the program store's plans.json)
+# ---------------------------------------------------------------------------
+#
+# The in-process plan cache pins "first plan for a structure wins" so
+# cost-model drift never recompiles known shapes at new widths.  The
+# program store extends that guarantee ACROSS processes: a fresh worker
+# imports the persisted plans before its first search, so it requests
+# the same chunk widths — and therefore the same stored AOT programs —
+# the publishing process ran, instead of re-pricing from scratch.
+
+
+def _plan_key_to_json(key: Tuple) -> List[Any]:
+    return [list(key[0]), list(key[1]), *key[2:]]
+
+
+def _plan_key_from_json(j: Sequence[Any]) -> Tuple:
+    return (tuple(int(x) for x in j[0]),
+            tuple(None if c is None else int(c) for c in j[1]),
+            int(j[2]), int(j[3]), int(j[4]), str(j[5]),
+            None if j[6] is None else float(j[6]),
+            None if j[7] is None else float(j[7]))
+
+
+def export_plan_state() -> Dict[str, Any]:
+    """JSON-able snapshot of the process's geometry knowledge: the plan
+    cache (structure key -> chosen plan) plus the cost model's EMA
+    state."""
+    with _PLAN_CACHE_LOCK:
+        items = list(_PLAN_CACHE.items())
+    return {
+        "cost_model": geometry_cost_model().state_dict(),
+        "plans": [{"key": _plan_key_to_json(k), "plan": p.to_dict()}
+                  for k, p in items],
+    }
+
+
+def import_plan_state(state: Mapping[str, Any]) -> int:
+    """Seed the plan cache (and cost model) from a persisted snapshot.
+    In-process plans always win (``setdefault`` — widths never flap
+    mid-process); malformed records are skipped, never errors.  Returns
+    how many plans were newly seeded."""
+    cm = state.get("cost_model")
+    if cm:
+        geometry_cost_model().load_state(cm)
+    n = 0
+    for rec in state.get("plans", ()):
+        try:
+            key = _plan_key_from_json(rec["key"])
+            plan = GeometryPlan.from_dict(rec["plan"])
+        except (KeyError, IndexError, TypeError, ValueError):
+            continue
+        plan = dataclasses.replace(plan, source="store")
+        with _PLAN_CACHE_LOCK:
+            if _PLAN_CACHE.setdefault(key, plan) is plan:
+                n += 1
+    return n
 
 
 def build_fold_masks(

@@ -63,6 +63,7 @@ from spark_sklearn_tpu.search.scorers import (
     build_view,
     resolve_scoring,
 )
+from spark_sklearn_tpu.utils.locks import named_lock
 from spark_sklearn_tpu.utils.native import fold_masks
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.metrics import search_registry
@@ -111,7 +112,7 @@ def _cache_evict(fam=None):
 _SORTED_LAUNCHES = 8
 
 
-def _cached_program(key, build):
+def _cached_program(key, build, store_parts=None, store=None):
     """Cross-search cache of jitted callables.
 
     The fit/score programs are built from per-search closures, so without
@@ -125,36 +126,78 @@ def _cached_program(key, build):
     Eviction is LRU with per-family program accounting (keys are
     ("fit"|"score"|..., family, ...) tuples): a family at its cap evicts
     its own LRU entry, the global cap evicts the overall LRU entry.
+
+    ``store_parts`` (a deterministic ``(kind, family_name, *structure)``
+    tuple) additionally routes the program through ``store`` — THIS
+    SEARCH's persistent AOT store (parallel/programstore.py), resolved
+    by the caller from its own config so a store-less search never
+    consults a store some earlier search activated: the cached value
+    becomes a :class:`~spark_sklearn_tpu.parallel.programstore.
+    StoredProgram` that resolves serialized artifacts instead of
+    re-tracing, and ``n_compiles`` then counts signatures that actually
+    traced (store misses) rather than cache builds.
     """
-    global _PROGRAM_BUILDS
+    if store_parts is None:
+        store = None
     try:
         k = _freeze(key)
     except TypeError:
-        _PROGRAM_BUILDS += 1
+        _count_build()
         return build()
+    if store is not None:
+        # store-backed and plain programs are distinct cache residents:
+        # a later store-less search must not consult the store through
+        # a stale proxy (nor the reverse)
+        k = (k, "__programstore__", store.directory)
     hit = _PROGRAM_CACHE.get(k)
     if hit is not None:
         _PROGRAM_CACHE.move_to_end(k)
+        if store is not None:
+            # a deactivate/re-activate cycle minted a fresh store
+            # object for the same directory: repoint the cached proxy
+            # so traffic lands on the store whose counters/manifest
+            # this search reports
+            rebind = getattr(hit[0], "rebind", None)
+            if rebind is not None:
+                rebind(store)
         return hit[0]
     fam = key[1] if isinstance(key, tuple) and len(key) > 1 else None
     if _PROGRAM_CACHE_FAMILY_COUNTS.get(fam, 0) >= _PROGRAM_CACHE_MAX_PER_FAMILY:
         _cache_evict(fam)
     elif len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
         _cache_evict()
-    _PROGRAM_BUILDS += 1
     fn = build()
+    if store is not None:
+        from spark_sklearn_tpu.parallel import programstore as _ps
+        wrapped = _ps.maybe_wrap(fn, store, store_parts,
+                                 on_trace=_count_build)
+        if wrapped is fn:     # store-unkeyable: legacy accounting
+            _count_build()
+        fn = wrapped
+    else:
+        _count_build()
     _PROGRAM_CACHE[k] = (fn, fam)
     _PROGRAM_CACHE_FAMILY_COUNTS[fam] += 1
     return fn
 
 
-#: count of program-cache misses (each one is a fresh traced program
-#: that compiles at first dispatch) — the search_report's n_compiles
+#: count of traced-program constructions (program-cache misses; with a
+#: program store active, store-resolution misses) — the search_report's
+#: n_compiles.  Store resolution may run on the compile thread while
+#: the dispatch thread builds, hence the lock.
 _PROGRAM_BUILDS = 0
+_BUILDS_LOCK = named_lock("grid._BUILDS_LOCK")
+
+
+def _count_build() -> None:
+    global _PROGRAM_BUILDS
+    with _BUILDS_LOCK:
+        _PROGRAM_BUILDS += 1
 
 
 def _program_build_count() -> int:
-    return _PROGRAM_BUILDS
+    with _BUILDS_LOCK:
+        return _PROGRAM_BUILDS
 
 
 @jax.jit
@@ -906,6 +949,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             enable_persistent_cache)
         enable_persistent_cache(config.resolved_cache_dir(),
                                 config.persistent_cache_min_compile_s)
+        # persistent AOT program store: sessionless fits activate it
+        # here (a TpuSession already did at construction) — programs
+        # resolve from serialized artifacts instead of re-tracing, and
+        # the search publishes what it compiles for the next process
+        from spark_sklearn_tpu.parallel import programstore as _programstore
+        pstore = _programstore.activate_store(config)
+        ps_before = _programstore.snapshot_counters(pstore)
         dtype = dtype_override or config.dtype or np.float32
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
@@ -1329,6 +1379,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                            else "device" if plane is not None else "host")
             metrics.put("dataplane", _dataplane.report_block(
                 plane, dp_before, mask_tiling=mask_tiling))
+            # this search's AOT-store traffic (hits = programs served
+            # from serialized artifacts with zero tracing; publishes =
+            # artifacts written for the next cold process) — schema in
+            # obs.metrics.PROGRAMSTORE_BLOCK_SCHEMA
+            metrics.put("programstore", _programstore.report_block(
+                pstore, ps_before))
         if preval_failed.any():
             # failed fits never ran: sklearn records 0.0 for their times
             fit_times[preval_failed, :] = 0.0
@@ -1481,6 +1537,24 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # chunks attribute out of their single-launch wall.
         fused_mode = all_cores and config.fuse_fit_score
         score_key = tuple(sorted(scorers.items()))
+        # deterministic identity parts for the persistent program store
+        # (parallel/programstore.py): everything in a store key must
+        # repr identically across processes, so the family OBJECT
+        # becomes its registry name, the mesh its topology, and the
+        # scorer closures their registry names (their implementations
+        # are pinned by the package version in the store's environment
+        # fingerprint).  Donated programs skip the store: the exported
+        # wrapper would silently drop the donation.
+        mesh_desc = ("mesh", tuple(sorted(dict(mesh.shape).items())),
+                     tuple(int(d.id)
+                           for d in np.asarray(mesh.devices).flat))
+        store_score_names = tuple(sorted(scorers))
+        store_sw_key = tuple(sorted(sw_blind))
+        # THIS search's store (None when its config doesn't enable one:
+        # a store-less search must never resolve programs through a
+        # store an earlier search in the process activated)
+        from spark_sklearn_tpu.parallel import programstore as _pstore
+        search_store = _pstore.activate_store(config)
 
         # ------------------------------------------------------------------
         # group plans: chunk geometry + (lazily built) programs
@@ -1650,7 +1724,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 fit_jit = _cached_program(
                     ("fit_tb", family, static, meta, nc_batch, n_folds,
                      bool(config.bf16_matmul), donate),
-                    lambda: jax.jit(fit_batch_tb, **donate_kw))
+                    lambda: jax.jit(fit_batch_tb, **donate_kw),
+                    store_parts=None if donate else (
+                        "fit_tb", family.name, static, meta, nc_batch,
+                        n_folds, bool(config.bf16_matmul), mesh_desc),
+                    store=search_store)
 
             def fit_batch(dyn_arrs, data_d, train_m, static=static):
                 def one_cand(dyn_scalars):
@@ -1758,7 +1836,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     ("fused", family, static, meta, nc_batch, n_folds,
                      bool(config.bf16_matmul), mesh, score_key,
                      return_train, sw_blind, donate),
-                    lambda: jax.jit(fused_batch, **donate_kw))
+                    lambda: jax.jit(fused_batch, **donate_kw),
+                    store_parts=None if donate else (
+                        "fused", family.name, static, meta, nc_batch,
+                        n_folds, bool(config.bf16_matmul), mesh_desc,
+                        store_score_names, store_sw_key, return_train),
+                    store=search_store)
             # separate fit/score programs: the non-fused path runs them
             # for every chunk; the fused path runs them for each group's
             # first live chunk to calibrate the score share that splits
@@ -1770,11 +1853,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 fit_jit = _cached_program(
                     ("fit", family, static, meta, mesh, donate),
                     lambda: jax.jit(fit_batch, out_shardings=task_shard,
-                                    **donate_kw))
+                                    **donate_kw),
+                    store_parts=None if donate else (
+                        "fit", family.name, static, meta, mesh_desc),
+                    store=search_store)
             score_jit = _cached_program(
                 ("score", family, static, meta, score_key, return_train,
                  sw_blind, bool(all_cores)),
-                lambda: jax.jit(score_batch))
+                lambda: jax.jit(score_batch),
+                store_parts=("score", family.name, static, meta,
+                             mesh_desc, store_score_names, store_sw_key,
+                             return_train, bool(all_cores)),
+                store=search_store)
             progs = {"fit": fit_jit, "score": score_jit,
                      "fused": fused_jit}
             cache[nc_batch] = progs
@@ -1812,7 +1902,6 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         #: guards the per-plan staged-chunk bookkeeping: stage normally
         #: runs on the single stage thread, but supervisor retries
         #: re-stage on whichever thread is recovering
-        from spark_sklearn_tpu.utils.locks import named_lock
         stage_lock = named_lock("grid.stage_lock")
 
         cache0 = persistent_cache_counts()
@@ -2395,6 +2484,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # (plans already computed this process keep their widths via
             # the plan cache, so drift never forces recompiles)
             geometry_cost_model().observe(pr.get("launches"))
+            # persist the plan cache + cost-model state next to the AOT
+            # artifacts: a fresh process then plans the SAME chunk
+            # widths — and resolves the same stored programs — without
+            # re-measuring (parallel/programstore.py plans.json)
+            if search_store is not None:
+                from spark_sklearn_tpu.parallel.taskgrid import (
+                    export_plan_state)
+                search_store.save_plan_state(export_plan_state())
 
     def _print_task_end_lines(self, candidates, idx, n_folds, scorer_names,
                               test_scores, train_scores, return_train,

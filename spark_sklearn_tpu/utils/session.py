@@ -10,6 +10,7 @@ collectives ride ICI/DCN via XLA — SURVEY §5.8).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from spark_sklearn_tpu.obs.log import get_logger
@@ -50,6 +51,18 @@ class TpuSession:
             # X/y/mask uploads — the session-lifetime sc.broadcast
             from spark_sklearn_tpu.parallel.dataplane import plane_for
             self.dataplane = plane_for(self.config)
+            # persistent AOT program store (parallel/programstore.py):
+            # activate it now and prewarm from the manifest, so the
+            # first search's programs — and the launch-geometry plans
+            # that select them — are resident before any chunk stages
+            from spark_sklearn_tpu.parallel import (
+                programstore as _programstore)
+            self.programstore = _programstore.activate_store(self.config)
+            self._prewarm_summary = {}
+            manifest = _programstore.resolve_manifest(self.config)
+            if self.programstore is not None and manifest and \
+                    os.path.isfile(manifest):
+                self._prewarm_summary = self.prewarm(manifest)
             # parse the fault-injection plan NOW so a typo in
             # TpuConfig(fault_plan=...) / SST_FAULT_PLAN fails loudly at
             # session construction, not halfway through a long search
@@ -66,6 +79,12 @@ class TpuSession:
             "disabled" if self.dataplane is None else
             f"budget={self.dataplane.byte_budget // 2 ** 20} MiB",
             getattr(self.config, "geometry_mode", "auto"))
+        logger.info(
+            "program store: %s",
+            "disabled" if self.programstore is None else
+            f"{self.programstore.directory} "
+            f"(prewarmed {self._prewarm_summary.get('loaded', 0)} "
+            "artifact(s))")
         logger.info(
             "fault supervisor: max_launch_retries=%d "
             "max_search_retries=%d backoff=%.2fs timeout=%s "
@@ -85,6 +104,47 @@ class TpuSession:
         data plane (empty dict when ``dataplane_bytes=0`` disabled
         it)."""
         return {} if self.dataplane is None else self.dataplane.stats()
+
+    def programstore_stats(self) -> dict:
+        """Cumulative counters + disk state of the session's persistent
+        AOT program store (empty dict when no store is configured)."""
+        if self.programstore is None:
+            return {}
+        return {**self.programstore.counts(),
+                **self.programstore.disk_stats()}
+
+    def prewarm(self, manifest) -> dict:
+        """Load the AOT program artifacts a manifest declares (path or
+        parsed dict — see
+        :meth:`~spark_sklearn_tpu.parallel.programstore.ProgramStore.
+        prewarm`) into the store's memory cache, so the declared
+        (family, grid-shape) programs resolve without disk IO when the
+        first search requests them.  No-op (with a log line) when the
+        session has no program store."""
+        if self.programstore is None:
+            logger.info("prewarm skipped: no program store configured "
+                        "(TpuConfig.program_store_dir)")
+            return {}
+        return self.programstore.prewarm(manifest)
+
+    def write_prewarm_manifest(self, path: Optional[str] = None) -> str:
+        """Record every store artifact this process served or published
+        — what the finished searches actually used — as a prewarm
+        manifest for the next session's
+        ``TpuConfig(prewarm_manifest=...)``.  Default path: the
+        configured ``prewarm_manifest``."""
+        if self.programstore is None:
+            raise ValueError(
+                "no program store: construct the session with "
+                "TpuConfig(program_store_dir=...)")
+        from spark_sklearn_tpu.parallel.programstore import (
+            resolve_manifest)
+        target = path or resolve_manifest(self.config)
+        if not target:
+            raise ValueError(
+                "no manifest path: pass one, or construct the session "
+                "with TpuConfig(prewarm_manifest=...)")
+        return self.programstore.write_manifest(target)
 
     def export_trace(self, path: Optional[str] = None) -> str:
         """Write the tracer's current buffer as a Chrome trace-event

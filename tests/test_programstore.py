@@ -1,0 +1,542 @@
+"""Persistent AOT program & plan store (parallel/programstore.py).
+
+Contracts under test:
+  - artifact round trip: publish -> (memory | fresh-store disk) load,
+    executes-what-it-published, byte/hit counters;
+  - robustness trio from the issue: VERSION/ENV MISMATCH is a clean
+    miss (never quarantined), a TRUNCATED/BIT-FLIPPED artifact is
+    quarantined and recompiled, CONCURRENT WRITERS of one key end with
+    a consistent store — and every failure mode falls back to JIT with
+    exact `cv_results_` parity;
+  - prewarm manifest round trip (write_manifest -> fresh store
+    prewarm -> memory hits);
+  - geometry plan persistence: export/import round trip, "store"
+    provenance, cost-model adoption rule (more observations wins);
+  - `search_report["programstore"]` renders the pinned schema block.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.parallel import programstore as ps
+from spark_sklearn_tpu.parallel import taskgrid
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store_global():
+    """Each test activates its own store directory; the process-global
+    singleton must not leak across tests."""
+    ps.deactivate_store()
+    yield
+    ps.deactivate_store()
+
+
+def _non_time_results(gs):
+    return {k: v for k, v in gs.cv_results_.items()
+            if "time" not in k and k != "params"}
+
+
+def _assert_exact_equal(ra, rb):
+    assert set(ra) == set(rb)
+    for k in ra:
+        np.testing.assert_array_equal(
+            np.asarray(ra[k]), np.asarray(rb[k]), err_msg=k)
+
+
+def _data(n=96, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    return X, (X[:, 0] > 0).astype(np.int64)
+
+
+def _fit(X, y, **cfg_kw):
+    from sklearn.linear_model import LogisticRegression
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.GridSearchCV(
+            LogisticRegression(max_iter=10), {"C": [0.1, 1.0, 10.0]},
+            cv=2, refit=False, backend="tpu",
+            config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+def _export_double(store, name):
+    """Publish a tiny exported program under `name`; returns the
+    exported artifact the store handed back."""
+    from jax import export as jexport
+    jit_fn = jax.jit(lambda x: x * 2.0)
+    exported = jexport.export(jit_fn)(np.ones(4, np.float32))
+    return store.publish(name, exported, kind="test", family="toy")
+
+
+def _rewrite_header(path, mutate):
+    """Parse one artifact file, apply `mutate(header_dict)`, rewrite it
+    (payload untouched, so its checksum stays valid)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    off = len(ps._MAGIC)
+    hlen = int.from_bytes(raw[off:off + 4], "big")
+    header = json.loads(raw[off + 4:off + 4 + hlen].decode())
+    payload = raw[off + 4 + hlen:]
+    mutate(header)
+    hbytes = json.dumps(header, sort_keys=True).encode()
+    with open(path, "wb") as f:
+        f.write(ps._MAGIC)
+        f.write(len(hbytes).to_bytes(4, "big"))
+        f.write(hbytes)
+        f.write(payload)
+
+
+def _artifacts(store):
+    return sorted(fn for fn in os.listdir(store._dir)
+                  if fn.endswith(ps._SUFFIX))
+
+
+class TestStoreUnit:
+    def test_publish_then_fresh_store_loads_from_disk(self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        name = store.entry_name("test", "toy", "aaaa", "bbbb")
+        assert _export_double(store, name) is not None
+        c = store.counts()
+        assert c["publishes"] == 1 and c["bytes_saved"] > 0
+        # same store: memory hit, zero disk bytes
+        assert store.load(name) is not None
+        assert store.counts()["bytes_loaded"] == 0
+        # fresh store (new process stand-in): disk hit with bytes
+        fresh = ps.ProgramStore(str(tmp_path))
+        ex = fresh.load(name)
+        assert ex is not None
+        out = jax.jit(ex.call)(np.full(4, 3.0, np.float32))
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.full(4, 6.0, np.float32))
+        fc = fresh.counts()
+        assert fc["hits"] == 1 and fc["bytes_loaded"] > 0
+        assert fresh.disk_stats()["n_entries"] == 1
+
+    def test_env_mismatch_is_clean_miss_not_quarantine(self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        name = store.entry_name("test", "toy", "aaaa", "bbbb")
+        _export_double(store, name)
+        _rewrite_header(store.path_for(name),
+                        lambda h: h["env"].update(jax="0.0.1-other"))
+        fresh = ps.ProgramStore(str(tmp_path))
+        assert fresh.load(name) is None
+        c = fresh.counts()
+        assert c["misses"] == 1 and c["quarantined"] == 0
+        # the foreign-version artifact stays in place for its world
+        assert _artifacts(fresh) == [name]
+
+    @pytest.mark.parametrize("corruption", ["truncate", "bitflip", "magic"])
+    def test_corrupt_artifact_quarantined(self, tmp_path, corruption):
+        store = ps.ProgramStore(str(tmp_path))
+        name = store.entry_name("test", "toy", "aaaa", "bbbb")
+        _export_double(store, name)
+        path = store.path_for(name)
+        raw = open(path, "rb").read()
+        if corruption == "truncate":
+            raw = raw[:len(raw) // 2]
+        elif corruption == "bitflip":
+            raw = raw[:-8] + bytes([raw[-8] ^ 0xFF]) + raw[-7:]
+        else:
+            raw = b"XXXXXXXX" + raw[8:]
+        with open(path, "wb") as f:
+            f.write(raw)
+        fresh = ps.ProgramStore(str(tmp_path))
+        assert fresh.load(name) is None
+        c = fresh.counts()
+        assert c["quarantined"] == 1 and c["misses"] == 1
+        assert _artifacts(fresh) == []
+        qdir = os.path.join(str(tmp_path), "quarantine")
+        assert os.path.isdir(qdir) and len(os.listdir(qdir)) == 1
+        # the quarantined key recompiles + republishes cleanly
+        assert _export_double(fresh, name) is not None
+        assert fresh.load(name) is not None
+
+    def test_artifact_vanishing_mid_read_is_clean_miss(
+            self, tmp_path, monkeypatch):
+        """A concurrent publisher's eviction can remove the file
+        between the isfile check and the read: clean miss, no
+        quarantine, never an exception into the search."""
+        store = ps.ProgramStore(str(tmp_path))
+        name = store.entry_name("test", "toy", "aaaa", "bbbb")
+        _export_double(store, name)
+        fresh = ps.ProgramStore(str(tmp_path))
+        monkeypatch.setattr(
+            ps.ProgramStore, "_read_artifact",
+            lambda self, path: (_ for _ in ()).throw(
+                FileNotFoundError(path)))
+        assert fresh.load(name) is None
+        c = fresh.counts()
+        assert c["misses"] == 1 and c["quarantined"] == 0
+
+    def test_byte_budget_evicts_oldest(self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        n0 = store.entry_name("test", "toy", "old0", "sig0")
+        _export_double(store, n0)
+        sz = os.path.getsize(store.path_for(n0))
+        # budget fits exactly one artifact: the second publish evicts
+        # the first (publish's own key is always kept)
+        store.byte_budget = int(sz * 1.5)
+        os.utime(store.path_for(n0), (1, 1))      # make it the oldest
+        n1 = store.entry_name("test", "toy", "new1", "sig1")
+        _export_double(store, n1)
+        assert _artifacts(store) == [n1]
+        assert store.counts()["evictions"] == 1
+
+    def test_maybe_wrap_unkeyable_parts_stays_plain(self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        jit_fn = jax.jit(lambda x: x + 1)
+        wrapped = ps.maybe_wrap(jit_fn, store,
+                                ("fit", "toy", object()))
+        assert wrapped is jit_fn
+        assert ps.maybe_wrap(jit_fn, None, ("fit", "toy")) is jit_fn
+        keyed = ps.maybe_wrap(jit_fn, store, ("fit", "toy", 3, (1, 2)))
+        assert isinstance(keyed, ps.StoredProgram)
+
+    def test_stored_program_counts_traces_once_per_signature(
+            self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        traces = []
+        prog = ps.maybe_wrap(jax.jit(lambda x: x * 3.0), store,
+                             ("fit", "toy", 7),
+                             on_trace=lambda: traces.append(1))
+        x = np.ones(8, np.float32)
+        np.testing.assert_array_equal(np.asarray(prog(x)), x * 3.0)
+        np.testing.assert_array_equal(np.asarray(prog(x)), x * 3.0)
+        assert len(traces) == 1                  # miss traced once
+        assert store.counts()["publishes"] == 1
+        # fresh store + fresh proxy (cold process stand-in): store hit,
+        # no trace counted
+        ps_fresh = ps.ProgramStore(str(tmp_path))
+        prog2 = ps.maybe_wrap(jax.jit(lambda x: x * 3.0), ps_fresh,
+                              ("fit", "toy", 7),
+                              on_trace=lambda: traces.append(1))
+        np.testing.assert_array_equal(np.asarray(prog2(x)), x * 3.0)
+        assert len(traces) == 1
+        assert ps_fresh.counts()["hits"] == 1
+
+    def test_precompile_seam_resolves_store_first(self, tmp_path):
+        """The pipeline's compile thread (parallel/pipeline.precompile)
+        consults the store BEFORE lowering: abstract compile-ahead and
+        concrete dispatch share one signature, and a fresh process's
+        compile-ahead serves the stored artifact."""
+        from spark_sklearn_tpu.parallel.pipeline import precompile
+        store = ps.ProgramStore(str(tmp_path))
+        prog = ps.maybe_wrap(jax.jit(lambda x: x * 5.0), store,
+                             ("fit", "toy", 1))
+        spec = jax.ShapeDtypeStruct((4,), np.float32)
+        compiled = precompile(prog, spec)
+        x = np.ones(4, np.float32)
+        np.testing.assert_array_equal(np.asarray(compiled(x)), x * 5.0)
+        np.testing.assert_array_equal(np.asarray(prog(x)), x * 5.0)
+        c = store.counts()
+        assert c["misses"] == 1 and c["publishes"] == 1
+        fresh = ps.ProgramStore(str(tmp_path))
+        prog2 = ps.maybe_wrap(jax.jit(lambda x: x * 5.0), fresh,
+                              ("fit", "toy", 1))
+        compiled2 = precompile(prog2, spec)
+        np.testing.assert_array_equal(np.asarray(compiled2(x)), x * 5.0)
+        assert fresh.counts()["hits"] == 1
+
+    def test_abstract_and_concrete_signatures_agree(self):
+        x = np.ones((4, 3), np.float32)
+        spec = jax.ShapeDtypeStruct((4, 3), np.float32)
+        assert ps.aval_signature((x,)) == ps.aval_signature((spec,))
+        assert ps.aval_signature((x,)) != ps.aval_signature(
+            (np.ones((4, 4), np.float32),))
+
+    def test_prewarm_manifest_round_trip(self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        name = store.entry_name("test", "toy", "aaaa", "bbbb")
+        _export_double(store, name)
+        manifest = str(tmp_path / "manifest.json")
+        store.write_manifest(manifest)
+        doc = json.load(open(manifest))
+        assert [e["file"] for e in doc["entries"]] == [name]
+        fresh = ps.ProgramStore(str(tmp_path))
+        summary = fresh.prewarm(manifest)
+        assert summary["loaded"] == 1 and summary["skipped"] == 0
+        c = fresh.counts()
+        assert c["prewarmed"] == 1 and c["bytes_loaded"] > 0
+        # the prewarmed artifact now serves from memory: no more disk IO
+        assert fresh.load(name) is not None
+        assert fresh.counts()["bytes_loaded"] == c["bytes_loaded"]
+
+    def test_prewarm_missing_and_foreign_entries_skipped(self, tmp_path):
+        store = ps.ProgramStore(str(tmp_path))
+        summary = store.prewarm(str(tmp_path / "nope.json"))
+        assert summary == {"entries": 0, "loaded": 0, "skipped": 0,
+                           "bytes": 0}
+        summary = store.prewarm({"entries": [
+            {"file": "gone" + ps._SUFFIX},
+            {"file": "foreign" + ps._SUFFIX, "env": "deadbeef0000"},
+            {"file": "../escape.txt"},
+        ]})
+        assert summary["loaded"] == 0 and summary["skipped"] == 3
+
+
+class TestTraceDigest:
+    def test_trace_summary_compile_digest(self):
+        """programstore.load/.save spans render into trace_summary's
+        compile digest (hit rate + bytes next to the h2d line)."""
+        from tools.trace_summary import format_summary, summarize
+        us = 1_000_000.0
+        events = [
+            {"ph": "X", "name": "compile", "ts": 0.0, "dur": 2.0 * us,
+             "pid": 1, "tid": 1, "args": {}},
+            {"ph": "X", "name": "programstore.load", "ts": 2.0 * us,
+             "dur": 0.01 * us, "pid": 1, "tid": 1,
+             "args": {"hit": True, "bytes": 1000}},
+            {"ph": "X", "name": "programstore.load", "ts": 2.1 * us,
+             "dur": 0.01 * us, "pid": 1, "tid": 1,
+             "args": {"hit": False, "bytes": 0}},
+            {"ph": "X", "name": "programstore.save", "ts": 2.2 * us,
+             "dur": 0.05 * us, "pid": 1, "tid": 1,
+             "args": {"bytes": 4000}},
+        ]
+        s = summarize(events)
+        assert s["compile"] == {
+            "compile_wall_ms": 2000.0, "store_loads": 2,
+            "store_hits": 1, "store_hit_rate": 0.5,
+            "store_bytes_loaded": 1000, "store_bytes_saved": 4000}
+        text = format_summary(s)
+        assert "program store 1/2 hits (50%)" in text
+        assert s["unknown_names"] == []     # spans are in the vocabulary
+
+
+class TestPlanPersistence:
+    #: a structure no real search uses (overrides make it unique+cheap)
+    _KW = dict(n_folds=2, n_task_shards=8, max_width=64, mode="auto",
+               overhead_override=0.0625, lane_cost_override=0.0017,
+               reuse=True)
+
+    def test_export_import_round_trip_marks_store_source(self):
+        # a plan persisted by "another process"
+        plan = taskgrid.plan_geometry([41], [None], **self._KW)
+        state = taskgrid.export_plan_state()
+        assert "cost_model" in state and "plans" in state
+        rec = [r for r in state["plans"] if r["key"][0] == [41]
+               and r["key"][6] == 0.0625]
+        assert rec, state["plans"]
+        json.dumps(state)                        # JSON-able end to end
+        key = taskgrid._plan_key_from_json(rec[0]["key"])
+        with taskgrid._PLAN_CACHE_LOCK:
+            taskgrid._PLAN_CACHE.pop(key, None)
+        assert taskgrid.import_plan_state(
+            json.loads(json.dumps(state))) >= 1
+        replay = taskgrid.plan_geometry([41], [None], **self._KW)
+        assert replay.source == "store"
+        assert [g.width for g in replay.groups] == \
+            [g.width for g in plan.groups]
+
+    def test_in_process_plan_always_wins_over_import(self):
+        plan = taskgrid.plan_geometry([43], [None], **self._KW)
+        state = taskgrid.export_plan_state()
+        # importing on top of a live cache seeds nothing new and the
+        # live plan keeps its provenance (widths never flap mid-process)
+        rec = [r for r in state["plans"] if r["key"][0] == [43]]
+        assert taskgrid.import_plan_state({"plans": rec}) == 0
+        again = taskgrid.plan_geometry([43], [None], **self._KW)
+        assert again.source in ("computed", "plan-cache")
+        assert [g.width for g in again.groups] == \
+            [g.width for g in plan.groups]
+
+    def test_import_skips_malformed_records(self):
+        assert taskgrid.import_plan_state(
+            {"plans": [{"key": [1, 2], "plan": {}}, {"bogus": 1}],
+             "cost_model": {"bad": "state"}}) == 0
+
+    def test_cost_model_adoption_more_observations_wins(self):
+        m = taskgrid.GeometryCostModel()
+        m.observe([{"n_tasks": 8, "dispatch_s": 0.01,
+                    "compute_s": 0.1}])
+        seen = m.n_observations
+        assert not m.load_state({"n_observations": seen - 1,
+                                 "launch_overhead_s": 9.0,
+                                 "lane_cost_s": 9.0})
+        assert m.load_state({"n_observations": seen + 50,
+                             "launch_overhead_s": 0.5,
+                             "lane_cost_s": 0.002,
+                             "compile_wall_s": 1.0})
+        assert m.launch_overhead_s == 0.5
+        assert not m.load_state({"n_observations": "NaN-ish"})
+        assert not m.load_state({"n_observations": seen + 99,
+                                 "launch_overhead_s": float("nan"),
+                                 "lane_cost_s": 0.1})
+
+
+class TestSearchIntegration:
+    def test_store_on_vs_store_off_exact_parity(self, tmp_path):
+        X, y = _data()
+        base = _fit(X, y)
+        stored = _fit(X, y, program_store_dir=str(tmp_path / "store"))
+        _assert_exact_equal(_non_time_results(base),
+                            _non_time_results(stored))
+        block = stored.search_report["programstore"]
+        assert block["enabled"] and block["publishes"] > 0
+        assert block["n_entries"] > 0 and block["store_bytes"] > 0
+
+    def test_report_block_matches_pinned_schema(self, tmp_path):
+        from spark_sklearn_tpu.obs.metrics import (
+            PROGRAMSTORE_BLOCK_SCHEMA)
+        X, y = _data()
+        gs = _fit(X, y, program_store_dir=str(tmp_path / "store"))
+        block = gs.search_report["programstore"]
+        assert set(block) == {m.name for m in PROGRAMSTORE_BLOCK_SCHEMA}
+        # store-less searches render the same keys (enabled=False)
+        off = _fit(X, y)
+        off_block = off.search_report["programstore"]
+        assert set(off_block) == set(block)
+        assert off_block["enabled"] is False
+
+    def test_reactivated_store_records_traffic(self, tmp_path):
+        """After deactivate/re-activate mints a fresh ProgramStore for
+        the same directory, cross-search cached StoredPrograms rebind
+        to it — new-signature resolutions land on the store object
+        whose counters/manifest the search reports, not the dead one."""
+        d = str(tmp_path / "store")
+        X, y = _data()
+        first = _fit(X, y, program_store_dir=d)
+        assert first.search_report["programstore"]["publishes"] > 0
+        ps.deactivate_store()
+        # new data SHAPE -> new input signature on the cached proxies
+        X2, y2 = _data(n=130)
+        second = _fit(X2, y2, program_store_dir=d)
+        block = second.search_report["programstore"]
+        assert block["misses"] > 0 and block["publishes"] > 0, block
+
+    def test_store_disabled_by_zero_budget(self, tmp_path):
+        X, y = _data()
+        gs = _fit(X, y, program_store_dir=str(tmp_path / "store"),
+                  program_store_bytes=0)
+        assert gs.search_report["programstore"]["enabled"] is False
+        assert not os.path.exists(str(tmp_path / "store"))
+
+
+#: subprocess body for the cross-process tests: one search against the
+#: store dir in argv[1], programstore block + n_compiles + scores as
+#: the last stdout line.  argv[2] optionally names a prewarm manifest
+#: to write ("-" = none).
+_CHILD = """
+import json, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+cfg = sst.TpuConfig(program_store_dir=sys.argv[1])
+sess = sst.TpuSession(config=cfg, appName="ps-test-child")
+gs = sst.GridSearchCV(LogisticRegression(max_iter=10),
+                      {"C": [0.1, 1.0, 10.0]}, cv=2, refit=False,
+                      backend="tpu", config=cfg).fit(X, y)
+if sys.argv[2] != "-":
+    sess.write_prewarm_manifest(sys.argv[2])
+print(json.dumps({"ps": gs.search_report["programstore"],
+                  "n_compiles":
+                      gs.search_report["pipeline"]["n_compiles"],
+                  "scores":
+                      gs.cv_results_["mean_test_score"].tolist()}))
+"""
+
+
+def _run_child(store_dir, manifest="-", extra_env=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(store_dir), str(manifest)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+class TestCrossProcess:
+    def test_second_cold_process_zero_compiles_exact_parity(
+            self, tmp_path):
+        first = _run_child(tmp_path)
+        assert first["ps"]["publishes"] > 0
+        second = _run_child(tmp_path)
+        assert second["ps"]["hits"] > 0 and second["ps"]["misses"] == 0
+        assert second["n_compiles"] == 0, second
+        np.testing.assert_array_equal(np.array(first["scores"]),
+                                      np.array(second["scores"]))
+
+    def test_corrupted_store_quarantines_and_recovers(self, tmp_path):
+        first = _run_child(tmp_path)
+        store = ps.ProgramStore(str(tmp_path))
+        names = _artifacts(store)
+        assert names
+        for name in names:
+            path = store.path_for(name)
+            raw = open(path, "rb").read()
+            open(path, "wb").write(raw[:max(len(raw) // 3, 16)])
+        second = _run_child(tmp_path)
+        assert second["ps"]["quarantined"] == len(names), second
+        assert second["ps"]["hits"] == 0
+        assert second["ps"]["publishes"] == len(names)   # recompiled
+        np.testing.assert_array_equal(np.array(first["scores"]),
+                                      np.array(second["scores"]))
+        # and a third process hits the republished artifacts
+        third = _run_child(tmp_path)
+        assert third["ps"]["hits"] > 0 and third["n_compiles"] == 0
+
+    def test_version_mismatch_is_miss_with_parity(self, tmp_path):
+        first = _run_child(tmp_path)
+        store = ps.ProgramStore(str(tmp_path))
+        for name in _artifacts(store):
+            _rewrite_header(store.path_for(name),
+                            lambda h: h["env"].update(jax="0.0.1-x"))
+        second = _run_child(tmp_path)
+        assert second["ps"]["hits"] == 0, second
+        assert second["ps"]["quarantined"] == 0, second
+        assert second["ps"]["misses"] > 0
+        np.testing.assert_array_equal(np.array(first["scores"]),
+                                      np.array(second["scores"]))
+
+    def test_concurrent_writers_same_key(self, tmp_path):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(tmp_path), "-"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for _ in range(2)]
+        outs = []
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            assert p.returncode == 0, stderr[-2000:]
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+        np.testing.assert_array_equal(np.array(outs[0]["scores"]),
+                                      np.array(outs[1]["scores"]))
+        # no torn temp files; the store serves a later process cleanly
+        store = ps.ProgramStore(str(tmp_path))
+        leftovers = [fn for fn in os.listdir(store._dir)
+                     if ".tmp." in fn]
+        assert not leftovers
+        third = _run_child(tmp_path)
+        assert third["ps"]["hits"] > 0 and third["n_compiles"] == 0
+
+    def test_prewarm_manifest_cold_process(self, tmp_path):
+        manifest = tmp_path / "prewarm.json"
+        first = _run_child(tmp_path, manifest=manifest)
+        assert os.path.isfile(manifest)
+        second = _run_child(
+            tmp_path, extra_env={"SST_PREWARM_MANIFEST": str(manifest)})
+        # manifest prewarm loaded the artifacts at session init: the
+        # search's own window shows memory hits and zero disk bytes
+        assert second["ps"]["prewarmed"] > 0, second
+        assert second["ps"]["hits"] > 0 and second["ps"]["misses"] == 0
+        assert second["ps"]["bytes_loaded"] == 0, second
+        assert second["n_compiles"] == 0
+        np.testing.assert_array_equal(np.array(first["scores"]),
+                                      np.array(second["scores"]))

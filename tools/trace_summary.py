@@ -163,8 +163,36 @@ def summarize(events: List[Dict[str, Any]], top: int = 12,
         "bytes_per_launch": round(h2d_bytes / n_launches, 1)
         if n_launches else 0.0,
     }
+    # compile digest from the AOT spans: the compile wall (sst-compile
+    # thread) next to the program store's traffic (programstore.load /
+    # .save spans each carry hit flags and byte counts) — the
+    # zero-cold-start observable: a prewarmed process shows hit rate
+    # 1.0 and a (near-)zero compile wall
+    compile_ms = sum(float(e["dur"]) / 1e3 for e in spans
+                     if e.get("name") == "compile")
+    store_loads = store_hits = 0
+    store_bytes_loaded = store_bytes_saved = 0
+    for e in spans:
+        args = e.get("args", {}) or {}
+        if e.get("name") == "programstore.load":
+            store_loads += 1
+            if args.get("hit"):
+                store_hits += 1
+            store_bytes_loaded += int(args.get("bytes", 0) or 0)
+        elif e.get("name") == "programstore.save":
+            store_bytes_saved += int(args.get("bytes", 0) or 0)
+    compile_digest = {
+        "compile_wall_ms": round(compile_ms, 3),
+        "store_loads": store_loads,
+        "store_hits": store_hits,
+        "store_hit_rate": round(store_hits / store_loads, 4)
+        if store_loads else 0.0,
+        "store_bytes_loaded": store_bytes_loaded,
+        "store_bytes_saved": store_bytes_saved,
+    }
     return {
         "h2d": h2d,
+        "compile": compile_digest,
         "unknown_names": sorted(unknown),
         "n_events": len(events),
         "n_spans": len(spans),
@@ -213,6 +241,14 @@ def format_summary(s: Dict[str, Any]) -> str:
             f"({h2d['bytes_per_launch'] / 1e6:.3f} MB per launch); "
             f"{h2d['bytes_tiled_on_device'] / 1e6:.3f} MB tiled "
             "on-device (no transfer)")
+    comp = s.get("compile") or {}
+    if comp.get("compile_wall_ms") or comp.get("store_loads"):
+        out.append(
+            f"compile: {comp['compile_wall_ms'] / 1e3:.2f} s wall; "
+            f"program store {comp['store_hits']}/{comp['store_loads']} "
+            f"hits ({100 * comp['store_hit_rate']:.0f}%), "
+            f"{comp['store_bytes_loaded'] / 1e6:.3f} MB loaded, "
+            f"{comp['store_bytes_saved'] / 1e6:.3f} MB published")
     return "\n".join(out)
 
 
