@@ -785,6 +785,70 @@ def leg_keyed(cache_dir=None, n_keys=1000, rows=20, d=8):
             "backend": km.backend}
 
 
+def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
+                        folds=2, max_iter=10, levels=(2, 4)):
+    """Contended multi-tenant throughput: one TpuSession, `k`
+    concurrent identical-shape searches per level, measuring aggregate
+    searches/minute and the fair-share queue-wait distribution
+    (p50/p95 from the scheduler block's bounded wait sample).  A solo
+    run first warms every program, so the contended levels measure
+    scheduling, not compilation."""
+    import numpy as np
+    from sklearn.datasets import load_digits
+    from sklearn.linear_model import LogisticRegression
+
+    import spark_sklearn_tpu as sst
+
+    X, y = load_digits(return_X_y=True)
+    X = (X[:n_rows] / 16.0).astype(np.float32)
+    y = y[:n_rows]
+    grid = {"C": np.logspace(-3, 2, n_candidates).tolist()}
+    cfg = sst.TpuConfig(compilation_cache_dir=cache_dir)
+
+    def search():
+        return sst.GridSearchCV(LogisticRegression(max_iter=max_iter),
+                                grid, cv=folds, refit=False,
+                                backend="tpu", config=cfg)
+
+    def pct(sorted_vals, p):
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1,
+                int(round(p / 100.0 * (len(sorted_vals) - 1))))
+        return round(sorted_vals[i], 6)
+
+    sess = sst.createLocalTpuSession("bench-serve")
+    out = {"shape": f"digits[{n_rows}], {n_candidates} C x {folds} "
+                    f"folds per search"}
+    try:
+        t0 = time.perf_counter()
+        sess.submit(search(), X, y).result()
+        out["solo_wall_s"] = round(time.perf_counter() - t0, 2)
+        for k in levels:
+            searches = [search() for _ in range(k)]
+            t0 = time.perf_counter()
+            futs = [sess.submit(s, X, y) for s in searches]
+            for f in futs:
+                f.result()
+            wall = time.perf_counter() - t0
+            waits = sorted(
+                w for s in searches
+                for w in s.search_report["scheduler"]["waits"])
+            interleave = [s.search_report["scheduler"]["interleave_frac"]
+                          for s in searches]
+            out[f"contended_{k}"] = {
+                "wall_s": round(wall, 2),
+                "searches_per_min": round(60.0 * k / wall, 2),
+                "queue_wait_p50_s": pct(waits, 50),
+                "queue_wait_p95_s": pct(waits, 95),
+                "interleave_frac": [round(f, 4) for f in interleave],
+                "n_queue_waits": len(waits),
+            }
+    finally:
+        sess.stop()
+    return out
+
+
 #: (detail key, leg fn, kwargs builder) for the breadth legs the TPU
 #: child runs after the headline; each failure is contained per-leg.
 _BREADTH_LEGS = [
@@ -794,6 +858,7 @@ _BREADTH_LEGS = [
     ("config4_gbr_grid", leg_config4_gbr, {}),
     ("config5_scaler_mlp", leg_config5_mlp, {}),
     ("keyed_1000models", leg_keyed, {}),
+    ("serve_contended", leg_serve_contended, {}),
 ]
 
 #: scaled-down per-leg kwargs for the BENCH_FORCE_BREADTH=1 rehearsal
@@ -814,6 +879,8 @@ _BREADTH_TOY_KWARGS = {
     "config5_scaler_mlp": dict(hidden=8, max_iter=5, folds=2,
                                alphas=(1e-3,)),
     "keyed_1000models": dict(n_keys=8, rows=10, d=3),
+    "serve_contended": dict(n_rows=96, n_candidates=16, folds=2,
+                            max_iter=5, levels=(2,)),
 }
 
 

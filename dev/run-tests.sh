@@ -30,7 +30,7 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 # instrumented: the conftest hook fails the shard on any recorded
 # acquisition-order inversion
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
-    tests/test_faults.py tests/test_sstlint.py -q
+    tests/test_faults.py tests/test_serve.py tests/test_sstlint.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -139,6 +139,62 @@ print(f"program-store smoke [{mode}]:",
 PY
 done
 rm -rf "$PS_DIR"
+
+echo "== multi-tenant smoke (two concurrent searches, one session) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+from sklearn.naive_bayes import GaussianNB
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+cfg = sst.TpuConfig(max_tasks_per_batch=16)
+grid_a = {"C": np.logspace(-2, 1, 24).tolist()}
+grid_b = {"var_smoothing": np.logspace(-9, -5, 24).tolist()}
+
+
+def sa():
+    return sst.GridSearchCV(LogisticRegression(max_iter=10), grid_a,
+                            cv=2, refit=False, backend="tpu", config=cfg)
+
+
+def sb():
+    return sst.GridSearchCV(GaussianNB(), grid_b, cv=2, refit=False,
+                            backend="tpu", config=cfg)
+
+
+ref_a, ref_b = sa().fit(X, y), sb().fit(X, y)
+sess = sst.createLocalTpuSession("serve-smoke")
+# pause the shared dispatch loop until both searches have a chunk
+# queued, so the first two dispatches provably come from different
+# searches (deterministic interleave)
+sess.executor.pause()
+fa, fb = sess.submit(sa(), X, y), sess.submit(sb(), X, y)
+t0 = time.time()
+while sess.executor.queued_count() < 2 and time.time() - t0 < 60:
+    time.sleep(0.01)
+sess.executor.resume()
+a, b = fa.result(timeout=300), fb.result(timeout=300)
+np.testing.assert_array_equal(a.cv_results_["mean_test_score"],
+                              ref_a.cv_results_["mean_test_score"])
+np.testing.assert_array_equal(b.cv_results_["mean_test_score"],
+                              ref_b.cv_results_["mean_test_score"])
+scha, schb = a.search_report["scheduler"], b.search_report["scheduler"]
+assert scha["enabled"] and schb["enabled"]
+assert scha["interleave_frac"] > 0 or schb["interleave_frac"] > 0, \
+    (scha, schb)
+sess.stop()
+print("serve smoke:",
+      {k: scha[k] for k in ("n_dispatches", "interleave_frac",
+                            "queue_wait_s")},
+      {k: schb[k] for k in ("n_dispatches", "interleave_frac",
+                            "queue_wait_s")})
+PY
 
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'

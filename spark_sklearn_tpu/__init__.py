@@ -32,10 +32,20 @@ from spark_sklearn_tpu.utils.session import (
     createLocalTpuSession,
     init_distributed,
 )
+from spark_sklearn_tpu.serve import (
+    AdmissionError,
+    SearchCancelledError,
+    SearchExecutor,
+    SearchFuture,
+)
 
 __all__ = [
     "GridSearchCV",
     "RandomizedSearchCV",
+    "AdmissionError",
+    "SearchCancelledError",
+    "SearchExecutor",
+    "SearchFuture",
     "Converter",
     "KeyedEstimator",
     "KeyedModel",

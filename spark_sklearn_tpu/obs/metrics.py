@@ -32,6 +32,7 @@ __all__ = [
     "DATAPLANE_BLOCK_SCHEMA",
     "GEOMETRY_BLOCK_SCHEMA",
     "PROGRAMSTORE_BLOCK_SCHEMA",
+    "SCHEDULER_BLOCK_SCHEMA",
     "search_registry",
     "schema_markdown",
 ]
@@ -140,6 +141,14 @@ SEARCH_REPORT_SCHEMA = (
         "and the store's end-of-search state "
         "(parallel/programstore.py)."),
     MetricDef(
+        "scheduler", "struct",
+        "The multi-tenant fair-share executor's per-search view (see "
+        "the scheduler-block schema below): queue waits, interleave "
+        "fraction and measured tenant shares when the search was "
+        "submitted to a TpuSession's SearchExecutor; the zeroed "
+        "enabled=False shape for a standalone fit "
+        "(serve/executor.py)."),
+    MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
         backends="host"),
@@ -170,6 +179,11 @@ PIPELINE_BLOCK_SCHEMA = (
               "Sum of blocking device->host transfer walls."),
     MetricDef("finalize_wall_s", "gauge",
               "Sum of result-write/checkpoint walls."),
+    MetricDef("queue_wait_wall_s", "gauge",
+              "Sum of multi-tenant fair-share queue waits across "
+              "launches (serve/executor.py; subtracted out of "
+              "dispatch_wall_s so contention never poisons the "
+              "geometry cost model)."),
     MetricDef("overlap_frac", "gauge",
               "Host work hidden behind device compute, as a fraction "
               "of all host work."),
@@ -351,6 +365,57 @@ FAULTS_BLOCK_SCHEMA = (
               "Host tier only: the exception type (and truncated "
               "message) that made the compiled tier fall back to the "
               "host backend, when the search started compiled."),
+)
+
+
+#: sub-keys of ``search_report["scheduler"]`` (written by
+#: ``serve.executor.report_block`` / ``SearchExecutor.search_block``) —
+#: the multi-tenant fair-share executor's per-search view.
+SCHEDULER_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Whether the search ran under a session's fair-share "
+              "executor (TpuSession.submit / attach); False for a "
+              "standalone fit, with every other key zeroed."),
+    MetricDef("tenant", "label",
+              "The search's tenant id (TpuConfig.tenant / SST_TENANT; "
+              "'default' when unset)."),
+    MetricDef("handle", "label",
+              "The executor-assigned search handle id "
+              "(tenant/s<sequence>)."),
+    MetricDef("weight", "gauge",
+              "The tenant's fair-share weight "
+              "(TpuConfig.tenant_weight / SST_TENANT_WEIGHT)."),
+    MetricDef("n_dispatches", "counter",
+              "Chunk dispatches the search issued through the "
+              "executor (queued + fastpath)."),
+    MetricDef("n_fastpath", "counter",
+              "Dispatches short-circuited inline because this was the "
+              "only active search with empty queues — the solo-search "
+              "zero-overhead path."),
+    MetricDef("n_interleaved", "counter",
+              "Dispatches immediately preceded on the shared dispatch "
+              "stream by a DIFFERENT search's dispatch."),
+    MetricDef("interleave_frac", "gauge",
+              "n_interleaved / n_dispatches — > 0 proves the device "
+              "stream interleaved this search's chunks with "
+              "concurrent searches'."),
+    MetricDef("queue_wait_s", "gauge",
+              "Total time the search's chunks waited in the "
+              "fair-share queue before dispatch."),
+    MetricDef("queue_wait_mean_s", "gauge",
+              "Mean queue wait per routed (non-fastpath) dispatch."),
+    MetricDef("queue_wait_max_s", "gauge",
+              "Worst single queue wait."),
+    MetricDef("share_frac", "gauge",
+              "This search's dispatched task-cost share of ALL cost "
+              "dispatched during its active window."),
+    MetricDef("tenant_shares", "struct",
+              "Measured per-tenant dispatched-cost shares over this "
+              "search's active window — under contention these track "
+              "the configured tenant weights."),
+    MetricDef("waits", "series",
+              "Per routed dispatch: seconds waited in the queue "
+              "(bounded sample; bench derives p50/p95 from it)."),
 )
 
 
@@ -553,5 +618,13 @@ def schema_markdown() -> str:
     out.append("\n### `search_report[\"geometry\"]` block\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in GEOMETRY_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"programstore\"]` block\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in PROGRAMSTORE_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"scheduler\"]` block\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in SCHEDULER_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     return "".join(out)

@@ -115,6 +115,15 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "OOM recovery: chunk bisected into half-width launches."),
     SpanDef("launch.host_fallback", "span", "parallel.faults",
             "OOM recovery bottomed out into per-candidate host runs."),
+    # serve/executor.py
+    SpanDef("serve.submit", "span", "serve.executor",
+            "Admission + enqueue of one submitted search."),
+    SpanDef("sched.queue.wait", "span", "serve.executor",
+            "A search's dispatch blocked while its chunk waits in the "
+            "multi-tenant fair-share queue."),
+    SpanDef("sched.dispatch", "span", "serve.executor",
+            "One routed chunk launch enqueued on the shared "
+            "sst-dispatch loop (carries tenant, handle, cost)."),
     # utils/session.py
     SpanDef("session.init", "span", "utils.session",
             "TpuSession bootstrap (mesh, caches, fault plan)."),

@@ -149,8 +149,9 @@ class DataPlane:
 
     def __init__(self, byte_budget: int = DEFAULT_BYTE_BUDGET):
         self._lock = named_rlock("dataplane.DataPlane._lock")
-        #: key -> (device array, nbytes)
-        self._entries: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
+        #: key -> (device array, nbytes, tenant)
+        self._entries: "OrderedDict[Any, Tuple[Any, int, Any]]" = \
+            OrderedDict()
         self._bytes = 0
         self.byte_budget = int(byte_budget)
         self.hits = 0
@@ -160,6 +161,15 @@ class DataPlane:
         self.bytes_tiled = 0          # device-side tile materializations
         #: compiled tile programs keyed by (shape, dtype, reps, sharding)
         self._tile_programs: Dict[Any, Any] = {}
+        #: multi-tenant accounting (serve/executor.py): per-tenant byte
+        #: quotas and current charged usage.  Entries uploaded with a
+        #: tenant are charged to it; a tenant over quota evicts its OWN
+        #: LRU entries, and the global budget pass prefers victims that
+        #: are unowned, the inserter's own, or over-quota — so one
+        #: tenant's pressure cannot evict another's resident X/y while
+        #: that tenant stays within its quota.
+        self._tenant_quotas: Dict[Any, int] = {}
+        self._tenant_bytes: Dict[Any, int] = {}
 
     # -- sizing ----------------------------------------------------------
     def configure(self, byte_budget: Optional[int]) -> "DataPlane":
@@ -172,13 +182,43 @@ class DataPlane:
             self._evict_over_budget()
         return self
 
-    def _evict_over_budget(self, keep: Any = None) -> None:
+    def _pop_entry(self, key) -> None:
+        with self._lock:
+            _, nbytes, tenant = self._entries.pop(key)
+            self._bytes -= nbytes
+            if tenant is not None:
+                left = self._tenant_bytes.get(tenant, 0) - nbytes
+                if left > 0:
+                    self._tenant_bytes[tenant] = left
+                else:
+                    self._tenant_bytes.pop(tenant, None)
+            self.evictions += 1
+
+    def _over_quota(self, tenant) -> bool:
+        quota = self._tenant_quotas.get(tenant)
+        return bool(quota) and self._tenant_bytes.get(tenant, 0) > quota
+
+    def _evict_over_budget(self, keep: Any = None,
+                           inserting: Any = None) -> None:
         # every caller already holds the (reentrant) plane lock; taking
         # it again makes the helper safe on its own rather than by
         # call-site convention
         with self._lock:
             while self._bytes > self.byte_budget and len(self._entries) > 1:
-                key = next(iter(self._entries))
+                # tenant isolation: prefer victims that are unowned,
+                # the inserter's own, or belong to an over-quota
+                # tenant; a tenant within its quota is only evicted by
+                # global pressure when no such victim exists (e.g. the
+                # quotas were configured to exceed the plane budget)
+                key = None
+                for k, (_, _, t) in self._entries.items():
+                    if k == keep:
+                        continue
+                    if t is None or t == inserting or self._over_quota(t):
+                        key = k
+                        break
+                if key is None:
+                    key = next(iter(self._entries))
                 if key == keep:
                     # never evict the entry being returned; rotate it to
                     # the MRU end and take the next-oldest instead
@@ -186,9 +226,7 @@ class DataPlane:
                     key = next(iter(self._entries))
                     if key == keep:
                         break
-                _, nbytes = self._entries.pop(key)
-                self._bytes -= nbytes
-                self.evictions += 1
+                self._pop_entry(key)
         # a single oversized entry may exceed the budget on its own; it
         # stays (dropping it would force a re-upload every search) and
         # becomes the next LRU victim
@@ -203,14 +241,66 @@ class DataPlane:
                 return hit[0]
             return None
 
-    def _insert(self, key, value, nbytes: int):
+    def _insert(self, key, value, nbytes: int, tenant: Any = None):
         with self._lock:
-            if key not in self._entries:
-                self._entries[key] = (value, int(nbytes))
-                self._bytes += int(nbytes)
-                self._evict_over_budget(keep=key)
+            if key in self._entries:
+                return
+            # per-tenant quota: a tenant exceeding its own quota evicts
+            # its OWN least-recently-used residents first — other
+            # tenants' entries are untouchable here by construction
+            quota = self._tenant_quotas.get(tenant)
+            if tenant is not None and quota:
+                while self._tenant_bytes.get(tenant, 0) + int(nbytes) \
+                        > quota:
+                    victim = next(
+                        (k for k, (_, _, t) in self._entries.items()
+                         if t == tenant), None)
+                    if victim is None:
+                        break
+                    self._pop_entry(victim)
+            self._entries[key] = (value, int(nbytes), tenant)
+            self._bytes += int(nbytes)
+            if tenant is not None:
+                self._tenant_bytes[tenant] = \
+                    self._tenant_bytes.get(tenant, 0) + int(nbytes)
+            self._evict_over_budget(keep=key, inserting=tenant)
 
-    def put(self, arr: np.ndarray, sharding, label: str = "array"):
+    # -- multi-tenant quotas ---------------------------------------------
+    def set_tenant_quota(self, tenant, nbytes: int) -> None:
+        """Register (or update) a tenant's resident byte quota.  New
+        inserts charged to the tenant evict its own LRU entries beyond
+        it; 0/None removes the quota (usage accounting remains)."""
+        with self._lock:
+            if nbytes:
+                self._tenant_quotas[tenant] = int(nbytes)
+            else:
+                self._tenant_quotas.pop(tenant, None)
+
+    def tenant_usage(self, tenant) -> int:
+        """Bytes currently resident and charged to ``tenant``."""
+        with self._lock:
+            return self._tenant_bytes.get(tenant, 0)
+
+    def release_tenant(self, tenant) -> int:
+        """Release a tenant's plane charge (a cancelled or finished
+        tenant's last search): its entries become unowned — first in
+        line for LRU eviction, but still servable as hits while they
+        survive — its usage resets to zero and its quota is dropped.
+        Returns the byte count released."""
+        with self._lock:
+            released = 0
+            for k in list(self._entries):
+                value, nbytes, t = self._entries[k]
+                if t == tenant:
+                    self._entries[k] = (value, nbytes, None)
+                    self._entries.move_to_end(k, last=False)
+                    released += nbytes
+            self._tenant_bytes.pop(tenant, None)
+            self._tenant_quotas.pop(tenant, None)
+            return released
+
+    def put(self, arr: np.ndarray, sharding, label: str = "array",
+            tenant: Any = None):
         """The cached ``device_put``: returns the resident device array
         for this (content, sharding), uploading at most once while the
         entry survives the budget.
@@ -229,18 +319,19 @@ class DataPlane:
             self.misses += 1
             self.bytes_uploaded += int(arr.nbytes)
             dev = upload(arr, sharding, label=label)
-            self._insert(key, dev, arr.nbytes)
+            self._insert(key, dev, arr.nbytes, tenant=tenant)
             return dev
 
-    def zeros(self, n: int, dtype, sharding):
+    def zeros(self, n: int, dtype, sharding, tenant: Any = None):
         """Cached all-zero launch operand (the all-static group's
         ``_pad`` axis definition) — uploaded once per (n, dtype,
         sharding), never per launch."""
         host = np.zeros(int(n), dtype=dtype)
-        return self.put(host, sharding, label="zeros")
+        return self.put(host, sharding, label="zeros", tenant=tenant)
 
     def tiled(self, base: np.ndarray, base_dev, reps: int, out_sharding,
-              label: str = "mask.tiled", fp: Optional[str] = None):
+              label: str = "mask.tiled", fp: Optional[str] = None,
+              tenant: Any = None):
         """Device-tiled ``(reps * rows, cols)`` view of ``base`` — the
         on-device replacement for host ``np.tile`` + upload.
 
@@ -272,7 +363,7 @@ class DataPlane:
                                    reps=int(reps), label=label):
                 dev = tile_fn(base_dev)
             self.bytes_tiled += nbytes
-            self._insert(key, dev, nbytes)
+            self._insert(key, dev, nbytes, tenant=tenant)
             return dev
 
     # -- introspection ---------------------------------------------------
@@ -303,6 +394,8 @@ class DataPlane:
             self._entries.clear()
             self._bytes = 0
             self._tile_programs.clear()
+            self._tenant_bytes.clear()
+            self._tenant_quotas.clear()
 
 
 _PLANE: Optional[DataPlane] = None

@@ -547,6 +547,10 @@ class LaunchSupervisor:
                                        group=group, attempt=attempt):
                     return fn()
             except Exception as exc:
+                if getattr(exc, "_sst_cancelled", False):
+                    # a cancelled search (serve.SearchCancelledError) is
+                    # an instruction, not a fault: no retry, no event
+                    raise
                 cls = classify_error(exc)
                 if cls != TRANSIENT:
                     if cls != OOM:
@@ -600,6 +604,12 @@ class LaunchSupervisor:
     def _recover(self, st: Dict[str, Any], exc: Exception):
         item = st["item"]
         while True:
+            if getattr(exc, "_sst_cancelled", False):
+                # cancellation (serve.SearchFuture.cancel) must unwind
+                # the search promptly: no retry budget, no recovery
+                # hooks, no fault journal entry — the checkpoint's
+                # completed chunks already make the search resumable
+                raise exc
             cls = classify_error(exc)
             if cls == FATAL:
                 # a real bug: propagate unchanged (the search engine's

@@ -186,6 +186,39 @@ class TpuConfig:
     # to SST_PROGRAM_STORE_BYTES (default 512 MiB); 0 disables the
     # store entirely.
     program_store_bytes: Optional[int] = None
+    # ---- multi-tenant search service (serve/executor.py) ----
+    # tenant identity of searches run under this config: concurrent
+    # searches submitted to one TpuSession fair-share the device by
+    # tenant (deficit round-robin over per-tenant chunk queues).  None
+    # defers to SST_TENANT, then "default".
+    tenant: Optional[str] = None
+    # fair-share weight of this config's tenant: a weight-3 tenant is
+    # granted 3x the dispatched task share of a weight-1 tenant while
+    # both have chunks queued.  None defers to SST_TENANT_WEIGHT, then
+    # 1.0.
+    tenant_weight: Optional[float] = None
+    # admission control: how many searches may run concurrently in the
+    # session's executor; beyond it submissions queue (up to
+    # max_queued_searches) and then reject with a clean AdmissionError.
+    max_concurrent_searches: int = 8
+    # bounded submission queue: searches waiting for a concurrency slot
+    # beyond this count are rejected at submit() time.
+    max_queued_searches: int = 16
+    # per-tenant cap on chunks in flight (dispatched, not yet
+    # finalized) across ALL of the tenant's concurrent searches; the
+    # scheduler skips a capped tenant until a chunk completes.
+    # 0 = unbounded (the per-search pipeline_depth still bounds each
+    # search on its own).
+    tenant_max_inflight: int = 0
+    # deficit-round-robin quantum in cost units (one unit = one real
+    # (candidate x fold) task of a chunk): per scheduling round each
+    # tenant accumulates quantum x tenant_weight of dispatch credit.
+    scheduler_quantum: int = 64
+    # per-tenant byte quota in the device data plane: a tenant over its
+    # quota evicts its OWN least-recently-used resident arrays, never
+    # another tenant's (parallel/dataplane.py).  0 = no per-tenant
+    # quota (the global dataplane_bytes budget still applies).
+    dataplane_tenant_bytes: int = 0
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

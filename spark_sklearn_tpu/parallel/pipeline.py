@@ -178,6 +178,11 @@ class LaunchTimings:
     gather_s: float = 0.0
     finalize_s: float = 0.0
     stage_bytes: int = 0      # host->device bytes the stage transferred
+    #: time this launch's dispatch spent waiting in the multi-tenant
+    #: fair-share queue (serve/executor.py) — subtracted out of
+    #: dispatch_s by the executor's item wrapper so contention never
+    #: poisons the geometry cost model's launch-overhead estimate
+    queue_wait_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -326,6 +331,8 @@ class ChunkPipeline:
             "n_launches": len(tl),
             "wall_s": round(wall, 4),
             **{k: round(v, 4) for k, v in walls.items()},
+            "queue_wait_wall_s": round(
+                sum(t.get("queue_wait_s", 0.0) for t in tl), 4),
             "overlap_frac": round(overlap, 4),
             "n_precompiled": self._n_precompiled,
             "stage_bytes_total": sum(
@@ -351,6 +358,7 @@ class ChunkPipeline:
             "stage_bytes": int(tm.stage_bytes),
             "stage_s": round(tm.stage_s, 6),
             "stage_wait_s": round(tm.stage_wait_s, 6),
+            "queue_wait_s": round(tm.queue_wait_s, 6),
             "dispatch_s": round(tm.dispatch_s, 6),
             "compute_s": round(tm.compute_s, 6),
             "gather_s": round(tm.gather_s, 6),

@@ -63,7 +63,7 @@ from spark_sklearn_tpu.search.scorers import (
     build_view,
     resolve_scoring,
 )
-from spark_sklearn_tpu.utils.locks import named_lock
+from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
 from spark_sklearn_tpu.utils.native import fold_masks
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.metrics import search_registry
@@ -88,24 +88,31 @@ def _freeze(obj):
 #: and device constants, so the bound is per-family as well as global — a
 #: long-lived process cycling many shapes of ONE family can at worst evict
 #: its own older programs, never another family's entire working set.
+#: CONCURRENT searches (serve/executor.py) hit this cache from several
+#: worker threads, so every read-modify-write runs under the rlock;
+#: program construction itself stays outside it (builds may take the
+#: programstore's own locks, and two racing builders just keep the
+#: first-inserted program).
 _PROGRAM_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _PROGRAM_CACHE_MAX = 128
 _PROGRAM_CACHE_MAX_PER_FAMILY = 32
 _PROGRAM_CACHE_FAMILY_COUNTS: Dict[Any, int] = defaultdict(int)
+_PROGRAM_CACHE_LOCK = named_rlock("grid._PROGRAM_CACHE_LOCK")
 
 
 def _cache_evict(fam=None):
     """Drop the least-recently-used entry (of `fam` if given, else global)."""
-    victim = None
-    if fam is not None:
-        victim = next((k for k, (_, f) in _PROGRAM_CACHE.items() if f == fam),
-                      None)
-    if victim is None:
-        victim = next(iter(_PROGRAM_CACHE))
-    _, vfam = _PROGRAM_CACHE.pop(victim)
-    _PROGRAM_CACHE_FAMILY_COUNTS[vfam] -= 1
-    if _PROGRAM_CACHE_FAMILY_COUNTS[vfam] <= 0:
-        del _PROGRAM_CACHE_FAMILY_COUNTS[vfam]
+    with _PROGRAM_CACHE_LOCK:
+        victim = None
+        if fam is not None:
+            victim = next((k for k, (_, f) in _PROGRAM_CACHE.items()
+                           if f == fam), None)
+        if victim is None:
+            victim = next(iter(_PROGRAM_CACHE))
+        _, vfam = _PROGRAM_CACHE.pop(victim)
+        _PROGRAM_CACHE_FAMILY_COUNTS[vfam] -= 1
+        if _PROGRAM_CACHE_FAMILY_COUNTS[vfam] <= 0:
+            del _PROGRAM_CACHE_FAMILY_COUNTS[vfam]
 #: launches per compile group under convergence-sorted chunking — enough
 #: grading that easy launches early-exit well below max_iter, few enough
 #: that each launch stays matmul-wide
@@ -149,23 +156,27 @@ def _cached_program(key, build, store_parts=None, store=None):
         # a later store-less search must not consult the store through
         # a stale proxy (nor the reverse)
         k = (k, "__programstore__", store.directory)
-    hit = _PROGRAM_CACHE.get(k)
+    with _PROGRAM_CACHE_LOCK:
+        hit = _PROGRAM_CACHE.get(k)
+        if hit is not None:
+            _PROGRAM_CACHE.move_to_end(k)
     if hit is not None:
-        _PROGRAM_CACHE.move_to_end(k)
         if store is not None:
             # a deactivate/re-activate cycle minted a fresh store
             # object for the same directory: repoint the cached proxy
             # so traffic lands on the store whose counters/manifest
-            # this search reports
+            # this search reports (outside the cache lock: rebind
+            # takes the store's own)
             rebind = getattr(hit[0], "rebind", None)
             if rebind is not None:
                 rebind(store)
         return hit[0]
     fam = key[1] if isinstance(key, tuple) and len(key) > 1 else None
-    if _PROGRAM_CACHE_FAMILY_COUNTS.get(fam, 0) >= _PROGRAM_CACHE_MAX_PER_FAMILY:
-        _cache_evict(fam)
-    elif len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
-        _cache_evict()
+    # build OUTSIDE the lock: tracing/wrapping may take programstore
+    # locks, and a slow build must not stall every concurrent search's
+    # cache lookups.  Two racing builders of the same key are benign —
+    # the first insert wins below and the loser's identical program is
+    # dropped (its _count_build still ran: both really traced).
     fn = build()
     if store is not None:
         from spark_sklearn_tpu.parallel import programstore as _ps
@@ -176,8 +187,18 @@ def _cached_program(key, build, store_parts=None, store=None):
         fn = wrapped
     else:
         _count_build()
-    _PROGRAM_CACHE[k] = (fn, fam)
-    _PROGRAM_CACHE_FAMILY_COUNTS[fam] += 1
+    with _PROGRAM_CACHE_LOCK:
+        raced = _PROGRAM_CACHE.get(k)
+        if raced is not None:
+            _PROGRAM_CACHE.move_to_end(k)
+            return raced[0]
+        if _PROGRAM_CACHE_FAMILY_COUNTS.get(fam, 0) >= \
+                _PROGRAM_CACHE_MAX_PER_FAMILY:
+            _cache_evict(fam)
+        elif len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            _cache_evict()
+        _PROGRAM_CACHE[k] = (fn, fam)
+        _PROGRAM_CACHE_FAMILY_COUNTS[fam] += 1
     return fn
 
 
@@ -461,6 +482,17 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         return router
 
     def fit(self, X, y=None, **params):
+        # a session-attached search (TpuSession.attach) is sugar for
+        # submit + wait: fit routes through the session's fair-share
+        # executor, sharing the device with any concurrently-submitted
+        # searches.  Inside an executor worker (current_binding set)
+        # this IS the submitted fit, so it runs the real path below —
+        # unattached searches are untouched.
+        session = getattr(self, "_sst_session", None)
+        if session is not None:
+            from spark_sklearn_tpu import serve as _serve
+            if _serve.current_binding() is None:
+                return session.submit(self, X, y, **params).result()
         # teardown of attached callbacks is guaranteed even when fit
         # raises (sklearn wraps fit the same way via _fit_context)
         with callback_management_context(self):
@@ -1139,10 +1171,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         from spark_sklearn_tpu.parallel import dataplane as _dataplane
         plane = _dataplane.plane_for(config)
         dp_before = _dataplane.snapshot_counters(plane)
+        # a search submitted through a session's SearchExecutor charges
+        # its broadcast residents to its tenant's data-plane quota
+        from spark_sklearn_tpu import serve as _serve
+        _binding = _serve.current_binding()
+        _tenant = _binding.tenant if _binding is not None else None
 
         def _bput(v, sharding, label):
             if plane is not None:
-                return plane.put(v, sharding, label=label)
+                return plane.put(v, sharding, label=label,
+                                 tenant=_tenant)
             return _dataplane.upload(v, sharding, label=label)
 
         _t_upload0 = time.perf_counter()
@@ -1496,6 +1534,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # buffers instead of allocating per chunk)
         from spark_sklearn_tpu.parallel import dataplane as _dataplane
         plane = _dataplane.plane_for(config)
+        # the multi-tenant executor binding (serve/executor.py): set
+        # when this search was submitted to a TpuSession's
+        # SearchExecutor — its LaunchItems then route through the
+        # session's shared fair-share dispatch queue, and its plane
+        # uploads are charged to its tenant
+        from spark_sklearn_tpu import serve as _serve
+        binding = _serve.current_binding()
+        sched_tenant = binding.tenant if binding is not None else None
         # multi-controller runs force depth 0 below; resolved here so
         # the staging ring can size itself to the in-flight window
         depth = config.pipeline_depth if jax.process_count() == 1 else 0
@@ -1889,7 +1935,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     w = plan["w_task_dev"] = plane.tiled(
                         fit_masks, fit_dev, plan["nc_batch"],
                         tb_mask_shard, label="mask.fit.tiled",
-                        fp=fit_masks_fp())
+                        fp=fit_masks_fp(), tenant=sched_tenant)
                 return w
             w = plan.get("w_task_dev")
             if w is None:
@@ -2037,7 +2083,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         task_shard, label="dyn.recover")
                 if not dyn and not task_batched:
                     dyn["_pad"] = (
-                        plane.zeros(width, dtype, task_shard)
+                        plane.zeros(width, dtype, task_shard,
+                                    tenant=sched_tenant)
                         if plane is not None and not donate else
                         _dataplane.upload(np.zeros(width, dtype=dtype),
                                           task_shard, label="dyn.pad"))
@@ -2049,7 +2096,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     w = (plane.tiled(fit_masks, fit_dev, width,
                                      tb_mask_shard,
                                      label="mask.fit.tiled",
-                                     fp=fit_masks_fp())
+                                     fp=fit_masks_fp(),
+                                     tenant=sched_tenant)
                          if plane is not None else
                          _dataplane.upload(
                              np.tile(fit_masks, (width, 1)),
@@ -2232,7 +2280,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             # launch that consumed it
                             dyn["_pad"] = (
                                 plane.zeros(plan["nc_batch"], dtype,
-                                            task_shard)
+                                            task_shard,
+                                            tenant=sched_tenant)
                                 if plane is not None and not donate else
                                 _dataplane.upload(
                                     np.zeros(plan["nc_batch"],
@@ -2462,9 +2511,22 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         supervisor = LaunchSupervisor(
             config, faults=metrics.struct("faults"), ckpt=ckpt,
             verbose=self.verbose)
+        items = chunk_items()
+        if binding is not None:
+            # executor wrapping sits UNDER the supervisor: a routed
+            # launch that fails re-enters the supervisor on THIS
+            # search's threads (retries re-queue fairly; one tenant's
+            # OOM bisection never blocks the shared dispatch loop)
+            binding.executor.note_planned(
+                binding.handle, sum(p["n_live"] for p in plans))
+            items = binding.executor.wrap_items(binding.handle, items)
         try:
-            pipe.run(supervisor.wrap(chunk_items()))
+            pipe.run(supervisor.wrap(items))
         finally:
+            # the scheduler's per-search view (queue waits, interleave,
+            # measured tenant shares) — zeroed enabled=False shape for
+            # a standalone fit, so the report schema never changes
+            metrics.put("scheduler", _serve.report_block(binding))
             # the compile thread traces under this search's jax config
             # (e.g. temporarily-enabled x64): join it before returning
             pipe.close()

@@ -1,0 +1,41 @@
+"""Multi-tenant search serving — ``TpuSession.submit`` and the async
+fair-share executor.
+
+Public surface::
+
+    session = createLocalTpuSession()
+    fut_a = session.submit(search_a, X, y)        # tenant "default"
+    fut_b = session.submit(search_b, X, y)        # interleaves with a
+    search_a = fut_a.result()                     # fitted estimator
+    fut_b.cancel()                                # drains, resumable
+
+See :mod:`spark_sklearn_tpu.serve.executor` for the architecture
+(deficit-round-robin fair share, admission control, tenant byte
+quotas, cancellation) and the ``search_report["scheduler"]`` block.
+"""
+
+from spark_sklearn_tpu.serve.executor import (
+    DEFAULT_TENANT,
+    AdmissionError,
+    SearchCancelledError,
+    SearchExecutor,
+    SearchFuture,
+    SearchHandle,
+    current_binding,
+    report_block,
+    resolve_tenant,
+    resolve_weight,
+)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "AdmissionError",
+    "SearchCancelledError",
+    "SearchExecutor",
+    "SearchFuture",
+    "SearchHandle",
+    "current_binding",
+    "report_block",
+    "resolve_tenant",
+    "resolve_weight",
+]

@@ -68,6 +68,13 @@ class TpuSession:
             # session construction, not halfway through a long search
             from spark_sklearn_tpu.parallel.faults import FaultPlan
             self.fault_plan = FaultPlan.resolve(self.config)
+            # the multi-tenant search service (serve/executor.py): the
+            # session owns ONE fair-share executor; submit() routes
+            # searches through it.  Construction is thread-free — the
+            # sst-dispatch loop and worker threads only exist once a
+            # search is actually submitted
+            from spark_sklearn_tpu.serve import SearchExecutor
+            self.executor = SearchExecutor(self.config, appName)
         # structured logging channel (never stdout: the session has no
         # legacy print contract)
         logger.info("TpuSession %r: mesh=%s, cache_dir=%r", appName,
@@ -98,6 +105,37 @@ class TpuSession:
     @property
     def n_devices(self) -> int:
         return self.mesh.size
+
+    # -- multi-tenant serving (serve/executor.py) ------------------------
+    def submit(self, search, X, y=None, **fit_params):
+        """Submit a search to the session's fair-share executor and
+        return a :class:`~spark_sklearn_tpu.serve.SearchFuture`
+        (``result()`` / ``cancel()`` / ``progress()``).
+
+        Concurrent submissions interleave their chunk launches on the
+        device under deficit-round-robin fair share over tenants
+        (``TpuConfig(tenant, tenant_weight)``), with admission control
+        (``max_concurrent_searches`` / ``max_queued_searches`` ->
+        :class:`~spark_sklearn_tpu.serve.AdmissionError`) and
+        per-tenant data-plane byte quotas on top.  Every search's
+        ``cv_results_`` is bit-exact with its solo ``fit``; a single
+        submitted search short-circuits to the solo dispatch path."""
+        return self.executor.submit(search, X, y,
+                                    fit_params=fit_params)
+
+    def attach(self, search):
+        """Bind a search estimator to this session: its ``fit`` becomes
+        sugar for ``submit(...).result()`` — identical results, routed
+        through the session's executor so it fair-shares the device
+        with concurrently-submitted searches.  Returns the search for
+        chaining."""
+        search._sst_session = self
+        return search
+
+    def executor_stats(self) -> dict:
+        """The executor's live state: active/pending search counts and
+        per-tenant queue/in-flight/dispatched-cost tallies."""
+        return self.executor.stats()
 
     def dataplane_stats(self) -> dict:
         """Cumulative hit/miss/byte counters of the session's device
@@ -159,8 +197,11 @@ class TpuSession:
                 "with TpuConfig(trace='out.json')")
         return export_chrome_trace(target)
 
-    def stop(self):  # reference API symmetry (SparkSession.stop)
-        pass
+    def stop(self):
+        """Shut the session's search executor down (reference API
+        symmetry: SparkSession.stop).  Running searches finish, the
+        waiting line cancels, new submissions raise AdmissionError."""
+        self.executor.shutdown()
 
     def __repr__(self):
         return (f"TpuSession(appName={self.appName!r}, "

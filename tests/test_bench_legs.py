@@ -95,6 +95,16 @@ class TestLegsToyShapes:
         _assert_finite(d, ["wall_s", "models_per_sec"])
         assert d["backend"]
 
+    def test_serve_contended(self):
+        d = bench.leg_serve_contended(n_rows=96, n_candidates=16,
+                                      folds=2, max_iter=5, levels=(2,))
+        _assert_finite(d, ["solo_wall_s"])
+        c2 = d["contended_2"]
+        _assert_finite(c2, ["wall_s", "searches_per_min",
+                            "queue_wait_p50_s", "queue_wait_p95_s"])
+        assert len(c2["interleave_frac"]) == 2
+        assert c2["queue_wait_p95_s"] >= c2["queue_wait_p50_s"]
+
 
 def _last_json_line(stdout):
     return bench._parse_last_json_line(stdout)

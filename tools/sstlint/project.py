@@ -137,6 +137,24 @@ class Project:
                 # grid: per-plan staged-chunk id sets
                 SharedState("search/grid.py", "grid.stage_lock",
                             taint_key="staged_ids"),
+                # grid: the cross-search program cache, hit by every
+                # concurrent search's worker + compile threads
+                SharedState("search/grid.py", "grid._PROGRAM_CACHE_LOCK",
+                            name="_PROGRAM_CACHE"),
+                SharedState("search/grid.py", "grid._PROGRAM_CACHE_LOCK",
+                            name="_PROGRAM_CACHE_FAMILY_COUNTS"),
+                # serve: the fair-share executor's scheduler state
+                SharedState("serve/executor.py",
+                            "serve.SearchExecutor._lock",
+                            cls="SearchExecutor",
+                            attrs=("_tenants", "_active", "_pending",
+                                   "_workers", "_rr", "_seq",
+                                   "_last_handle", "_cost_by_tenant",
+                                   "_dispatch_log")),
+                # dataplane: per-tenant quota/usage accounting
+                SharedState("parallel/dataplane.py",
+                            "dataplane.DataPlane._lock", cls="DataPlane",
+                            attrs=("_tenant_quotas", "_tenant_bytes")),
                 # obs/log: the logger cache
                 SharedState("obs/log.py", "log._LOGGERS_LOCK",
                             name="_LOGGERS"),
@@ -161,10 +179,17 @@ class Project:
                     Producer("subscript-var", "search/grid.py",
                              "faults"),
                 )),
+                BlockSpec("scheduler", "SCHEDULER_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "serve/executor.py",
+                             "report_block"),
+                    Producer("dict-keys", "serve/executor.py",
+                             "SearchExecutor.search_block"),
+                )),
             ),
             launch_paths=(
                 "parallel/faults.py",
                 "parallel/pipeline.py",
+                "serve/executor.py",
                 "search/grid.py::_dispatch",
                 "search/grid.py::submit_precompile",
                 "search/grid.py::resolve_fused",
