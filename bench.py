@@ -788,9 +788,10 @@ def leg_keyed(cache_dir=None, n_keys=1000, rows=20, d=8):
 def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                         folds=2, max_iter=10, levels=(2, 4)):
     """Contended multi-tenant throughput: one TpuSession, `k`
-    concurrent identical-shape searches per level, measuring aggregate
-    searches/minute and the fair-share queue-wait distribution
-    (p50/p95 from the scheduler block's bounded wait sample).  A solo
+    concurrent identical-shape searches per level — each under its OWN
+    tenant — measuring aggregate searches/minute and the fair-share
+    queue-wait distribution both in aggregate and PER TENANT (p50/p95
+    from the scheduler block's tenant-stamped wait sample).  A solo
     run first warms every program, so the contended levels measure
     scheduling, not compilation."""
     import numpy as np
@@ -803,9 +804,10 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
     X = (X[:n_rows] / 16.0).astype(np.float32)
     y = y[:n_rows]
     grid = {"C": np.logspace(-3, 2, n_candidates).tolist()}
-    cfg = sst.TpuConfig(compilation_cache_dir=cache_dir)
 
-    def search():
+    def search(tenant=None):
+        cfg = sst.TpuConfig(compilation_cache_dir=cache_dir,
+                            tenant=tenant)
         return sst.GridSearchCV(LogisticRegression(max_iter=max_iter),
                                 grid, cv=folds, refit=False,
                                 backend="tpu", config=cfg)
@@ -825,15 +827,20 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
         sess.submit(search(), X, y).result()
         out["solo_wall_s"] = round(time.perf_counter() - t0, 2)
         for k in levels:
-            searches = [search() for _ in range(k)]
+            searches = [search(tenant=f"tenant{i}") for i in range(k)]
             t0 = time.perf_counter()
             futs = [sess.submit(s, X, y) for s in searches]
             for f in futs:
                 f.result()
             wall = time.perf_counter() - t0
-            waits = sorted(
-                w for s in searches
-                for w in s.search_report["scheduler"]["waits"])
+            # the waits sample is tenant-stamped (ISSUE 8 satellite),
+            # so the merged distribution still attributes per tenant
+            by_tenant = {}
+            for s in searches:
+                for w in s.search_report["scheduler"]["waits"]:
+                    by_tenant.setdefault(w["tenant"], []).append(
+                        w["wait_s"])
+            waits = sorted(w for ws in by_tenant.values() for w in ws)
             interleave = [s.search_report["scheduler"]["interleave_frac"]
                           for s in searches]
             out[f"contended_{k}"] = {
@@ -841,6 +848,11 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                 "searches_per_min": round(60.0 * k / wall, 2),
                 "queue_wait_p50_s": pct(waits, 50),
                 "queue_wait_p95_s": pct(waits, 95),
+                "per_tenant_queue_wait": {
+                    t: {"p50_s": pct(sorted(ws), 50),
+                        "p95_s": pct(sorted(ws), 95),
+                        "n": len(ws)}
+                    for t, ws in sorted(by_tenant.items())},
                 "interleave_frac": [round(f, 4) for f in interleave],
                 "n_queue_waits": len(waits),
             }

@@ -30,7 +30,8 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 # instrumented: the conftest hook fails the shard on any recorded
 # acquisition-order inversion
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
-    tests/test_faults.py tests/test_serve.py tests/test_sstlint.py -q
+    tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
+    tests/test_sstlint.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -195,6 +196,103 @@ print("serve smoke:",
       {k: schb[k] for k in ("n_dispatches", "interleave_frac",
                             "queue_wait_s")})
 PY
+
+echo "== fleet telemetry smoke (endpoint + per-tenant SLOs + flight recorder) =="
+FLIGHT_DIR=$(mktemp -d /tmp/sst_flight_smoke_XXXX)
+JAX_PLATFORMS=cpu SST_FLIGHT_DIR="$FLIGHT_DIR" python - <<'PY'
+import json
+import re
+import time
+import urllib.request
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+from sklearn.naive_bayes import GaussianNB
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+
+# two tenants contending through one telemetry-enabled session
+cfg_a = sst.TpuConfig(max_tasks_per_batch=16, tenant="alpha",
+                      telemetry_port=0, telemetry_interval_s=0.1)
+cfg_b = sst.TpuConfig(max_tasks_per_batch=16, tenant="beta")
+sess = sst.createLocalTpuSession("telemetry-smoke", config=cfg_a)
+sess.executor.pause()
+fa = sess.submit(sst.GridSearchCV(
+    LogisticRegression(max_iter=10),
+    {"C": np.logspace(-2, 1, 24).tolist()}, cv=2, refit=False,
+    backend="tpu", config=cfg_a), X, y)
+fb = sess.submit(sst.GridSearchCV(
+    GaussianNB(), {"var_smoothing": np.logspace(-9, -5, 24).tolist()},
+    cv=2, refit=False, backend="tpu", config=cfg_b), X, y)
+t0 = time.time()
+while sess.executor.queued_count() < 2 and time.time() - t0 < 60:
+    time.sleep(0.01)
+sess.executor.resume()
+a, b = fa.result(timeout=300), fb.result(timeout=300)
+
+url = sess.fleet_endpoint.url
+# the JSON snapshot exposes nonzero per-tenant series that agree with
+# the searches' own scheduler blocks
+snap = json.loads(urllib.request.urlopen(
+    url + "/snapshot.json", timeout=10).read())
+assert snap["enabled"] is True
+tenants = snap["tenants"]
+assert set(tenants) >= {"alpha", "beta"}, tenants
+for name, fut in (("alpha", a), ("beta", b)):
+    sch = fut.search_report["scheduler"]
+    assert tenants[name]["dispatches_total"] == sch["n_dispatches"], \
+        (name, tenants[name], sch)
+    assert tenants[name]["tasks_total"] > 0
+assert snap["device"]["busy_s_window"] > 0, snap["device"]
+# the Prometheus payload parses line-for-line and carries the series
+body = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+from spark_sklearn_tpu.obs.fleet import METRIC_LINE_RE
+lines = [ln for ln in body.splitlines() if ln and not ln.startswith("#")]
+bad = [ln for ln in lines if not METRIC_LINE_RE.match(ln)]
+assert not bad, bad[:5]
+assert 'sst_tenant_queue_wait_seconds{quantile="0.95",tenant="alpha"}' \
+    in body or 'tenant="alpha"' in body, body[:500]
+# fleet_top one-shot digest against the live endpoint
+import subprocess, sys
+top = subprocess.run([sys.executable, "tools/fleet_top.py",
+                      "--url", url], capture_output=True, text=True)
+assert top.returncode == 0, top.stderr
+assert "alpha" in top.stdout and "beta" in top.stdout, top.stdout
+sess.stop()
+
+# oom@4 injection: the search recovers (exact scores) AND the flight
+# recorder leaves a black-box bundle in SST_FLIGHT_DIR
+grid = {"C": np.logspace(-2, 1, 40).tolist()}
+base = sst.GridSearchCV(LogisticRegression(max_iter=10), grid, cv=2,
+                        refit=False, backend="tpu").fit(X, y)
+cfg_f = sst.TpuConfig(fault_plan="oom@4", retry_backoff_s=0.01,
+                      trace=True)
+gs = sst.GridSearchCV(LogisticRegression(max_iter=10), grid, cv=2,
+                      refit=False, backend="tpu", config=cfg_f).fit(X, y)
+np.testing.assert_array_equal(base.cv_results_["mean_test_score"],
+                              gs.cv_results_["mean_test_score"])
+import glob, os
+bundles = glob.glob(os.path.join(os.environ["SST_FLIGHT_DIR"],
+                                 "flight-oom-*.json"))
+assert bundles, os.listdir(os.environ["SST_FLIGHT_DIR"])
+bundle = json.load(open(bundles[0]))
+assert bundle["reason"] == "oom" and bundle["traceEvents"], \
+    sorted(bundle)
+assert any(r.get("kind") == "fault" for r in bundle["records"])
+print("telemetry smoke:",
+      {t: {k: tenants[t][k] for k in ("dispatches_total",
+                                      "queue_wait_p95_s")}
+       for t in ("alpha", "beta")},
+      "bundle:", os.path.basename(bundles[0]))
+PY
+# the bundle embeds its trace slice under traceEvents: the standard
+# trace digest reads the black box directly (exit 0 = spans found)
+JAX_PLATFORMS=cpu python tools/trace_summary.py "$FLIGHT_DIR"/flight-oom-*.json
+rm -rf "$FLIGHT_DIR"
 
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'

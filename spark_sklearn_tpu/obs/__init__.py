@@ -18,14 +18,29 @@ problem of MPMD pipeline schedulers (arXiv:2412.14374).  Four pieces:
     place (``SEARCH_REPORT_SCHEMA``) instead of hand-assembled dicts;
   - ``obs.log``     — a structured logger the ``verbose > 0`` paths
     route through; its stdout-parity emit preserves sklearn's
-    ``[CV i/n] END ...`` line format byte-for-byte.
+    ``[CV i/n] END ...`` line format byte-for-byte;
+  - ``obs.telemetry`` + ``obs.fleet`` — fleet telemetry for the
+    multi-tenant serving path: a process-wide sampler aggregating
+    per-tenant SLO series (queue-wait p50/p95, throughput, share),
+    device occupancy and fault counters across searches, a localhost
+    Prometheus/JSON endpoint owned by the session
+    (``TpuConfig(telemetry_port)`` / ``SST_TELEMETRY_PORT``), and an
+    always-on flight recorder that dumps a correlated black-box bundle
+    to ``SST_FLIGHT_DIR`` on FATAL faults, watchdog timeouts, OOMs,
+    cancellations and store quarantines.
 
 Enable tracing per search with ``TpuConfig(trace=True)`` (record only)
 or ``TpuConfig(trace="out.json")`` (record + export), or process-wide
 with the ``SST_TRACE`` environment variable (``1`` or a path).
 """
 
-from spark_sklearn_tpu.obs.trace import Tracer, get_tracer, search_tracing
+from spark_sklearn_tpu.obs.trace import (
+    Tracer,
+    current_correlation,
+    get_tracer,
+    search_tracing,
+    set_correlation,
+)
 from spark_sklearn_tpu.obs.export import chrome_trace_events, export_chrome_trace
 from spark_sklearn_tpu.obs.metrics import (
     SEARCH_REPORT_SCHEMA,
@@ -34,11 +49,35 @@ from spark_sklearn_tpu.obs.metrics import (
     search_registry,
 )
 from spark_sklearn_tpu.obs.log import StructuredLogger, get_logger
+from spark_sklearn_tpu.obs.telemetry import (
+    FlightRecorder,
+    TelemetryService,
+    flight_recorder,
+    get_telemetry,
+)
+
+#: obs.fleet re-exports resolve lazily (PEP 562): fleet pulls in
+#: http.server, which every `import spark_sklearn_tpu` would otherwise
+#: pay at startup with telemetry off — against the zero-cold-start
+#: objective.  The session imports fleet only when telemetry_port is
+#: actually configured.
+_FLEET_EXPORTS = ("FleetEndpoint", "prometheus_text",
+                  "resolve_telemetry_port")
+
+
+def __getattr__(name):
+    if name in _FLEET_EXPORTS:
+        from spark_sklearn_tpu.obs import fleet
+        return getattr(fleet, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "Tracer",
+    "current_correlation",
     "get_tracer",
     "search_tracing",
+    "set_correlation",
     "chrome_trace_events",
     "export_chrome_trace",
     "MetricsRegistry",
@@ -47,4 +86,11 @@ __all__ = [
     "schema_markdown",
     "StructuredLogger",
     "get_logger",
+    "FlightRecorder",
+    "TelemetryService",
+    "flight_recorder",
+    "get_telemetry",
+    "FleetEndpoint",
+    "prometheus_text",
+    "resolve_telemetry_port",
 ]

@@ -1,0 +1,296 @@
+"""Fleet exposition — Prometheus text + JSON snapshot over localhost.
+
+The :class:`~spark_sklearn_tpu.obs.telemetry.TelemetryService` owns the
+numbers; this module puts them on the wire:
+
+  - :func:`prometheus_text` renders a snapshot in the Prometheus text
+    exposition format (``sst_``-prefixed families, tenants as labels),
+    so any standard scraper — or a bare ``curl`` — can watch the fleet;
+  - :class:`FleetEndpoint` serves ``/metrics`` (Prometheus) and
+    ``/snapshot.json`` (the raw snapshot) from a daemon
+    ``ThreadingHTTPServer`` bound to ``127.0.0.1`` only.  Owned by
+    :class:`~spark_sklearn_tpu.utils.session.TpuSession` when
+    ``TpuConfig(telemetry_port)`` / ``SST_TELEMETRY_PORT`` is set
+    (default off — constructing a session with telemetry disabled
+    creates no socket and no thread).  Port ``0`` binds an ephemeral
+    port (tests and ``tools/fleet_top.py`` read it back from
+    ``endpoint.port``).
+
+``tools/fleet_top.py`` tails the JSON endpoint into a terminal digest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.telemetry import TelemetryService, get_telemetry
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "FleetEndpoint",
+    "prometheus_text",
+    "resolve_telemetry_port",
+]
+
+#: Prometheus metric-name grammar (validation aid for tests/smoke legs)
+METRIC_LINE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+]?("
+    r"[0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|Inf|NaN)$")
+
+
+def resolve_telemetry_port(config=None) -> Optional[int]:
+    """The configured endpoint port: ``TpuConfig.telemetry_port`` when
+    set, else the ``SST_TELEMETRY_PORT`` env var, else None (telemetry
+    off).  ``0`` means "bind an ephemeral port"."""
+    port = getattr(config, "telemetry_port", None) \
+        if config is not None else None
+    if port is None:
+        env = os.environ.get("SST_TELEMETRY_PORT", "").strip()
+        if not env or env.lower() in ("off", "none", "false"):
+            return None
+        try:
+            port = int(env)
+        except ValueError:
+            logger.warning(
+                "SST_TELEMETRY_PORT=%r is not an integer; telemetry "
+                "endpoint stays off", env)
+            return None
+    return int(port)
+
+
+def _label_escape(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+class _Lines:
+    """Accumulates exposition lines, emitting each family's # HELP /
+    # TYPE header once."""
+
+    def __init__(self):
+        self.out: List[str] = []
+        self._seen: set = set()
+
+    def add(self, name: str, value: Any, labels: Optional[Dict] = None,
+            mtype: str = "gauge", help_text: str = "") -> None:
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            value = float(bool(value)) if isinstance(value, bool) else None
+        if value is None:
+            return
+        if name not in self._seen:
+            self._seen.add(name)
+            if help_text:
+                self.out.append(f"# HELP {name} {help_text}")
+            self.out.append(f"# TYPE {name} {mtype}")
+        label_s = ""
+        if labels:
+            inner = ",".join(
+                f'{k}="{_label_escape(v)}"'
+                for k, v in sorted(labels.items()))
+            label_s = "{" + inner + "}"
+        self.out.append(f"{name}{label_s} {value}")
+
+    def text(self) -> str:
+        return "\n".join(self.out) + "\n"
+
+
+def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """Render a telemetry snapshot (default: the global service's) in
+    the Prometheus text exposition format."""
+    snap = snapshot if snapshot is not None \
+        else get_telemetry().snapshot()
+    ln = _Lines()
+    ln.add("sst_telemetry_enabled", snap.get("enabled", False),
+           help_text="1 when the telemetry service is aggregating.")
+    ln.add("sst_telemetry_window_seconds", snap.get("window_s", 0.0),
+           help_text="Sliding-window span the rates/percentiles cover.")
+    ln.add("sst_telemetry_samples_total", snap.get("n_samples", 0),
+           mtype="counter",
+           help_text="Sampler ticks since the service enabled.")
+    for tenant, t in (snap.get("tenants") or {}).items():
+        lbl = {"tenant": tenant}
+        ln.add("sst_tenant_dispatches_total",
+               t.get("dispatches_total", 0), labels=lbl, mtype="counter",
+               help_text="Chunk dispatches per tenant.")
+        ln.add("sst_tenant_tasks_total", t.get("tasks_total", 0),
+               labels=lbl, mtype="counter",
+               help_text="Dispatched (candidate x fold) task units per "
+                         "tenant.")
+        ln.add("sst_tenant_queue_wait_seconds_total",
+               t.get("queue_wait_s_total", 0.0), labels=lbl,
+               mtype="counter",
+               help_text="Total fair-share queue wait per tenant.")
+        for q, key in (("0.5", "queue_wait_p50_s"),
+                       ("0.95", "queue_wait_p95_s")):
+            ln.add("sst_tenant_queue_wait_seconds",
+                   t.get(key, 0.0), labels={**lbl, "quantile": q},
+                   help_text="Sliding-window queue-wait quantiles per "
+                             "tenant (the SLO series).")
+        ln.add("sst_tenant_throughput_tasks_per_second",
+               t.get("throughput_tasks_per_s", 0.0), labels=lbl,
+               help_text="Dispatched task units per second over the "
+                         "window.")
+        ln.add("sst_tenant_share_frac", t.get("share_frac", 0.0),
+               labels=lbl,
+               help_text="Tenant's share of all task cost dispatched "
+                         "in the window.")
+        ln.add("sst_tenant_residency_bytes",
+               t.get("residency_bytes", None), labels=lbl,
+               help_text="Data-plane bytes resident and charged to the "
+                         "tenant.")
+    dev = snap.get("device") or {}
+    ln.add("sst_device_busy_seconds_window", dev.get("busy_s_window"),
+           help_text="Device-busy seconds observed in the window.")
+    ln.add("sst_device_occupancy_frac", dev.get("occupancy_frac"),
+           help_text="Fraction of the window the device was busy.")
+    sched = snap.get("scheduler") or {}
+    ln.add("sst_scheduler_dispatches_total",
+           sched.get("dispatches_total"), mtype="counter",
+           help_text="All chunk dispatches through the executor.")
+    ln.add("sst_scheduler_loop_idle_frac", sched.get("loop_idle_frac"),
+           help_text="Fraction of the window the shared dispatch loop "
+                     "was idle.")
+    ln.add("sst_scheduler_queue_depth", sched.get("queue_depth"),
+           help_text="Chunk requests currently waiting in the "
+                     "fair-share queue.")
+    ln.add("sst_scheduler_active_searches", sched.get("n_active"),
+           help_text="Searches currently running in the executor.")
+    ln.add("sst_scheduler_pending_searches", sched.get("n_pending"),
+           help_text="Searches waiting for an admission slot.")
+    dp = snap.get("dataplane") or {}
+    ln.add("sst_dataplane_h2d_bytes_total", dp.get("h2d_bytes_total"),
+           mtype="counter",
+           help_text="Host->device bytes transferred through the data "
+                     "plane.")
+    ln.add("sst_dataplane_h2d_bytes_per_second",
+           dp.get("h2d_bytes_per_s"),
+           help_text="Host->device transfer rate over the window.")
+    ln.add("sst_dataplane_hits_total", dp.get("hits"), mtype="counter",
+           help_text="Cumulative data-plane cache hits.")
+    ln.add("sst_dataplane_misses_total", dp.get("misses"),
+           mtype="counter",
+           help_text="Cumulative data-plane cache misses.")
+    ln.add("sst_dataplane_bytes_in_cache", dp.get("bytes_in_cache"),
+           help_text="Bytes currently resident in the plane.")
+    ln.add("sst_dataplane_hits_window", dp.get("hits_window"),
+           help_text="Data-plane hits within the sliding window.")
+    ln.add("sst_dataplane_misses_window", dp.get("misses_window"),
+           help_text="Data-plane misses within the sliding window.")
+    ps = snap.get("programstore") or {}
+    for key, val in sorted(ps.items()):
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        ln.add(f"sst_programstore_{key}", val,
+               mtype="counter" if key.endswith("_total") else "gauge",
+               help_text="Program-store counter (see "
+                         "search_report['programstore']).")
+    faults = snap.get("faults") or {}
+    for cls, n in (faults.get("by_class") or {}).items():
+        ln.add("sst_faults_total", n, labels={"class": cls},
+               mtype="counter",
+               help_text="Observed faults by taxonomy class.")
+    for action, n in (faults.get("by_action") or {}).items():
+        ln.add("sst_fault_actions_total", n, labels={"action": action},
+               mtype="counter",
+               help_text="Recovery actions by kind "
+                         "(retry/bisect/host_fallback/...).")
+    flight = snap.get("flight") or {}
+    ln.add("sst_flight_records_total", flight.get("n_records"),
+           mtype="counter",
+           help_text="Events recorded by the flight recorder ring.")
+    ln.add("sst_flight_dumps_total", flight.get("n_dumps"),
+           mtype="counter",
+           help_text="Black-box bundles dumped.")
+    return ln.text()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes: /metrics (Prometheus text), /snapshot.json (raw JSON).
+    The owning endpoint hangs its service off the server object."""
+
+    server_version = "sst-fleet/1"
+
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        service: TelemetryService = self.server.sst_service
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = prometheus_text(service.snapshot()).encode()
+                self._respond(
+                    200, body, "text/plain; version=0.0.4; charset=utf-8")
+            elif path in ("/", "/snapshot", "/snapshot.json"):
+                body = json.dumps(service.snapshot()).encode()
+                self._respond(200, body, "application/json")
+            else:
+                self._respond(404, b"not found\n", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            # the scraper went away mid-response; nothing to serve
+            pass
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        # route http.server's stderr chatter to the structured channel
+        logger.debug("fleet endpoint: " + fmt, *args)
+
+
+class FleetEndpoint:
+    """The localhost telemetry server.  ``start()`` binds and spawns
+    the daemon serving thread; ``port`` is the actual bound port
+    (meaningful when constructed with port 0); ``stop()`` shuts the
+    socket down.  Never binds a non-loopback interface."""
+
+    def __init__(self, port: int, service: Optional[TelemetryService] = None,
+                 host: str = "127.0.0.1"):
+        self._requested_port = int(port)
+        self._host = host
+        self._service = service if service is not None else get_telemetry()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    @property
+    def url(self) -> Optional[str]:
+        if self._server is None:
+            return None
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "FleetEndpoint":
+        if self._server is not None:
+            return self
+        server = ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler)
+        server.daemon_threads = True
+        server.sst_service = self._service
+        self._server = server
+        self._thread = threading.Thread(
+            target=server.serve_forever, name="sst-fleet-http",
+            daemon=True)
+        self._thread.start()
+        logger.info("fleet telemetry endpoint serving on %s "
+                    "(/metrics, /snapshot.json)", self.url, url=self.url)
+        return self
+
+    def stop(self) -> None:
+        server, thread = self._server, self._thread
+        self._server = self._thread = None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)

@@ -16,6 +16,17 @@ Two emit channels with different contracts:
 
 Loggers live under the ``spark_sklearn_tpu.*`` namespace of the stdlib
 ``logging`` module, so users attach handlers/levels the standard way.
+
+Two fleet-telemetry integrations (ISSUE 8), both zero-cost on the
+default path:
+
+  - every structured record is stamped with the calling thread's
+    tenant/search-handle correlation
+    (:func:`~spark_sklearn_tpu.obs.trace.current_correlation`), so a
+    multi-tenant log stream attributes each line to its search;
+  - WARNING-and-up records additionally land in the always-on flight
+    recorder ring (:mod:`spark_sklearn_tpu.obs.telemetry`), so a
+    black-box bundle carries the warnings that led up to the incident.
 """
 
 from __future__ import annotations
@@ -23,7 +34,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict
 
-from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.obs.trace import current_correlation, get_tracer
 from spark_sklearn_tpu.utils import locks as _locks
 
 __all__ = ["StructuredLogger", "get_logger"]
@@ -56,8 +67,21 @@ class StructuredLogger:
 
     def _emit(self, level: int, msg: str, args, fields: Dict[str, Any]):
         if self._log.isEnabledFor(level):
+            corr = current_correlation()
+            stamped = {**corr, **fields} if corr else dict(fields)
             self._log.log(level, msg, *args,
-                          extra={"sst_fields": dict(fields)})
+                          extra={"sst_fields": stamped})
+        if level >= logging.WARNING:
+            # the black box keeps the warnings that led up to an
+            # incident (correlation is stamped by the recorder itself)
+            from spark_sklearn_tpu.obs import telemetry as _telemetry
+            try:
+                rendered = msg % args if args else msg
+            except (TypeError, ValueError):
+                rendered = msg
+            _telemetry.flight_recorder().note(
+                "log", level=logging.getLevelName(level),
+                logger=self._log.name, message=rendered, **fields)
 
     def info(self, msg: str, *args: Any, **fields: Any) -> None:
         self._emit(logging.INFO, msg, args, fields)

@@ -33,6 +33,7 @@ __all__ = [
     "GEOMETRY_BLOCK_SCHEMA",
     "PROGRAMSTORE_BLOCK_SCHEMA",
     "SCHEDULER_BLOCK_SCHEMA",
+    "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
 ]
@@ -414,8 +415,59 @@ SCHEDULER_BLOCK_SCHEMA = (
               "search's active window — under contention these track "
               "the configured tenant weights."),
     MetricDef("waits", "series",
-              "Per routed dispatch: seconds waited in the queue "
-              "(bounded sample; bench derives p50/p95 from it)."),
+              "Per routed dispatch: {tenant, wait_s} record of the "
+              "seconds waited in the queue (bounded sample, tenant-"
+              "stamped so merged samples from concurrent searches "
+              "still attribute; bench derives PER-TENANT p50/p95 "
+              "from it)."),
+)
+
+
+#: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
+#: telemetry service's JSON view (``obs/telemetry.py``), also served
+#: as ``/snapshot.json`` (and rendered to Prometheus text) by the
+#: session's localhost endpoint (``obs/fleet.py``,
+#: ``TpuConfig.telemetry_port`` / ``SST_TELEMETRY_PORT``).
+TELEMETRY_SNAPSHOT_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Whether the telemetry service is aggregating; the "
+              "zeroed shape renders when it is off."),
+    MetricDef("ts_unix_s", "gauge",
+              "Wall-clock timestamp the snapshot was rendered at."),
+    MetricDef("window_s", "gauge",
+              "Sliding-window span (seconds) the rates and "
+              "percentiles below cover."),
+    MetricDef("interval_s", "gauge",
+              "Sampler-thread tick period (seconds)."),
+    MetricDef("n_samples", "counter",
+              "Sampler ticks since the service enabled."),
+    MetricDef("tenants", "struct",
+              "Per-tenant SLO series: dispatches/tasks/queue-wait "
+              "cumulative totals plus sliding-window queue-wait "
+              "p50/p95, throughput (task units per second) and "
+              "share_frac — these agree with the searches' own "
+              "search_report['scheduler'] blocks."),
+    MetricDef("device", "struct",
+              "Device occupancy over the window: busy seconds (from "
+              "per-launch compute estimates) and occupancy_frac."),
+    MetricDef("scheduler", "struct",
+              "Dispatch-loop view: cumulative dispatches, loop busy "
+              "seconds and idle fraction over the window, plus the "
+              "sampler's polled queue depth and active/pending search "
+              "counts."),
+    MetricDef("dataplane", "struct",
+              "Host->device transfer totals and window rate, plus the "
+              "sampler's polled plane state (hits/misses/residency; "
+              "per-tenant residency lands under tenants)."),
+    MetricDef("programstore", "struct",
+              "AOT-store hit/miss/publish/quarantine event totals "
+              "plus the sampler's polled cumulative counters."),
+    MetricDef("faults", "struct",
+              "Observed fault totals by taxonomy class and recovery "
+              "action (fed by the launch supervisor's event hook)."),
+    MetricDef("flight", "struct",
+              "Flight-recorder state: records seen, ring occupancy, "
+              "black-box bundles dumped."),
 )
 
 
@@ -626,5 +678,15 @@ def schema_markdown() -> str:
     out.append("\n### `search_report[\"scheduler\"]` block\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in SCHEDULER_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
+               "endpoint schema\n")
+    out.append(
+        "\nTop-level keys of the fleet-telemetry snapshot "
+        "(`spark_sklearn_tpu/obs/telemetry.py`), served as "
+        "`/snapshot.json` and rendered to Prometheus text by the "
+        "session's localhost endpoint.\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in TELEMETRY_SNAPSHOT_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     return "".join(out)

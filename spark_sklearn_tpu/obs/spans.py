@@ -124,6 +124,9 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
     SpanDef("sched.dispatch", "span", "serve.executor",
             "One routed chunk launch enqueued on the shared "
             "sst-dispatch loop (carries tenant, handle, cost)."),
+    # obs/telemetry.py
+    SpanDef("telemetry.sample", "span", "obs.telemetry",
+            "One fleet-telemetry sampler tick (provider polls)."),
     # utils/session.py
     SpanDef("session.init", "span", "utils.session",
             "TpuSession bootstrap (mesh, caches, fault plan)."),

@@ -1,0 +1,798 @@
+"""Fleet telemetry — process-wide metrics, per-tenant SLO accounting,
+and the fault flight recorder.
+
+PR 7 turned the engine into the reference's "shared Spark cluster": a
+long-lived :class:`~spark_sklearn_tpu.utils.session.TpuSession` serving
+many tenants' searches through one fair-share executor.  Every
+observable so far died with its search (``search_report``, the span
+tracer's per-fit export), so an operator of that service could not
+answer "is tenant A starved *right now*?", "what is the device doing
+between searches?", or "what led up to that 3 a.m. OOM?" — the
+continuous telemetry loop online shared-cluster tuning assumes as input
+(arXiv:2309.01901) and the fleet-level resource visibility that
+distributed-ML performance analysis shows is where the wins come from
+(arXiv:1612.01437).  Three pieces:
+
+  - :class:`TelemetryService` — a process-global, session-scoped
+    aggregator.  Cheap ``note_*`` hooks (one attribute read when
+    disabled — the tracer's exact-no-op discipline) feed per-tenant
+    queue-wait/throughput/share rolling windows, device-occupancy and
+    dispatch-loop busy series, fault/retry/bisection counters and
+    host->device byte totals from the executor, pipeline, supervisor,
+    data plane and program store; a low-overhead **sampler thread**
+    polls registered providers (scheduler queue depth, data-plane
+    residency, program-store counters) on an interval so gauges stay
+    current between searches.  ``snapshot()`` renders the whole state
+    as one JSON-able dict whose top-level schema is pinned in
+    ``obs.metrics.TELEMETRY_SNAPSHOT_SCHEMA``.
+  - **exposition** lives in :mod:`spark_sklearn_tpu.obs.fleet`: a
+    localhost HTTP endpoint (Prometheus text + JSON snapshot) owned by
+    the session (``TpuConfig(telemetry_port)`` / ``SST_TELEMETRY_PORT``,
+    default off), plus ``session.telemetry_snapshot()`` in-process and
+    the ``tools/fleet_top.py`` terminal digest.
+  - :class:`FlightRecorder` — the always-on black box.  A bounded ring
+    of recent scheduler dispatch events, fault events and warning-level
+    structured log records (each stamped with the thread's
+    tenant/search-handle correlation, so cross-search causality is
+    reconstructable), dumped as a correlated bundle — ring records,
+    trace slice (Chrome ``traceEvents``, loadable by
+    ``tools/trace_summary.py``), scheduler state, faults block, config
+    and environment fingerprint — to ``TpuConfig(flight_dir)`` /
+    ``SST_FLIGHT_DIR`` on any FATAL fault, watchdog timeout, first OOM
+    recovery, cancellation, or program-store quarantine.  With no
+    flight dir configured the ring still records (bounded, in-memory)
+    and dumping is a no-op.
+
+Enabling telemetry also enables the span tracer (the flight recorder's
+"recent spans" ring); disabling restores the tracer's prior state.
+Telemetry off is an exact no-op: hooks early-out before any allocation,
+``search_report`` / ``cv_results_`` / exported traces are byte-identical
+to a telemetry-less build, and no thread or socket exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from spark_sklearn_tpu.obs.trace import current_correlation, get_tracer
+from spark_sklearn_tpu.utils.atomic import atomic_write
+from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
+
+__all__ = [
+    "DEFAULT_WINDOW_S",
+    "DEFAULT_INTERVAL_S",
+    "FlightRecorder",
+    "RollingWindow",
+    "TelemetryService",
+    "flight_recorder",
+    "get_telemetry",
+    "note_dispatch",
+    "note_fault",
+    "note_h2d",
+    "note_launch",
+    "note_programstore",
+    "note_sched_busy",
+    "percentile",
+    "resolve_flight_dir",
+]
+
+#: sliding-window span (seconds) the SLO percentiles/rates cover
+DEFAULT_WINDOW_S = 120.0
+#: sampler tick period (seconds)
+DEFAULT_INTERVAL_S = 0.5
+#: bounded flight-recorder ring (records, not bytes)
+DEFAULT_FLIGHT_RECORDS = 4096
+#: per-window sample bound — a million-chunk burst must not grow an
+#: unbounded deque; rates/percentiles degrade to the newest samples
+MAX_WINDOW_SAMPLES = 4096
+
+
+def percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile of an ascending list (0.0 when empty) —
+    the same estimator ``bench.py`` uses, so endpoint and bench numbers
+    agree sample-for-sample."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            int(round(p / 100.0 * (len(sorted_vals) - 1))))
+    return float(sorted_vals[i])
+
+
+class RollingWindow:
+    """Bounded (timestamp, value) samples over a sliding time window.
+
+    Appends are O(1); reads evict expired samples first.  NOT
+    internally locked — the owning :class:`TelemetryService` serializes
+    access under its own named lock."""
+
+    __slots__ = ("window_s", "_samples")
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 max_samples: int = MAX_WINDOW_SAMPLES):
+        self.window_s = float(window_s)
+        self._samples: deque = deque(maxlen=int(max_samples))
+
+    def add(self, value: Any, t: Optional[float] = None) -> None:
+        self._samples.append(
+            (time.perf_counter() if t is None else t, value))
+
+    def _evict(self, now: Optional[float] = None) -> None:
+        cutoff = (time.perf_counter() if now is None else now) \
+            - self.window_s
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def values(self, now: Optional[float] = None) -> List[Any]:
+        self._evict(now)
+        return [v for _, v in self._samples]
+
+    def sum(self, now: Optional[float] = None) -> float:
+        return float(sum(self.values(now)))
+
+    def count(self, now: Optional[float] = None) -> int:
+        self._evict(now)
+        return len(self._samples)
+
+    def span_s(self, now: Optional[float] = None) -> float:
+        """Elapsed time the current samples actually cover (capped at
+        the window) — rates divide by this, not the full window, so a
+        service younger than one window reports honest rates."""
+        self._evict(now)
+        if not self._samples:
+            return 0.0
+        now = time.perf_counter() if now is None else now
+        return min(self.window_s, max(1e-9, now - self._samples[0][0]))
+
+    def percentile(self, p: float, now: Optional[float] = None) -> float:
+        return percentile(sorted(self.values(now)), p)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder — the always-on black box
+# ---------------------------------------------------------------------------
+
+
+def resolve_flight_dir(config=None) -> Optional[str]:
+    """The directory flight bundles dump to: ``TpuConfig.flight_dir``,
+    else the ``SST_FLIGHT_DIR`` env var, else None (dumping disabled;
+    the in-memory ring still records)."""
+    d = getattr(config, "flight_dir", None) if config is not None else None
+    return d or os.environ.get("SST_FLIGHT_DIR") or None
+
+
+def _env_fingerprint() -> Dict[str, Any]:
+    """Versions/platform/device-fleet identity stamped into every
+    bundle, so a postmortem knows exactly which world produced it."""
+    import platform
+
+    info: Dict[str, Any] = {
+        "python": platform.python_version(),
+        "platform": sys.platform,
+        "pid": os.getpid(),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        info["jax"] = jax.__version__
+        info["jaxlib"] = jaxlib.__version__
+        info["backend"] = jax.default_backend()
+        info["n_devices"] = len(jax.devices())
+    except (ImportError, AttributeError, RuntimeError):
+        # a bundle from a jax-less/uninitializable context still records
+        # the host identity above
+        pass
+    try:
+        import spark_sklearn_tpu
+
+        info["spark_sklearn_tpu"] = getattr(
+            spark_sklearn_tpu, "__version__", "?")
+    except ImportError:
+        pass
+    return info
+
+
+def _config_jsonable(config) -> Dict[str, Any]:
+    if config is None:
+        return {}
+    if dataclasses.is_dataclass(config):
+        out = {}
+        for f in dataclasses.fields(config):
+            v = getattr(config, f.name, None)
+            out[f.name] = v if isinstance(
+                v, (str, int, float, bool, type(None))) else repr(v)
+        return out
+    return {"repr": repr(config)}
+
+
+class FlightRecorder:
+    """Bounded ring of recent dispatch/fault/log events plus the
+    black-box ``dump``.
+
+    ``note`` is called from the executor's dispatch accounting, the
+    fault supervisor's event journal and the structured logger's
+    warning channel — always outside their own locks, so the recorder
+    introduces no cross-module lock nesting.  Records carry the calling
+    thread's tenant/handle correlation
+    (:func:`~spark_sklearn_tpu.obs.trace.current_correlation`)."""
+
+    def __init__(self, max_records: int = DEFAULT_FLIGHT_RECORDS):
+        self._lock = named_lock("telemetry.FlightRecorder._lock")
+        self._ring: deque = deque(maxlen=int(max_records))
+        self._n_dumps = 0
+        self._n_records = 0
+
+    # -- recording -------------------------------------------------------
+    def note(self, kind: str, **fields: Any) -> None:
+        rec = {"t_unix_s": time.time(), "t_mono_s": time.perf_counter(),
+               "kind": kind}
+        corr = current_correlation()
+        if corr:
+            rec.update(corr)
+        rec.update(fields)
+        with self._lock:
+            self._ring.append(rec)
+            self._n_records += 1
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"n_records": self._n_records,
+                    "n_buffered": len(self._ring),
+                    "n_dumps": self._n_dumps}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- the black-box dump ----------------------------------------------
+    def dump(self, reason: str, flight_dir: Optional[str] = None,
+             config=None, faults: Optional[Dict[str, Any]] = None,
+             scheduler: Optional[Dict[str, Any]] = None,
+             context: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Write a correlated black-box bundle for ``reason`` and
+        return its path — or None when no flight directory resolves
+        (``flight_dir`` arg, ``TpuConfig.flight_dir`` via ``config``,
+        or ``SST_FLIGHT_DIR``).
+
+        The bundle is one JSON object: the ring's recent records, a
+        Chrome ``traceEvents`` slice of the tracer's current buffer
+        (``tools/trace_summary.py`` digests the bundle file directly),
+        the scheduler state the caller supplies, the faults block,
+        the config, and an environment fingerprint.  Dump failures are
+        logged and swallowed — the black box must never turn an
+        incident into a second failure."""
+        target_dir = flight_dir or resolve_flight_dir(config)
+        if not target_dir:
+            return None
+        with self._lock:
+            records = list(self._ring)
+            self._n_dumps += 1
+            seq = self._n_dumps
+        corr = current_correlation() or {}
+        tracer = get_tracer()
+        trace_events: List[Dict[str, Any]] = []
+        if len(tracer):
+            from spark_sklearn_tpu.obs.export import chrome_trace_events
+            trace_events = chrome_trace_events(tracer.events())
+        svc = get_telemetry()
+        bundle = {
+            "flight_format": 1,
+            "reason": reason,
+            "ts_unix_s": time.time(),
+            "correlation": dict(corr),
+            "context": dict(context or {}),
+            "env": _env_fingerprint(),
+            "config": _config_jsonable(config),
+            "scheduler": dict(scheduler or {}),
+            "faults": dict(faults or {}),
+            "telemetry": svc.snapshot() if svc.enabled else {},
+            "records": records,
+            "traceEvents": trace_events,
+        }
+        slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason)[:40]
+        path = os.path.join(
+            target_dir, f"flight-{slug}-{os.getpid()}-{seq:04d}.json")
+        try:
+            os.makedirs(target_dir, exist_ok=True)
+            # the hardened tmp+fsync+replace path (utils/atomic.py) —
+            # bundles are written mid-incident, when a crash is most
+            # likely, and a torn black box is worse than none
+            atomic_write(path, json.dumps(bundle, default=str).encode())
+        except (OSError, TypeError, ValueError) as exc:
+            from spark_sklearn_tpu.obs.log import get_logger
+            get_logger(__name__).warning(
+                "flight recorder: bundle write failed for %r (%r)",
+                reason, exc)
+            return None
+        from spark_sklearn_tpu.obs.log import get_logger
+        get_logger(__name__).warning(
+            "flight recorder: %s bundle dumped to %s (%d record(s), "
+            "%d trace event(s))", reason, path, len(records),
+            len(trace_events), reason=reason, path=path)
+        return path
+
+
+_FLIGHT = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-global flight recorder (always on, bounded)."""
+    return _FLIGHT
+
+
+# ---------------------------------------------------------------------------
+# Telemetry service
+# ---------------------------------------------------------------------------
+
+
+class _TenantStats:
+    """One tenant's SLO state: cumulative totals + rolling windows."""
+
+    __slots__ = ("dispatches_total", "tasks_total", "queue_wait_s_total",
+                 "waits", "costs")
+
+    def __init__(self, window_s: float):
+        self.dispatches_total = 0
+        self.tasks_total = 0
+        self.queue_wait_s_total = 0.0
+        self.waits = RollingWindow(window_s)     # queue-wait seconds
+        self.costs = RollingWindow(window_s)     # dispatched task units
+
+
+class TelemetryService:
+    """The process-global aggregator behind the fleet endpoint.
+
+    Disabled (the default) every hook early-outs on one attribute read;
+    enabled, hooks append to bounded rolling windows under one named
+    lock and a daemon sampler thread polls the registered providers.
+    The service never calls a provider while holding its own lock, and
+    hooks are invoked by producers *outside* their locks — so telemetry
+    adds no cross-module lock ordering."""
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 interval_s: float = DEFAULT_INTERVAL_S):
+        # reentrant: snapshot() renders its sub-blocks through helpers
+        # that take the lock again themselves, so each is safe
+        # standalone (the dataplane's _evict_over_budget pattern)
+        self._lock = named_rlock("telemetry.TelemetryService._lock")
+        self.enabled = False
+        #: enable/disable are refcounted: two telemetry-enabled
+        #: sessions in one process share the global service, and
+        #: stopping the first must not kill the second's endpoint view
+        self._enable_count = 0
+        self.window_s = float(window_s)
+        self.interval_s = float(interval_s)
+        self._t_enabled: Optional[float] = None
+        self._we_enabled_tracer = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._tenants: Dict[str, _TenantStats] = {}
+        self._device_busy = RollingWindow(window_s)
+        self._sched_busy = RollingWindow(window_s)
+        self._sched_dispatches_total = 0
+        self._faults_by_class: Dict[str, int] = {}
+        self._faults_by_action: Dict[str, int] = {}
+        self._h2d = {"bytes_total": 0, "uploads_total": 0}
+        self._h2d_window = RollingWindow(window_s)
+        self._ps_events: Dict[str, int] = {}
+        #: provider name -> STACK of zero-arg callables returning a
+        #: JSON-able dict; the newest registration is polled, and
+        #: unregistering it restores the previous one — so two
+        #: sessions sharing the service survive either stop order
+        self._providers: Dict[str, List[Callable[[], Dict[str, Any]]]] \
+            = {}
+        #: provider name -> rolling (t, polled dict) for window deltas
+        self._polls: Dict[str, RollingWindow] = {}
+        self._n_samples = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def enable(self, window_s: Optional[float] = None,
+               interval_s: Optional[float] = None) -> "TelemetryService":
+        """Start aggregating (refcounted: each ``enable`` pairs with
+        one :meth:`disable`, so two telemetry-enabled sessions sharing
+        the global service survive the first one stopping).  Also
+        enables the span tracer when it is off — the flight recorder's
+        "recent spans" ring — remembering to restore it when the LAST
+        disable lands."""
+        mismatch = None
+        with self._lock:
+            self._enable_count += 1
+            if self.enabled:
+                # the FIRST owner's window/interval stand: resizing a
+                # live service's windows would retroactively change the
+                # meaning of the other session's SLO series
+                if (window_s and float(window_s) != self.window_s) or \
+                        (interval_s and
+                         float(interval_s) != self.interval_s):
+                    mismatch = (self.window_s, self.interval_s)
+            else:
+                if window_s:
+                    self.window_s = float(window_s)
+                    for ts in self._tenants.values():
+                        ts.waits.window_s = self.window_s
+                        ts.costs.window_s = self.window_s
+                    self._device_busy.window_s = self.window_s
+                    self._sched_busy.window_s = self.window_s
+                    self._h2d_window.window_s = self.window_s
+                if interval_s:
+                    self.interval_s = float(interval_s)
+        if mismatch is not None:
+            from spark_sklearn_tpu.obs.log import get_logger
+            get_logger(__name__).warning(
+                "telemetry already enabled with window=%.0fs "
+                "interval=%.2fs; the new session's settings are "
+                "ignored until the last owner disables", *mismatch)
+            return self
+        with self._lock:
+            if self.enabled:
+                return self
+            self.enabled = True
+            self._t_enabled = time.perf_counter()
+        tracer = get_tracer()
+        if not tracer.enabled:
+            tracer.enable()
+            with self._lock:
+                self._we_enabled_tracer = True
+        self._ensure_sampler()
+        return self
+
+    def disable(self) -> bool:
+        """Drop one enable reference; the LAST disable stops the
+        sampler and the hooks (accumulated state stays readable through
+        :meth:`snapshot`, whose ``enabled`` goes False).  Returns True
+        when the service actually stopped — callers that own shared
+        providers only tear them down then.
+
+        Known limitation: the tracer restore is boolean, not
+        refcounted — if telemetry turned the tracer on and a LATER
+        consumer (e.g. a ``TpuConfig(trace=True)`` session) started
+        relying on it, the last telemetry disable turns it off for
+        them too; re-enable via ``get_tracer().enable()`` or construct
+        the tracing session first."""
+        with self._lock:
+            if not self.enabled:
+                self._enable_count = 0
+                return True
+            self._enable_count = max(0, self._enable_count - 1)
+            if self._enable_count > 0:
+                return False
+            self.enabled = False
+            thread = self._thread
+            self._thread = None
+            # THIS sampler's stop event (each sampler thread gets its
+            # own in _ensure_sampler): a concurrent re-enable starting
+            # a fresh sampler can never be killed by this late set()
+            stop = self._stop
+            we_enabled = self._we_enabled_tracer
+            self._we_enabled_tracer = False
+        stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
+        if we_enabled:
+            get_tracer().disable()
+        return True
+
+    def reset(self) -> None:
+        """Drop all accumulated series/counters (test isolation)."""
+        with self._lock:
+            self._tenants.clear()
+            self._device_busy = RollingWindow(self.window_s)
+            self._sched_busy = RollingWindow(self.window_s)
+            self._sched_dispatches_total = 0
+            self._faults_by_class.clear()
+            self._faults_by_action.clear()
+            self._h2d = {"bytes_total": 0, "uploads_total": 0}
+            self._h2d_window = RollingWindow(self.window_s)
+            self._ps_events.clear()
+            self._polls.clear()
+            self._n_samples = 0
+
+    # -- providers + sampler ---------------------------------------------
+    def register_provider(self, name: str,
+                          fn: Callable[[], Dict[str, Any]]) -> None:
+        """Register a polled gauge source (the NEWEST registration
+        under a name is the one polled).  ``fn`` runs on the sampler
+        thread WITHOUT the telemetry lock held, so it may take its
+        subsystem's own locks freely."""
+        with self._lock:
+            self._providers.setdefault(name, []).append(fn)
+            self._polls.setdefault(name, RollingWindow(self.window_s))
+
+    def unregister_provider(self, name: str, expected: Optional[
+            Callable[[], Dict[str, Any]]] = None) -> None:
+        """Remove a provider registration.  With ``expected`` given,
+        remove exactly that callable from the name's stack (wherever it
+        sits) — a stopping session tears down only its own
+        registration, and an earlier session's provider resumes being
+        polled.  Without ``expected``, the whole name is dropped."""
+        with self._lock:
+            if expected is None:
+                self._providers.pop(name, None)
+                return
+            stack = self._providers.get(name)
+            if not stack:
+                return
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is expected:
+                    del stack[i]
+                    break
+            if not stack:
+                self._providers.pop(name, None)
+
+    def _ensure_sampler(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            # a FRESH stop event per sampler: disable() sets only the
+            # event of the thread it is stopping, so a disable racing
+            # a re-enable cannot kill the newly started sampler
+            stop = self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._sample_loop, args=(stop,),
+                name="sst-telemetry", daemon=True)
+            thread = self._thread
+        thread.start()
+
+    def _sample_loop(self, stop: threading.Event) -> None:
+        while not stop.wait(self.interval_s):
+            if not self.enabled:
+                break
+            self.sample_once()
+
+    def sample_once(self) -> None:
+        """One sampler tick: poll every provider (outside the lock) and
+        store the results for window-delta rates.  Public so tests and
+        the endpoint can force a fresh poll deterministically."""
+        with self._lock:
+            providers = [(name, stack[-1])
+                         for name, stack in self._providers.items()
+                         if stack]
+        t = time.perf_counter()
+        with get_tracer().span("telemetry.sample"):
+            for name, fn in providers:
+                try:
+                    polled = dict(fn() or {})
+                # a dying subsystem (executor mid-shutdown, store being
+                # deactivated) must degrade to a skipped sample, never
+                # kill the sampler thread — the next tick retries, so
+                # the failure is self-healing and not worth a log line
+                # per 0.5 s tick
+                # sstlint: disable=swallowed-exception
+                except Exception:
+                    continue
+                with self._lock:
+                    win = self._polls.setdefault(
+                        name, RollingWindow(self.window_s))
+                    win.add(polled, t=t)
+        with self._lock:
+            self._n_samples += 1
+
+    # -- hooks (each early-outs when disabled) ---------------------------
+    def note_dispatch(self, tenant: str, cost: int,
+                      wait_s: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            ts = self._tenants.get(tenant)
+            if ts is None:
+                ts = self._tenants[tenant] = _TenantStats(self.window_s)
+            ts.dispatches_total += 1
+            ts.tasks_total += int(cost)
+            ts.costs.add(int(cost))
+            self._sched_dispatches_total += 1
+            if wait_s is not None:
+                ts.queue_wait_s_total += float(wait_s)
+                ts.waits.add(float(wait_s))
+
+    def note_launch(self, compute_s: float) -> None:
+        """Device-occupancy feed: one launch's device-busy estimate."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._device_busy.add(max(0.0, float(compute_s)))
+
+    def note_sched_busy(self, busy_s: float) -> None:
+        """Dispatch-loop feed: time the shared loop spent dispatching
+        (its idle fraction is 1 - busy/window)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._sched_busy.add(max(0.0, float(busy_s)))
+
+    def note_fault(self, fault_class: str, action: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._faults_by_class[fault_class] = \
+                self._faults_by_class.get(fault_class, 0) + 1
+            self._faults_by_action[action] = \
+                self._faults_by_action.get(action, 0) + 1
+
+    def note_h2d(self, nbytes: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._h2d["bytes_total"] += int(nbytes)
+            self._h2d["uploads_total"] += 1
+            self._h2d_window.add(int(nbytes))
+
+    def note_programstore(self, event: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ps_events[event] = self._ps_events.get(event, 0) + 1
+
+    # -- snapshot --------------------------------------------------------
+    def _tenant_block(self, now: float) -> Dict[str, Any]:
+        total_window_cost = sum(
+            ts.costs.sum(now) for ts in self._tenants.values())
+        residency = self._latest_poll("dataplane").get(
+            "tenant_bytes") or {}
+        out: Dict[str, Any] = {}
+        for name in sorted(self._tenants):
+            ts = self._tenants[name]
+            span = ts.costs.span_s(now)
+            win_cost = ts.costs.sum(now)
+            out[name] = {
+                "residency_bytes": int(residency.get(name, 0)),
+                "dispatches_total": ts.dispatches_total,
+                "tasks_total": ts.tasks_total,
+                "queue_wait_s_total": round(ts.queue_wait_s_total, 6),
+                "queue_wait_p50_s": round(ts.waits.percentile(50, now), 6),
+                "queue_wait_p95_s": round(ts.waits.percentile(95, now), 6),
+                "wait_samples": ts.waits.count(now),
+                "throughput_tasks_per_s": round(win_cost / span, 4)
+                if span > 0 else 0.0,
+                "share_frac": round(win_cost / total_window_cost, 4)
+                if total_window_cost > 0 else 0.0,
+            }
+        return out
+
+    def _device_block(self, now: float) -> Dict[str, Any]:
+        span = self._device_busy.span_s(now)
+        busy = self._device_busy.sum(now)
+        return {
+            "busy_s_window": round(busy, 4),
+            "occupancy_frac": round(min(1.0, busy / span), 4)
+            if span > 0 else 0.0,
+        }
+
+    def _scheduler_block(self, now: float) -> Dict[str, Any]:
+        with self._lock:
+            span = self._sched_busy.span_s(now)
+            busy = self._sched_busy.sum(now)
+            block = {
+                "dispatches_total": self._sched_dispatches_total,
+                "loop_busy_s_window": round(busy, 4),
+                "loop_idle_frac": round(max(0.0, 1.0 - busy / span), 4)
+                if span > 0 else 1.0,
+            }
+            block.update(self._latest_poll("scheduler"))
+            return block
+
+    def _latest_poll(self, name: str) -> Dict[str, Any]:
+        win = self._polls.get(name)
+        if win is None:
+            return {}
+        vals = win.values()
+        return dict(vals[-1]) if vals else {}
+
+    def _poll_delta(self, name: str, keys: Tuple[str, ...]) -> Dict[str, Any]:
+        """newest - oldest of a polled cumulative counter over the
+        window, suffixed ``_window`` (hit/publish RATES without hooks on
+        every cache lookup)."""
+        win = self._polls.get(name)
+        if win is None:
+            return {}
+        vals = win.values()
+        if not vals:
+            return {}
+        lo, hi = vals[0], vals[-1]
+        return {f"{k}_window": int(hi.get(k, 0)) - int(lo.get(k, 0))
+                for k in keys if k in hi}
+
+    def _dataplane_block(self, now: float) -> Dict[str, Any]:
+        with self._lock:
+            span = self._h2d_window.span_s(now)
+            block = {
+                "h2d_bytes_total": self._h2d["bytes_total"],
+                "h2d_uploads_total": self._h2d["uploads_total"],
+                "h2d_bytes_per_s": round(
+                    self._h2d_window.sum(now) / span, 1)
+                if span > 0 else 0.0,
+            }
+            block.update(self._latest_poll("dataplane"))
+            block.update(self._poll_delta("dataplane",
+                                          ("hits", "misses")))
+            # the raw per-tenant dict surfaces under tenants instead
+            block.pop("tenant_bytes", None)
+            return block
+
+    def _programstore_block(self) -> Dict[str, Any]:
+        with self._lock:
+            block = {f"{k}_total": v
+                     for k, v in sorted(self._ps_events.items())}
+            block.update(self._latest_poll("programstore"))
+            return block
+
+    def _faults_block(self) -> Dict[str, Any]:
+        return {
+            "total": sum(self._faults_by_class.values()),
+            "by_class": dict(sorted(self._faults_by_class.items())),
+            "by_action": dict(sorted(self._faults_by_action.items())),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The whole telemetry state as one JSON-able dict.  Top-level
+        keys are pinned in ``obs.metrics.TELEMETRY_SNAPSHOT_SCHEMA``;
+        the same dict backs the endpoint's ``/snapshot.json`` and the
+        Prometheus rendering (``obs.fleet.prometheus_text``)."""
+        now = time.perf_counter()
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "ts_unix_s": round(time.time(), 3),
+                "window_s": self.window_s,
+                "interval_s": self.interval_s,
+                "n_samples": self._n_samples,
+                "tenants": self._tenant_block(now),
+                "device": self._device_block(now),
+                "scheduler": self._scheduler_block(now),
+                "dataplane": self._dataplane_block(now),
+                "programstore": self._programstore_block(),
+                "faults": self._faults_block(),
+                "flight": _FLIGHT.stats(),
+            }
+
+
+_GLOBAL = TelemetryService()
+
+
+def get_telemetry() -> TelemetryService:
+    """The process-global service every hook reports to."""
+    return _GLOBAL
+
+
+# -- module-level hook spellings (what the producers call) ----------------
+
+def note_dispatch(tenant: str, cost: int,
+                  wait_s: Optional[float] = None) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_dispatch(tenant, cost, wait_s)
+
+
+def note_launch(compute_s: float) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_launch(compute_s)
+
+
+def note_sched_busy(busy_s: float) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_sched_busy(busy_s)
+
+
+def note_fault(fault_class: str, action: str) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_fault(fault_class, action)
+
+
+def note_h2d(nbytes: int) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_h2d(nbytes)
+
+
+def note_programstore(event: str) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_programstore(event)

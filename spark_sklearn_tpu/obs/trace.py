@@ -39,12 +39,48 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "Tracer",
+    "current_correlation",
     "get_tracer",
     "search_tracing",
+    "set_correlation",
 ]
 
 #: default ring-buffer capacity (events, not bytes)
 DEFAULT_BUFFER_SIZE = 65536
+
+# ---------------------------------------------------------------------------
+# Correlation context (multi-tenant attribution)
+# ---------------------------------------------------------------------------
+
+#: thread-local {tenant, handle} stamped onto every event a thread
+#: records (ISSUE 8 satellite: a multi-tenant Perfetto export used to
+#: interleave three searches' spans with no way to tell whose is
+#: whose).  Set by the serve executor's worker threads; propagated by
+#: ChunkPipeline onto its stage/gather/compile workers; None for a
+#: standalone fit, so untenanted traces stay byte-identical.
+_CORR = threading.local()
+
+
+def set_correlation(attrs: Optional[Dict[str, Any]]) -> None:
+    """Bind (or clear, with None) the calling thread's correlation
+    attributes.  Explicit span attributes win over correlation keys on
+    collision."""
+    _CORR.attrs = dict(attrs) if attrs else None
+
+
+def current_correlation() -> Optional[Dict[str, Any]]:
+    """The calling thread's correlation attrs, or None."""
+    return getattr(_CORR, "attrs", None)
+
+
+def _stamp(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge the thread's correlation under explicit attrs (explicit
+    keys win).  One getattr when no correlation is set — negligible on
+    the recording path, absent entirely when tracing is off."""
+    corr = getattr(_CORR, "attrs", None)
+    if not corr:
+        return attrs
+    return {**corr, **attrs}
 
 #: event tuples: (ph, name, t0, t1, track_key, track_name, attrs)
 #:   ph "X" — complete span (t0..t1 on one thread or virtual track)
@@ -96,7 +132,8 @@ class _Span:
         th = threading.current_thread()
         # deque.append is atomic under the GIL: no lock on the hot path
         self._tracer._events.append(
-            ("X", self._name, self._t0, t1, th.ident, th.name, self._attrs))
+            ("X", self._name, self._t0, t1, th.ident, th.name,
+             _stamp(self._attrs)))
         return False
 
 
@@ -145,7 +182,8 @@ class Tracer:
             return
         th = threading.current_thread()
         self._events.append(
-            ("i", name, time.perf_counter(), None, th.ident, th.name, attrs))
+            ("i", name, time.perf_counter(), None, th.ident, th.name,
+             _stamp(attrs)))
 
     def record_span(self, name: str, t0: float, t1: float,
                     track: Optional[str] = None, **attrs) -> None:
@@ -161,7 +199,7 @@ class Tracer:
             key, tname = th.ident, th.name
         else:
             key = tname = track
-        self._events.append(("X", name, t0, t1, key, tname, attrs))
+        self._events.append(("X", name, t0, t1, key, tname, _stamp(attrs)))
 
     def record_async(self, name: str, t0: float, t1: float, track: str,
                      **attrs) -> None:
@@ -170,7 +208,8 @@ class Tracer:
         out on parallel lanes)."""
         if not self._enabled:
             return
-        self._events.append(("b", name, t0, t1, track, track, attrs))
+        self._events.append(("b", name, t0, t1, track, track,
+                             _stamp(attrs)))
 
     # -- consumption -----------------------------------------------------
     def events(self) -> List[Event]:

@@ -58,6 +58,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
 
@@ -104,6 +105,8 @@ def upload(arr: np.ndarray, sharding=None, label: str = "staging"):
     with _TOTALS_LOCK:
         _TOTALS["bytes"] += nbytes
         _TOTALS["uploads"] += 1
+    # fleet telemetry (outside the totals lock; exact no-op off)
+    _telemetry.note_h2d(nbytes)
     return out
 
 
@@ -280,6 +283,12 @@ class DataPlane:
         """Bytes currently resident and charged to ``tenant``."""
         with self._lock:
             return self._tenant_bytes.get(tenant, 0)
+
+    def tenant_usage_all(self) -> Dict[Any, int]:
+        """Resident bytes charged per tenant (the fleet endpoint's
+        per-tenant residency gauge)."""
+        with self._lock:
+            return dict(self._tenant_bytes)
 
     def release_tenant(self, tenant) -> int:
         """Release a tenant's plane charge (a cancelled or finished

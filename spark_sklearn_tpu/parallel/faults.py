@@ -43,6 +43,7 @@ structured log lines.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import os
 import re
@@ -53,6 +54,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.parallel.pipeline import LaunchItem
@@ -354,6 +356,12 @@ class LaunchSupervisor:
         self.plan = FaultPlan.resolve(config)
         self.verbose = int(verbose)
         self._ckpt = ckpt
+        #: kept for flight-recorder dumps (TpuConfig.flight_dir /
+        #: SST_FLIGHT_DIR resolve at dump time)
+        self._config = config
+        #: one OOM bundle per search — a deep bisection storm must not
+        #: dump a bundle per sub-range (guarded by self._lock)
+        self._oom_dumped = False
         self._tracer = get_tracer()
         self._lock = named_lock("faults.LaunchSupervisor._lock")
         self._seq = 0
@@ -397,6 +405,50 @@ class LaunchSupervisor:
                               if exc is not None else "")})
             except OSError:
                 _slog.warning("fault journal write failed for %s", key)
+        # fleet telemetry + the flight recorder's event ring (both
+        # called outside self._lock; the telemetry hook is an exact
+        # no-op when the service is disabled)
+        _telemetry.note_fault(cls, action)
+        _telemetry.flight_recorder().note(
+            "fault", key=key, group=group, fault_class=cls,
+            action=action, attempt=attempt,
+            error=(f"{type(exc).__name__}: {exc}"[:200]
+                   if exc is not None else ""))
+        self._maybe_flight_dump(key, group, cls, action, exc, attempt)
+
+    def _maybe_flight_dump(self, key: str, group: int, cls: str,
+                           action: str, exc: Optional[BaseException],
+                           attempt: int) -> None:
+        """Black-box bundles for the incidents worth a postmortem:
+        FATAL raises, watchdog timeouts, and the FIRST OOM recovery of
+        the search (the 3 a.m. OOM the flight recorder exists for —
+        deduped so a deep bisection storm dumps one bundle, not one
+        per sub-range).  No-op unless ``TpuConfig.flight_dir`` /
+        ``SST_FLIGHT_DIR`` names a directory — checked FIRST so the
+        default no-dump configuration never pays the payload copy."""
+        if _telemetry.resolve_flight_dir(self._config) is None:
+            return
+        reason = None
+        if cls == FATAL and action == "raise":
+            reason = "fatal"
+        elif cls == HUNG:
+            reason = "watchdog-timeout"
+        elif cls == OOM and action == "recover":
+            with self._lock:
+                if self._oom_dumped:
+                    return
+                self._oom_dumped = True
+            reason = "oom"
+        if reason is None:
+            return
+        with self._lock:
+            faults_copy = copy.deepcopy(self.faults)
+        _telemetry.flight_recorder().dump(
+            reason, config=self._config, faults=faults_copy,
+            context={"key": key, "group": group, "class": cls,
+                     "action": action, "attempt": attempt,
+                     "error": (f"{type(exc).__name__}: {exc}"[:300]
+                               if exc is not None else "")})
 
     def record_bisection(self, key: str, group: int) -> None:
         """Called by the item's bisect hook once per split."""

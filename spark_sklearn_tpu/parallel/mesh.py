@@ -219,6 +219,25 @@ class TpuConfig:
     # another tenant's (parallel/dataplane.py).  0 = no per-tenant
     # quota (the global dataplane_bytes budget still applies).
     dataplane_tenant_bytes: int = 0
+    # ---- fleet telemetry (obs/telemetry.py + obs/fleet.py) ----
+    # localhost metrics endpoint: the session serves Prometheus text at
+    # /metrics and the JSON snapshot at /snapshot.json on this port
+    # (127.0.0.1 only).  None disables telemetry entirely — an exact
+    # no-op, like the tracer — deferring to SST_TELEMETRY_PORT; 0 binds
+    # an ephemeral port (read it back from session.fleet_endpoint.port,
+    # or point tools/fleet_top.py at it).
+    telemetry_port: Optional[int] = None
+    # sliding-window span (seconds) the telemetry SLO series cover
+    # (per-tenant queue-wait p50/p95, throughput, shares, device
+    # occupancy) and the sampler thread's poll period.
+    telemetry_window_s: float = 120.0
+    telemetry_interval_s: float = 0.5
+    # flight recorder: directory black-box bundles dump to on FATAL
+    # faults, watchdog timeouts, first OOM recovery, cancellations and
+    # program-store quarantines.  None defers to SST_FLIGHT_DIR; unset
+    # disables dumping (the bounded in-memory event ring still
+    # records).
+    flight_dir: Optional[str] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

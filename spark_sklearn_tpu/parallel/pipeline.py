@@ -47,8 +47,13 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
-from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.obs.trace import (
+    current_correlation,
+    get_tracer,
+    set_correlation,
+)
 from spark_sklearn_tpu.parallel import dataplane as _dataplane
 from spark_sklearn_tpu.utils.locks import named_lock
 
@@ -245,6 +250,10 @@ class ChunkPipeline:
         self._compile_executor: Optional[ThreadPoolExecutor] = None
         self._compile_futures: List[Future] = []
         self._tracer = get_tracer()
+        # the constructing thread's tenant/handle correlation, applied
+        # to the stage/gather/compile worker threads so every span and
+        # log line they emit attributes to the owning search
+        self._corr = current_correlation()
         # per compile group: [first dispatch t, last finalize t] — the
         # compile-group boundary spans of the exported trace
         self._group_bounds: Dict[int, List[float]] = {}
@@ -263,6 +272,7 @@ class ChunkPipeline:
                 max_workers=1, thread_name_prefix="sst-compile")
 
         def job():
+            set_correlation(self._corr)
             with self._tracer.span("compile", label=label):
                 exe = precompile(jit_fn, *args)
             self._n_precompiled += 1
@@ -352,6 +362,9 @@ class ChunkPipeline:
         return jax.block_until_ready(out)
 
     def _record(self, item: LaunchItem, tm: LaunchTimings) -> None:
+        # fleet telemetry: the launch's device-busy estimate feeds the
+        # rolling device-occupancy series (exact no-op when disabled)
+        _telemetry.note_launch(tm.compute_s)
         rec = {
             "key": item.key, "group": item.group, "kind": item.kind,
             "n_tasks": item.n_tasks,
@@ -444,6 +457,7 @@ class ChunkPipeline:
         exhausted = False
 
         def staged_call(item):
+            set_correlation(self._corr)
             t0 = time.perf_counter()
             # bytes accounted via the (single) stage thread's delta of
             # the process-wide data-plane counter — supervisor re-stages
@@ -468,6 +482,7 @@ class ChunkPipeline:
                 staged.append((nxt, fut))
 
         def gather_job(item, out, t_dispatch0, t_dispatched, tm):
+            set_correlation(self._corr)
             with tr.span("compute.wait", key=item.key):
                 out = self._wait_item(item, out)
             t_ready = time.perf_counter()

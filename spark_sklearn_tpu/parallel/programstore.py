@@ -68,8 +68,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 import jax
 
+from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
+# crash-safe publish (tmp + fsync + os.replace): the one hardened
+# write path every store file (artifacts, plans.json, manifests) goes
+# through — shared with the flight recorder via utils/atomic.py
+from spark_sklearn_tpu.utils.atomic import atomic_write as _atomic_write
 from spark_sklearn_tpu.utils.locks import named_lock
 
 logger = get_logger(__name__)
@@ -120,22 +125,6 @@ def _digest(obj: Any, hexchars: int = 16) -> str:
     return h.hexdigest()
 
 
-def _atomic_write(path: str, payload: bytes) -> None:
-    """Crash-safe publish: tmp + fsync + ``os.replace`` — concurrent
-    writers of one path each replace with a complete file, last writer
-    wins, no reader ever sees a torn file.  The one hardened write
-    path every store file (artifacts, plans.json, manifests) goes
-    through."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.remove(tmp)
 
 
 def env_fingerprint() -> Dict[str, Any]:
@@ -189,8 +178,13 @@ class ProgramStore:
     """
 
     def __init__(self, directory: str,
-                 byte_budget: int = DEFAULT_STORE_BUDGET):
+                 byte_budget: int = DEFAULT_STORE_BUDGET,
+                 flight_dir: Optional[str] = None):
         self.directory = os.path.abspath(directory)
+        #: where a quarantine incident's flight bundle dumps
+        #: (TpuConfig.flight_dir of the activating session; the
+        #: SST_FLIGHT_DIR env var still applies as the fallback)
+        self.flight_dir = flight_dir
         self.env = env_fingerprint()
         self.env_digest = _digest(tuple(sorted(
             (k, tuple(v) if isinstance(v, list) else v)
@@ -260,6 +254,15 @@ class ProgramStore:
         logger.warning(
             "program store: quarantined corrupt artifact %s -> %s",
             os.path.basename(path), target)
+        # a quarantine is a black-box incident: something corrupted an
+        # on-disk artifact — bundle the recent events for postmortem
+        # (the activating config's flight_dir, else SST_FLIGHT_DIR;
+        # no-op when neither is set)
+        _telemetry.note_programstore("quarantine")
+        _telemetry.flight_recorder().dump(
+            "quarantine", flight_dir=self.flight_dir,
+            context={"artifact": os.path.basename(path),
+                     "moved_to": target, "store": self.directory})
 
     def _note_used(self, name: str, header: Dict[str, Any]) -> None:
         with self._lock:
@@ -328,6 +331,7 @@ class ProgramStore:
                 self._counts["bytes_loaded"] += nbytes
             else:
                 self._counts["misses"] += 1
+        _telemetry.note_programstore("hit" if ex is not None else "miss")
         get_tracer().record_span(
             "programstore.load", t0, time.perf_counter(), key=name,
             bytes=nbytes, hit=ex is not None, source=hit_kind,
@@ -365,6 +369,7 @@ class ProgramStore:
                 self._counts["publishes"] += 1
                 self._counts["bytes_saved"] += len(blob)
                 self._mem[name] = ex
+            _telemetry.note_programstore("publish")
             get_tracer().record_span(
                 "programstore.save", t0, time.perf_counter(), key=name,
                 bytes=len(blob), kind=kind, family=str(family))
@@ -679,10 +684,16 @@ def activate_store(config=None) -> Optional[ProgramStore]:
     with _STORE_LOCK:
         if _STORE is None or \
                 _STORE.directory != os.path.abspath(directory):
-            _STORE = ProgramStore(directory, budget)
+            _STORE = ProgramStore(
+                directory, budget,
+                flight_dir=getattr(config, "flight_dir", None))
             fresh = True
         else:
             _STORE.byte_budget = int(budget)
+            fd = getattr(config, "flight_dir", None)
+            if fd:
+                # the latest activating session's flight dir wins
+                _STORE.flight_dir = fd
         store = _STORE
     if fresh:
         state = store.load_plan_state()

@@ -69,8 +69,9 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
-from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.obs.trace import get_tracer, set_correlation
 from spark_sklearn_tpu.parallel.pipeline import LaunchItem
 from spark_sklearn_tpu.utils.locks import named_rlock
 
@@ -189,7 +190,9 @@ class SearchHandle:
         self.cost_dispatched = 0   # task units dispatched
         self.inflight = 0          # chunks dispatched, not yet finalized
         self.planned = 0           # live chunk estimate (progress())
-        self.queue_waits: List[float] = []
+        #: bounded {tenant, wait_s} records — tenant-stamped so samples
+        #: merged across concurrent searches still attribute per tenant
+        self.queue_waits: List[Dict[str, Any]] = []
         self.queue_wait_s = 0.0
         self.queue_wait_max_s = 0.0
         self.t_start: Optional[float] = None
@@ -476,6 +479,12 @@ class SearchExecutor:
 
         def run():
             _TLS.binding = _Binding(self, handle)
+            # tenant/handle correlation: stamped onto every span and
+            # structured log record this thread (and the pipeline
+            # workers it spawns) emits, so a multi-tenant trace or
+            # flight bundle attributes each event to its search
+            set_correlation({"tenant": handle.tenant,
+                             "handle": handle.id})
             exc: Optional[BaseException] = None
             try:
                 if handle.cancelled:
@@ -495,6 +504,7 @@ class SearchExecutor:
                 exc = e
             finally:
                 _TLS.binding = None
+                set_correlation(None)
                 self._finish_search(handle, exc)
                 future._finish(exc)
         return run
@@ -594,6 +604,16 @@ class SearchExecutor:
             handle.future._finish(exc)
         logger.info("search %s cancelled (%d queued chunk(s) drained)",
                     handle.id, len(drained), handle=handle.id)
+        # black box: a cancellation is an operator-visible incident —
+        # bundle the scheduler state + recent events for the postmortem
+        # (dir checked FIRST: without one, no state is even copied)
+        if _telemetry.resolve_flight_dir(self.config) is not None:
+            _telemetry.flight_recorder().dump(
+                "cancelled", config=self.config,
+                scheduler={**self.stats(),
+                           "dispatch_log": self.dispatch_log()[-256:]},
+                context={"handle": handle.id, "tenant": handle.tenant,
+                         "drained": len(drained)})
         return True
 
     def progress(self, handle: SearchHandle) -> Dict[str, Any]:
@@ -649,6 +669,8 @@ class SearchExecutor:
                 # slot, its wall is not in dispatch_s)
                 if state["first_wait"] is None:
                     state["first_wait"] = 0.0
+                self._note_dispatch_out(handle, cost, None,
+                                        fastpath=True, key=item.key)
                 return inner_launch(payload)
             req = _Request(handle=handle, item=item, launch=inner_launch,
                            payload=payload, cost=cost, state=state,
@@ -832,19 +854,43 @@ class SearchExecutor:
                 h.queue_wait_s += wait
                 h.queue_wait_max_s = max(h.queue_wait_max_s, wait)
                 if len(h.queue_waits) < _MAX_WAIT_SAMPLES:
-                    h.queue_waits.append(round(wait, 6))
+                    # tenant-stamped sample (ISSUE 8 satellite): merged
+                    # samples from concurrent searches still attribute,
+                    # so bench/fleet derive PER-TENANT p50/p95 from it
+                    h.queue_waits.append(
+                        {"tenant": h.tenant, "wait_s": round(wait, 6)})
                 return head
             if runnable == 0:
                 self._work.clear()
             return None
 
+    def _note_dispatch_out(self, handle: SearchHandle, cost: int,
+                           wait_s: Optional[float], fastpath: bool,
+                           key: str = "") -> None:
+        """Fleet-telemetry + flight-recorder dispatch notes — always
+        called OUTSIDE the executor lock, so telemetry introduces no
+        cross-module lock nesting.  ``wait_s`` is None for fastpath
+        dispatches (they never queued; the SLO wait percentiles cover
+        routed dispatches only, like the scheduler block's sample)."""
+        _telemetry.note_dispatch(handle.tenant, cost, wait_s=wait_s)
+        _telemetry.flight_recorder().note(
+            "dispatch", handle=handle.id, tenant=handle.tenant,
+            cost=cost, key=key,
+            wait_s=round(wait_s, 6) if wait_s is not None else 0.0,
+            fastpath=fastpath)
+
     def _run_request(self, req: _Request) -> None:
+        self._note_dispatch_out(
+            req.handle, req.cost,
+            max(0.0, req.t_dequeued - req.t_enqueued),
+            fastpath=False, key=req.item.key)
         if req.handle.cancelled:
             self._note_done(req.handle, req.state)
             req.reply.set_exception(SearchCancelledError(
                 f"search {req.handle.id!r} was cancelled"))
             return
         tr = get_tracer()
+        t_busy0 = time.perf_counter()
         try:
             with tr.span("sched.dispatch", key=req.item.key,
                          tenant=req.handle.tenant, handle=req.handle.id,
@@ -856,8 +902,10 @@ class SearchExecutor:
         # nothing is swallowed and other tenants keep dispatching
         # sstlint: disable=broad-except-swallow,launch-except-taxonomy
         except BaseException as exc:
+            _telemetry.note_sched_busy(time.perf_counter() - t_busy0)
             req.reply.set_exception(exc)
             return
+        _telemetry.note_sched_busy(time.perf_counter() - t_busy0)
         req.reply.set_result(out)
 
     # -- drain/test aids -------------------------------------------------
@@ -892,6 +940,18 @@ class SearchExecutor:
                     for name, t in sorted(self._tenants.items())},
             }
 
+    def telemetry_gauges(self) -> Dict[str, Any]:
+        """Sampler provider (obs/telemetry.py): the scheduler gauges
+        the fleet endpoint polls — total queue depth plus the
+        active/pending search counts."""
+        with self._lock:
+            return {
+                "queue_depth": sum(
+                    len(t.queue) for t in self._tenants.values()),
+                "n_active": len(self._active),
+                "n_pending": len(self._pending),
+            }
+
     # -- reporting -------------------------------------------------------
     def search_block(self, handle: SearchHandle) -> Dict[str, Any]:
         """The search's rendered ``search_report["scheduler"]`` block
@@ -916,7 +976,7 @@ class SearchExecutor:
                 "queue_wait_max_s": round(handle.queue_wait_max_s, 6),
                 "share_frac": handle.share_frac,
                 "tenant_shares": dict(handle.tenant_shares),
-                "waits": list(handle.queue_waits),
+                "waits": [dict(w) for w in handle.queue_waits],
             }
 
     # -- lifecycle -------------------------------------------------------

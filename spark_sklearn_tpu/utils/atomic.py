@@ -1,0 +1,32 @@
+"""Crash-safe file publication — the one hardened write idiom.
+
+``tmp + flush + fsync + os.replace``: concurrent writers of one path
+each replace with a complete file, last writer wins, and no reader
+ever sees a torn file.  Extracted from the program store's artifact
+writer (PR 6) so the flight recorder's black-box bundles — written
+mid-incident, exactly when a crash is most likely — share the same
+guarantees instead of a drifting hand-rolled copy.
+
+stdlib-only on purpose: both ``parallel/programstore.py`` and
+``obs/telemetry.py`` import it, so it must sit below both.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["atomic_write"]
+
+
+def atomic_write(path: str, payload: bytes) -> None:
+    """Atomically publish ``payload`` at ``path`` (see module doc)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)

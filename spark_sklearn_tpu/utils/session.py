@@ -75,6 +75,17 @@ class TpuSession:
             # search is actually submitted
             from spark_sklearn_tpu.serve import SearchExecutor
             self.executor = SearchExecutor(self.config, appName)
+            # fleet telemetry (obs/telemetry.py + obs/fleet.py):
+            # default OFF — no thread, no socket, hooks early-out.
+            # TpuConfig(telemetry_port) / SST_TELEMETRY_PORT turns on
+            # the process-wide aggregator, registers this session's
+            # scheduler/dataplane/programstore providers, and serves
+            # Prometheus + JSON snapshots on localhost
+            self.telemetry = None
+            self.fleet_endpoint = None
+            self._telemetry_owned = False
+            self._telemetry_providers = {}
+            self._init_telemetry()
         # structured logging channel (never stdout: the session has no
         # legacy print contract)
         logger.info("TpuSession %r: mesh=%s, cache_dir=%r", appName,
@@ -101,6 +112,80 @@ class TpuSession:
             getattr(self.config, "retry_backoff_s", 0.5),
             getattr(self.config, "launch_timeout_s", None),
             len(self.fault_plan))
+
+    def _init_telemetry(self) -> None:
+        from spark_sklearn_tpu.obs import fleet as _fleet
+        from spark_sklearn_tpu.obs import telemetry as _telemetry
+        port = _fleet.resolve_telemetry_port(self.config)
+        if port is None:
+            return
+        svc = _telemetry.get_telemetry()
+        svc.enable(
+            window_s=getattr(self.config, "telemetry_window_s", None),
+            interval_s=getattr(self.config, "telemetry_interval_s",
+                               None))
+        self.telemetry = svc
+        self._telemetry_owned = True
+        # this session's own provider callables, remembered so stop()
+        # (and the unwind below) tears down exactly these — never a
+        # later session's registration under the same name
+        self._telemetry_providers = {
+            "scheduler": self.executor.telemetry_gauges}
+        if self.dataplane is not None:
+            plane = self.dataplane
+
+            def _plane_gauges():
+                return {**plane.stats(),
+                        "tenant_bytes": {
+                            str(t): b for t, b in
+                            plane.tenant_usage_all().items()}}
+
+            self._telemetry_providers["dataplane"] = _plane_gauges
+        if self.programstore is not None:
+            self._telemetry_providers["programstore"] = \
+                self.programstore.counts
+        try:
+            for name, fn in self._telemetry_providers.items():
+                svc.register_provider(name, fn)
+            self.fleet_endpoint = _fleet.FleetEndpoint(
+                port, service=svc).start()
+        except BaseException:
+            # a failed endpoint bind (port in use) must not leave the
+            # process-global service enabled with a live sampler bound
+            # to this half-built session — unwind to the exact no-op
+            self._teardown_telemetry()
+            raise
+        logger.info(
+            "fleet telemetry: window=%.0fs interval=%.2fs endpoint=%s",
+            svc.window_s, svc.interval_s, self.fleet_endpoint.url,
+            url=self.fleet_endpoint.url)
+
+    def _teardown_telemetry(self) -> None:
+        """Release this session's telemetry: drop ONE enable reference
+        (refcounted — another telemetry-enabled session keeps the
+        shared service alive) and unregister exactly the providers this
+        session registered (identity-checked, so a later session's
+        same-name registrations survive)."""
+        svc = self.telemetry
+        self.telemetry = None
+        self._telemetry_owned = False
+        if svc is None:
+            return
+        svc.disable()
+        for name, fn in getattr(self, "_telemetry_providers",
+                                {}).items():
+            svc.unregister_provider(name, expected=fn)
+        self._telemetry_providers = {}
+
+    def telemetry_snapshot(self) -> dict:
+        """The fleet-telemetry snapshot (schema pinned in
+        ``obs.metrics.TELEMETRY_SNAPSHOT_SCHEMA``): per-tenant
+        queue-wait p50/p95 / throughput / share over the sliding
+        window, device occupancy, scheduler queue depth, data-plane and
+        program-store gauges, fault totals and flight-recorder state.
+        The zeroed ``enabled: False`` shape when telemetry is off."""
+        from spark_sklearn_tpu.obs import telemetry as _telemetry
+        return _telemetry.get_telemetry().snapshot()
 
     @property
     def n_devices(self) -> int:
@@ -200,8 +285,14 @@ class TpuSession:
     def stop(self):
         """Shut the session's search executor down (reference API
         symmetry: SparkSession.stop).  Running searches finish, the
-        waiting line cancels, new submissions raise AdmissionError."""
+        waiting line cancels, new submissions raise AdmissionError.
+        A session-owned telemetry endpoint and sampler stop too."""
         self.executor.shutdown()
+        if self.fleet_endpoint is not None:
+            self.fleet_endpoint.stop()
+            self.fleet_endpoint = None
+        if self._telemetry_owned:
+            self._teardown_telemetry()
 
     def __repr__(self):
         return (f"TpuSession(appName={self.appName!r}, "

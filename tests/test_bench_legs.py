@@ -104,6 +104,16 @@ class TestLegsToyShapes:
                             "queue_wait_p50_s", "queue_wait_p95_s"])
         assert len(c2["interleave_frac"]) == 2
         assert c2["queue_wait_p95_s"] >= c2["queue_wait_p50_s"]
+        # tenant-stamped waits (ISSUE 8): the contended leg reports a
+        # distinct per-tenant distribution, not just the aggregate
+        # (a tenant whose dispatches all ran fastpath — e.g. the other
+        # search already drained — legitimately has no wait samples)
+        per_tenant = c2["per_tenant_queue_wait"]
+        assert set(per_tenant) <= {"tenant0", "tenant1"}, per_tenant
+        assert per_tenant, c2
+        for t in per_tenant.values():
+            assert t["p95_s"] >= t["p50_s"] >= 0.0
+            assert t["n"] >= 1
 
 
 def _last_json_line(stdout):

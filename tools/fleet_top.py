@@ -1,0 +1,166 @@
+"""Live digest of a running session's fleet-telemetry endpoint.
+
+``top`` for the multi-tenant search service: tails the JSON snapshot a
+:class:`~spark_sklearn_tpu.utils.session.TpuSession` serves when
+``TpuConfig(telemetry_port)`` / ``SST_TELEMETRY_PORT`` is set, and
+prints the per-tenant SLO table (queue-wait p50/p95, throughput,
+share, residency), device occupancy, scheduler queue depth, data-plane
+and program-store traffic, fault totals and flight-recorder state:
+
+    python tools/fleet_top.py --port 9090            # one shot
+    python tools/fleet_top.py --port 9090 --watch 2  # refresh every 2s
+    python tools/fleet_top.py --url http://127.0.0.1:9090 --json
+
+stdlib-only (urllib): digesting a fleet never pays the jax import.
+Exits nonzero when the endpoint is unreachable or telemetry is
+disabled — the CI smoke leg uses that as its assertion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+__all__ = ["fetch_snapshot", "format_snapshot", "main"]
+
+
+def fetch_snapshot(url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """GET ``<url>/snapshot.json`` and parse it.  Raises OSError /
+    ValueError on unreachable endpoints or non-JSON payloads."""
+    target = url.rstrip("/") + "/snapshot.json"
+    with urllib.request.urlopen(target, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt_bytes(n: Any) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def format_snapshot(snap: Dict[str, Any]) -> str:
+    out = []
+    dev = snap.get("device") or {}
+    sched = snap.get("scheduler") or {}
+    out.append(
+        f"fleet @ {time.strftime('%H:%M:%S')}  "
+        f"window={snap.get('window_s', 0):.0f}s  "
+        f"samples={snap.get('n_samples', 0)}  "
+        f"device occupancy={100 * dev.get('occupancy_frac', 0.0):.1f}%  "
+        f"dispatch-loop idle="
+        f"{100 * sched.get('loop_idle_frac', 1.0):.1f}%")
+    out.append(
+        f"scheduler: {sched.get('dispatches_total', 0)} dispatches, "
+        f"queue depth {sched.get('queue_depth', 0)}, "
+        f"{sched.get('n_active', 0)} active / "
+        f"{sched.get('n_pending', 0)} pending search(es)")
+    tenants = snap.get("tenants") or {}
+    if tenants:
+        out.append("")
+        out.append(f"  {'tenant':<16} {'disp':>6} {'tasks':>8} "
+                   f"{'thr/s':>8} {'share':>6} {'p50 wait':>9} "
+                   f"{'p95 wait':>9} {'resident':>10}")
+        for name in sorted(tenants):
+            t = tenants[name]
+            out.append(
+                f"  {name:<16} {t.get('dispatches_total', 0):>6} "
+                f"{t.get('tasks_total', 0):>8} "
+                f"{t.get('throughput_tasks_per_s', 0.0):>8.1f} "
+                f"{100 * t.get('share_frac', 0.0):>5.1f}% "
+                f"{1e3 * t.get('queue_wait_p50_s', 0.0):>7.1f}ms "
+                f"{1e3 * t.get('queue_wait_p95_s', 0.0):>7.1f}ms "
+                f"{_fmt_bytes(t.get('residency_bytes', 0)):>10}")
+    else:
+        out.append("  (no tenant traffic in the window)")
+    dp = snap.get("dataplane") or {}
+    if dp:
+        out.append(
+            f"dataplane: {_fmt_bytes(dp.get('h2d_bytes_total', 0))} "
+            f"host->device total "
+            f"({_fmt_bytes(dp.get('h2d_bytes_per_s', 0))}/s), "
+            f"cache {dp.get('hits', 0)} hits / "
+            f"{dp.get('misses', 0)} misses, "
+            f"{_fmt_bytes(dp.get('bytes_in_cache', 0))} resident")
+    ps = snap.get("programstore") or {}
+    if ps:
+        out.append(
+            "programstore: "
+            f"{ps.get('hit_total', ps.get('hits', 0))} hits / "
+            f"{ps.get('miss_total', ps.get('misses', 0))} misses, "
+            f"{ps.get('publish_total', ps.get('publishes', 0))} "
+            "publishes, "
+            f"{ps.get('quarantine_total', ps.get('quarantined', 0))} "
+            "quarantined")
+    faults = snap.get("faults") or {}
+    if faults.get("total"):
+        by_cls = ", ".join(f"{k}={v}" for k, v in sorted(
+            (faults.get("by_class") or {}).items()))
+        out.append(f"faults: {faults['total']} ({by_cls})")
+    flight = snap.get("flight") or {}
+    out.append(
+        f"flight recorder: {flight.get('n_buffered', 0)} buffered / "
+        f"{flight.get('n_records', 0)} total record(s), "
+        f"{flight.get('n_dumps', 0)} bundle(s) dumped")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="endpoint base url (default built from --port)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="localhost endpoint port "
+                         "(TpuConfig.telemetry_port)")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECS",
+                    help="refresh every SECS seconds until interrupted "
+                         "(default: print once and exit)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw snapshot JSON instead of the "
+                         "table")
+    args = ap.parse_args(argv)
+    if not args.url and args.port is None:
+        ap.error("pass --port or --url")
+    url = args.url or f"http://127.0.0.1:{args.port}"
+
+    def once() -> int:
+        try:
+            snap = fetch_snapshot(url)
+        except (OSError, ValueError) as exc:
+            print(f"error: fleet endpoint {url} unreachable ({exc})",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(snap, indent=2))
+        else:
+            print(format_snapshot(snap))
+        if not snap.get("enabled"):
+            print("error: telemetry service reports disabled",
+                  file=sys.stderr)
+            return 3
+        return 0
+
+    if args.watch is None:
+        return once()
+    try:
+        while True:
+            rc = once()
+            if rc:
+                return rc
+            time.sleep(max(0.1, args.watch))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

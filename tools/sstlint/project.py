@@ -124,7 +124,7 @@ class Project:
                             "faults.LaunchSupervisor._lock",
                             cls="LaunchSupervisor",
                             attrs=("faults", "_retries_used",
-                                   "_sticky_oom")),
+                                   "_sticky_oom", "_oom_dumped")),
                 # taskgrid: the geometry plan cache + cost model
                 SharedState("parallel/taskgrid.py",
                             "taskgrid._PLAN_CACHE_LOCK",
@@ -158,6 +158,28 @@ class Project:
                 # obs/log: the logger cache
                 SharedState("obs/log.py", "log._LOGGERS_LOCK",
                             name="_LOGGERS"),
+                # obs/telemetry: the fleet-telemetry aggregator, hit by
+                # every note_* hook (dispatch loop, gather threads,
+                # supervisor recovery) plus the sampler thread
+                SharedState("obs/telemetry.py",
+                            "telemetry.TelemetryService._lock",
+                            cls="TelemetryService",
+                            attrs=("enabled", "_enable_count",
+                                   "window_s", "interval_s",
+                                   "_t_enabled", "_we_enabled_tracer",
+                                   "_thread", "_tenants", "_device_busy",
+                                   "_sched_busy",
+                                   "_sched_dispatches_total",
+                                   "_faults_by_class",
+                                   "_faults_by_action", "_h2d",
+                                   "_h2d_window", "_ps_events",
+                                   "_providers", "_polls",
+                                   "_n_samples")),
+                # obs/telemetry: the always-on flight-recorder ring
+                SharedState("obs/telemetry.py",
+                            "telemetry.FlightRecorder._lock",
+                            cls="FlightRecorder",
+                            attrs=("_ring", "_n_dumps", "_n_records")),
             ),
             blocks=(
                 BlockSpec("pipeline", "PIPELINE_BLOCK_SCHEMA", (
@@ -184,6 +206,10 @@ class Project:
                              "report_block"),
                     Producer("dict-keys", "serve/executor.py",
                              "SearchExecutor.search_block"),
+                )),
+                BlockSpec("telemetry", "TELEMETRY_SNAPSHOT_SCHEMA", (
+                    Producer("dict-keys", "obs/telemetry.py",
+                             "TelemetryService.snapshot"),
                 )),
             ),
             launch_paths=(

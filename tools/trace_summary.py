@@ -16,6 +16,14 @@ Span names are interpreted through the registered vocabulary
 group by their registered prefix, and names the vocabulary has never
 heard of produce a stderr warning so a drifting producer is visible
 even from a bare trace file.
+
+Multi-tenant traces (searches submitted through a TpuSession's
+fair-share executor) carry a ``tenant``/``handle`` correlation on
+every span; ``--tenant NAME`` restricts the digest to one tenant's
+events, and the per-tenant rollup section attributes span time across
+tenants.  Flight-recorder bundles (obs/telemetry.py) embed their trace
+slice under the standard ``traceEvents`` key, so a bundle file digests
+here directly.
 """
 
 from __future__ import annotations
@@ -27,8 +35,8 @@ import sys
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
-__all__ = ["load_events", "load_vocabulary", "summarize",
-           "format_summary", "main"]
+__all__ = ["filter_tenant", "load_events", "load_vocabulary",
+           "summarize", "format_summary", "main"]
 
 
 def load_vocabulary():
@@ -55,6 +63,44 @@ def load_events(path: str) -> List[Dict[str, Any]]:
     if isinstance(data, dict):
         data = data.get("traceEvents", [])
     return [e for e in data if isinstance(e, dict)]
+
+
+def filter_tenant(events: List[Dict[str, Any]],
+                  tenant: str) -> List[Dict[str, Any]]:
+    """Only the events stamped with ``tenant`` (correlation attrs from
+    the multi-tenant executor), keeping the ``M`` metadata records that
+    name threads — so a per-tenant digest still labels its tracks."""
+    return [e for e in events
+            if e.get("ph") == "M"
+            or (e.get("args") or {}).get("tenant") == tenant]
+
+
+def _tenant_rollup(spans: List[Dict[str, Any]],
+                   events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-tenant span attribution: count/total-ms over the tenant-
+    stamped X spans plus each tenant's async launch count."""
+    roll: Dict[str, Dict[str, Any]] = {}
+    for e in spans:
+        tenant = (e.get("args") or {}).get("tenant")
+        if not tenant:
+            continue
+        rec = roll.setdefault(
+            tenant, {"n_spans": 0, "total_ms": 0.0, "n_launches": 0})
+        rec["n_spans"] += 1
+        rec["total_ms"] += float(e.get("dur", 0.0)) / 1e3
+    for e in events:
+        if e.get("ph") != "b" or \
+                not str(e.get("name", "")).startswith("launch"):
+            continue
+        tenant = (e.get("args") or {}).get("tenant")
+        if not tenant:
+            continue
+        rec = roll.setdefault(
+            tenant, {"n_spans": 0, "total_ms": 0.0, "n_launches": 0})
+        rec["n_launches"] += 1
+    for rec in roll.values():
+        rec["total_ms"] = round(rec["total_ms"], 3)
+    return roll
 
 
 def _self_times(spans: List[Dict[str, Any]]) -> Dict[int, float]:
@@ -193,6 +239,7 @@ def summarize(events: List[Dict[str, Any]], top: int = 12,
     return {
         "h2d": h2d,
         "compile": compile_digest,
+        "tenants": _tenant_rollup(spans, events),
         "unknown_names": sorted(unknown),
         "n_events": len(events),
         "n_spans": len(spans),
@@ -241,6 +288,15 @@ def format_summary(s: Dict[str, Any]) -> str:
             f"({h2d['bytes_per_launch'] / 1e6:.3f} MB per launch); "
             f"{h2d['bytes_tiled_on_device'] / 1e6:.3f} MB tiled "
             "on-device (no transfer)")
+    tenants = s.get("tenants") or {}
+    if tenants:
+        out.append("\nper-tenant rollup (correlation-stamped spans):")
+        out.append(f"  {'tenant':<20} {'spans':>6} {'span ms':>10} "
+                   f"{'launches':>9}")
+        for tenant in sorted(tenants):
+            r = tenants[tenant]
+            out.append(f"  {tenant:<20} {r['n_spans']:>6} "
+                       f"{r['total_ms']:>10.1f} {r['n_launches']:>9}")
     comp = s.get("compile") or {}
     if comp.get("compile_wall_ms") or comp.get("store_loads"):
         out.append(
@@ -257,10 +313,15 @@ def main(argv=None) -> int:
     ap.add_argument("trace", help="Chrome trace-event JSON file")
     ap.add_argument("--top", type=int, default=12,
                     help="how many span names to list (default 12)")
+    ap.add_argument("--tenant", default=None,
+                    help="restrict the digest to one tenant's "
+                         "correlation-stamped events")
     ap.add_argument("--json", action="store_true",
                     help="emit the digest as JSON instead of a table")
     args = ap.parse_args(argv)
     events = load_events(args.trace)
+    if args.tenant:
+        events = filter_tenant(events, args.tenant)
     s = summarize(events, top=args.top)
     try:
         if args.json:
