@@ -31,7 +31,7 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 # acquisition-order inversion
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
-    tests/test_sstlint.py -q
+    tests/test_halving.py tests/test_sstlint.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -293,6 +293,53 @@ PY
 # trace digest reads the black box directly (exit 0 = spans found)
 JAX_PLATFORMS=cpu python tools/trace_summary.py "$FLIGHT_DIR"/flight-oom-*.json
 rm -rf "$FLIGHT_DIR"
+
+echo "== adaptive-search smoke (halving rungs + lane reclamation) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.naive_bayes import GaussianNB
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+grid = {"var_smoothing": np.logspace(-9, -5, 24).tolist()}
+# manual cost overrides pin the geometry (and zero the width-affinity
+# allowance) so the reclaimed-lane assertion is deterministic
+geo = dict(geometry_overhead_s=0.05, geometry_lane_cost_s=0.001)
+
+
+def run(**kw):
+    return sst.HalvingGridSearchCV(
+        GaussianNB(), grid, cv=2, factor=3, random_state=7,
+        backend="tpu", config=sst.TpuConfig(**geo, **kw)).fit(X, y)
+
+
+on, off = run(), run(halving_replan=False)
+hb = on.search_report["halving"]
+# the rung schedule ran (3 rungs at factor=3 over 24 candidates)...
+assert on.n_iterations_ == hb["n_rungs"] == 3, hb
+assert on.n_candidates_ == [24, 8, 3]
+# ...re-planning reclaimed the eliminated candidates' lanes...
+assert hb["lanes_reclaimed_total"] > 0, hb
+assert on.search_report["halving"]["rungs"][1]["widths"][0] < \
+    on.search_report["halving"]["rungs"][0]["widths"][0]
+# ...and replanning is purely a geometry optimization: byte-identical
+# cv_results_ with it off (survivors padded to rung-0 widths)
+assert off.search_report["halving"]["lanes_reclaimed_total"] == 0
+for k in on.cv_results_:
+    if "time" in k or k == "params":
+        continue
+    np.testing.assert_array_equal(np.asarray(on.cv_results_[k]),
+                                  np.asarray(off.cv_results_[k]),
+                                  err_msg=k)
+print("halving smoke:",
+      {"n_rungs": hb["n_rungs"],
+       "lanes_reclaimed": hb["lanes_reclaimed_total"],
+       "widths": [r["widths"] for r in hb["rungs"]]})
+PY
 
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'

@@ -21,6 +21,10 @@ __version__ = "0.5.0"
 
 import spark_sklearn_tpu.models  # noqa: F401 — registers Tier-A families
 from spark_sklearn_tpu.search.grid import GridSearchCV, RandomizedSearchCV
+from spark_sklearn_tpu.search.halving import (
+    HalvingGridSearchCV,
+    HalvingRandomSearchCV,
+)
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
 from spark_sklearn_tpu.convert.converter import Converter
 from spark_sklearn_tpu.keyed.keyed import KeyedEstimator, KeyedModel
@@ -42,6 +46,8 @@ from spark_sklearn_tpu.serve import (
 __all__ = [
     "GridSearchCV",
     "RandomizedSearchCV",
+    "HalvingGridSearchCV",
+    "HalvingRandomSearchCV",
     "AdmissionError",
     "SearchCancelledError",
     "SearchExecutor",

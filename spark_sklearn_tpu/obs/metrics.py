@@ -33,6 +33,7 @@ __all__ = [
     "GEOMETRY_BLOCK_SCHEMA",
     "PROGRAMSTORE_BLOCK_SCHEMA",
     "SCHEDULER_BLOCK_SCHEMA",
+    "HALVING_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
@@ -149,6 +150,14 @@ SEARCH_REPORT_SCHEMA = (
         "submitted to a TpuSession's SearchExecutor; the zeroed "
         "enabled=False shape for a standalone fit "
         "(serve/executor.py)."),
+    MetricDef(
+        "halving", "struct",
+        "Successive-halving searches only (see the halving-block "
+        "schema below): per-rung candidate counts, resources, chunk "
+        "widths, walls and the lanes reclaimed by mid-search "
+        "geometry re-planning (search/halving.py).  Absent on "
+        "exhaustive searches.",
+        backends="tpu,host"),
     MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
@@ -423,6 +432,47 @@ SCHEDULER_BLOCK_SCHEMA = (
 )
 
 
+#: sub-keys of ``search_report["halving"]`` (written by
+#: ``search.halving._render_halving_block``) — the adaptive-search
+#: scheduler's observable surface: what each rung cost and what lane
+#: reclamation saved.
+HALVING_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Always True when present: the block only renders for "
+              "HalvingGridSearchCV / HalvingRandomSearchCV fits."),
+    MetricDef("factor", "gauge",
+              "The halving factor: each rung keeps "
+              "ceil(n_candidates / factor) survivors."),
+    MetricDef("resource", "label",
+              "The budgeted resource: 'n_samples' (fold-mask "
+              "subsampling) or an estimator parameter (e.g. "
+              "'n_estimators' via the masked-prefix trick)."),
+    MetricDef("replan", "label",
+              "Whether mid-search lane reclamation was on "
+              "(TpuConfig.halving_replan): rungs re-planned into "
+              "narrower chunks vs. survivors padded to rung-0 "
+              "widths."),
+    MetricDef("min_rung_width", "gauge",
+              "The configured floor on re-planned rung widths "
+              "(TpuConfig.min_rung_width; 0 = shard multiple only)."),
+    MetricDef("n_rungs", "gauge",
+              "Rungs executed (== n_iterations_)."),
+    MetricDef("lanes_reclaimed_total", "gauge",
+              "Total (candidate x fold) lanes the re-planner retired "
+              "across rungs, vs. running every rung at its rung-0 "
+              "chunk widths — freed device lanes instead of padding "
+              "waste."),
+    MetricDef("rungs", "series",
+              "One record per rung: iter, n_candidates, n_resources, "
+              "wall_s, widths (per compile group), "
+              "n_launches_planned, n_chunks_resumed, "
+              "lanes_reclaimed, padding_saved_frac, pipe_wall_s and "
+              "cost_observations (the geometry cost model's "
+              "observation count when the rung planned — increasing "
+              "across rungs proves mid-search feedback)."),
+)
+
+
 #: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
 #: telemetry service's JSON view (``obs/telemetry.py``), also served
 #: as ``/snapshot.json`` (and rendered to Prometheus text) by the
@@ -678,6 +728,13 @@ def schema_markdown() -> str:
     out.append("\n### `search_report[\"scheduler\"]` block\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in SCHEDULER_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"halving\"]` block\n")
+    out.append(
+        "\nPresent only on `HalvingGridSearchCV` / "
+        "`HalvingRandomSearchCV` fits (`search/halving.py`).\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in HALVING_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
                "endpoint schema\n")

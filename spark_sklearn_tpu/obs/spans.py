@@ -60,6 +60,15 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "The best_estimator_ refit after the sweep."),
     SpanDef("host.fit_and_score", "span", "search.grid",
             "Host-tier per-candidate sklearn _fit_and_score fan-out."),
+    SpanDef("geometry.replan", "span", "search.grid",
+            "Mid-search geometry re-plan of a halving rung's "
+            "surviving candidates (lane reclamation; carries iter and "
+            "whether replanning was on)."),
+    # search/halving.py
+    SpanDef("halving.rung", "span", "search.halving",
+            "One successive-halving rung: fit + score of the "
+            "surviving candidates at this rung's resource (carries "
+            "iter, n_candidates, n_resources)."),
     # parallel/taskgrid.py
     SpanDef("build_compile_groups", "span", "parallel.taskgrid",
             "Partitioning candidates into static-signature groups."),

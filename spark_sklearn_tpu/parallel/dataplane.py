@@ -152,8 +152,8 @@ class DataPlane:
 
     def __init__(self, byte_budget: int = DEFAULT_BYTE_BUDGET):
         self._lock = named_rlock("dataplane.DataPlane._lock")
-        #: key -> (device array, nbytes, tenant)
-        self._entries: "OrderedDict[Any, Tuple[Any, int, Any]]" = \
+        #: key -> (device array, nbytes, tenant, label)
+        self._entries: "OrderedDict[Any, Tuple[Any, int, Any, str]]" = \
             OrderedDict()
         self._bytes = 0
         self.byte_budget = int(byte_budget)
@@ -185,16 +185,24 @@ class DataPlane:
             self._evict_over_budget()
         return self
 
+    def _uncharge(self, tenant, nbytes: int) -> None:
+        """Drop ``nbytes`` from a tenant's charged usage; usage
+        reaching zero removes the accounting row.  (Callers hold the
+        reentrant plane lock; taken again for standalone safety.)"""
+        if tenant is None:
+            return
+        with self._lock:
+            left = self._tenant_bytes.get(tenant, 0) - int(nbytes)
+            if left > 0:
+                self._tenant_bytes[tenant] = left
+            else:
+                self._tenant_bytes.pop(tenant, None)
+
     def _pop_entry(self, key) -> None:
         with self._lock:
-            _, nbytes, tenant = self._entries.pop(key)
+            _, nbytes, tenant, _ = self._entries.pop(key)
             self._bytes -= nbytes
-            if tenant is not None:
-                left = self._tenant_bytes.get(tenant, 0) - nbytes
-                if left > 0:
-                    self._tenant_bytes[tenant] = left
-                else:
-                    self._tenant_bytes.pop(tenant, None)
+            self._uncharge(tenant, nbytes)
             self.evictions += 1
 
     def _over_quota(self, tenant) -> bool:
@@ -214,7 +222,7 @@ class DataPlane:
                 # global pressure when no such victim exists (e.g. the
                 # quotas were configured to exceed the plane budget)
                 key = None
-                for k, (_, _, t) in self._entries.items():
+                for k, (_, _, t, _lb) in self._entries.items():
                     if k == keep:
                         continue
                     if t is None or t == inserting or self._over_quota(t):
@@ -244,7 +252,8 @@ class DataPlane:
                 return hit[0]
             return None
 
-    def _insert(self, key, value, nbytes: int, tenant: Any = None):
+    def _insert(self, key, value, nbytes: int, tenant: Any = None,
+                label: str = ""):
         with self._lock:
             if key in self._entries:
                 return
@@ -256,12 +265,12 @@ class DataPlane:
                 while self._tenant_bytes.get(tenant, 0) + int(nbytes) \
                         > quota:
                     victim = next(
-                        (k for k, (_, _, t) in self._entries.items()
+                        (k for k, (_, _, t, _lb) in self._entries.items()
                          if t == tenant), None)
                     if victim is None:
                         break
                     self._pop_entry(victim)
-            self._entries[key] = (value, int(nbytes), tenant)
+            self._entries[key] = (value, int(nbytes), tenant, label)
             self._bytes += int(nbytes)
             if tenant is not None:
                 self._tenant_bytes[tenant] = \
@@ -299,13 +308,37 @@ class DataPlane:
         with self._lock:
             released = 0
             for k in list(self._entries):
-                value, nbytes, t = self._entries[k]
+                value, nbytes, t, label = self._entries[k]
                 if t == tenant:
-                    self._entries[k] = (value, nbytes, None)
+                    self._entries[k] = (value, nbytes, None, label)
                     self._entries.move_to_end(k, last=False)
                     released += nbytes
             self._tenant_bytes.pop(tenant, None)
             self._tenant_quotas.pop(tenant, None)
+            return released
+
+    def demote(self, label_prefix: str, tenant) -> int:
+        """Un-charge a tenant's entries whose label starts with
+        ``label_prefix``: they become unowned, stop counting against
+        the tenant's quota, and rotate to the LRU front — still
+        servable as hits while they survive, but first in line for
+        eviction.  The successive-halving rung barrier
+        (search/halving.py) calls this with the previous rung's
+        namespace (``"mask.r0."``) so a tenant's data-plane charge
+        shrinks as rungs retire candidates: that rung's subsampled
+        fold masks and wide tiled masks are exactly the buffers the
+        surviving (narrower) rungs no longer need — and the scoped
+        prefix can never touch a sibling search's live masks under
+        the same tenant.  Returns the byte count demoted."""
+        with self._lock:
+            released = 0
+            for k in list(self._entries):
+                value, nbytes, t, label = self._entries[k]
+                if t == tenant and label.startswith(label_prefix):
+                    self._entries[k] = (value, nbytes, None, label)
+                    self._entries.move_to_end(k, last=False)
+                    self._uncharge(tenant, nbytes)
+                    released += nbytes
             return released
 
     def put(self, arr: np.ndarray, sharding, label: str = "array",
@@ -328,7 +361,8 @@ class DataPlane:
             self.misses += 1
             self.bytes_uploaded += int(arr.nbytes)
             dev = upload(arr, sharding, label=label)
-            self._insert(key, dev, arr.nbytes, tenant=tenant)
+            self._insert(key, dev, arr.nbytes, tenant=tenant,
+                         label=label)
             return dev
 
     def zeros(self, n: int, dtype, sharding, tenant: Any = None):
@@ -372,7 +406,7 @@ class DataPlane:
                                    reps=int(reps), label=label):
                 dev = tile_fn(base_dev)
             self.bytes_tiled += nbytes
-            self._insert(key, dev, nbytes, tenant=tenant)
+            self._insert(key, dev, nbytes, tenant=tenant, label=label)
             return dev
 
     # -- introspection ---------------------------------------------------

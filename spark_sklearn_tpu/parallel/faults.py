@@ -341,7 +341,7 @@ class LaunchSupervisor:
     """
 
     def __init__(self, config=None, faults: Optional[Dict[str, Any]] = None,
-                 ckpt=None, verbose: int = 0):
+                 ckpt=None, verbose: int = 0, reset_faults: bool = True):
         self.max_launch_retries = int(
             getattr(config, "max_launch_retries", 2) or 0)
         self.max_search_retries = int(
@@ -372,10 +372,18 @@ class LaunchSupervisor:
         # one recovery clobber the other's flag
         self._sticky_oom = 0
         self.faults: Dict[str, Any] = faults if faults is not None else {}
-        self.faults.update({
+        defaults = {
             "retries": 0, "bisections": 0, "host_fallbacks": 0,
             "timeouts": 0, "injected": 0, "by_class": {}, "events": [],
-        })
+        }
+        if reset_faults:
+            self.faults.update(defaults)
+        else:
+            # a halving search wraps each rung in its own supervisor
+            # over ONE shared faults struct: later rungs keep the
+            # earlier rungs' recovery record instead of zeroing it
+            for k, v in defaults.items():
+                self.faults.setdefault(k, v)
 
     # -- accounting ------------------------------------------------------
     def _count(self, name: str, n: int = 1) -> None:

@@ -219,6 +219,21 @@ class TpuConfig:
     # another tenant's (parallel/dataplane.py).  0 = no per-tenant
     # quota (the global dataplane_bytes budget still applies).
     dataplane_tenant_bytes: int = 0
+    # ---- adaptive search (search/halving.py) ----
+    # successive-halving lane reclamation: re-plan each rung's
+    # SURVIVING candidates into narrower chunks (plan_geometry over the
+    # survivor sizes, width-affine to already-compiled widths priced by
+    # the cost model's measured compile wall), so eliminated candidates
+    # retire their lanes instead of riding along as padding.  False
+    # pins every rung to the rung-0 chunk widths — the A/B control arm
+    # and the "survivors ride along" baseline; cv_results_ is identical
+    # either way (widths are pure geometry, never scores).
+    halving_replan: bool = True
+    # lower bound on a re-planned rung's chunk width (rounded up to the
+    # task-shard multiple, capped by the HBM bound): keeps late rungs
+    # from degrading into matmul-starved slivers on wide meshes.
+    # 0 = no floor beyond the shard multiple.
+    min_rung_width: int = 0
     # ---- fleet telemetry (obs/telemetry.py + obs/fleet.py) ----
     # localhost metrics endpoint: the session serves Prometheus text at
     # /metrics and the JSON snapshot at /snapshot.json on this port

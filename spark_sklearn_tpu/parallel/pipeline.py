@@ -305,6 +305,29 @@ class ChunkPipeline:
                     group=g)
             self._group_bounds.clear()
 
+    def drain(self) -> None:
+        """Rung barrier (search/halving.py): block until every queued
+        compile-ahead job has finished WITHOUT shutting the compile
+        executor down.  The halving scheduler drains between rungs so
+        a straggler AOT job can never trace under the next rung's jax
+        config (e.g. a wants_float64 family's temporarily-enabled x64
+        mode restored at the rung boundary), while the compile thread
+        stays warm for the next rung's programs.  `run()` may be
+        called again afterwards — the timeline and wall accumulate, so
+        one report covers every rung."""
+        for fut in self._compile_futures:
+            if fut.cancelled():
+                continue
+            try:
+                fut.result()
+            # AOT compile-ahead is an optimization only: a failed
+            # future's consumer already fell back to the jit path, and
+            # an unconsumed failure means nothing needed the executable
+            # sstlint: disable=launch-except-taxonomy,swallowed-exception
+            except Exception:
+                pass
+        self._compile_futures = []
+
     def close(self) -> None:
         """Join the compile thread (AOT jobs trace under the caller's
         jax config — e.g. a temporarily-enabled x64 mode — so they must

@@ -427,7 +427,10 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                   cost_model: Optional[GeometryCostModel] = None,
                   overhead_override: Optional[float] = None,
                   lane_cost_override: Optional[float] = None,
-                  reuse: bool = False) -> GeometryPlan:
+                  reuse: bool = False,
+                  min_width: int = 0,
+                  preferred: Optional[Sequence[Optional[int]]] = None,
+                  ) -> GeometryPlan:
     """Choose every compile group's chunk width.
 
     ``sizes``: per-group candidate counts; ``sorted_caps``: per-group
@@ -442,15 +445,31 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     hatch).  Deterministic: same inputs (including the model values)
     -> same plan; ``reuse=True`` additionally serves the first plan
     computed for this structure again for the process lifetime.
+
+    ``min_width`` floors every auto-chosen unsorted width (rounded up
+    to the shard multiple, capped by ``max_width``) — the halving
+    scheduler's ``TpuConfig.min_rung_width`` guard against
+    pathologically narrow late-rung launches.  ``preferred`` gives a
+    per-group already-compiled width: a valid preferred width whose
+    plan cost is within the model's measured ``compile_wall_s`` of the
+    optimum wins, so a mid-search re-plan (halving rung k+1) reuses
+    the program compiled at rung k's width instead of paying a fresh
+    trace+compile for a marginal padding saving.  Preferences are
+    process-history-dependent, so a ``preferred`` plan is never cached
+    (callers pass ``reuse=False``).
     """
     if mode not in ("auto", "fixed"):
         raise ValueError(
             f"geometry_mode must be 'auto' or 'fixed', got {mode!r}")
     sizes = [int(n) for n in sizes]
     sorted_caps = [None if c is None else int(c) for c in sorted_caps]
+    if preferred is not None and reuse:
+        raise ValueError(
+            "preferred widths depend on process compile history and "
+            "must not enter the plan cache; pass reuse=False")
     cache_key = (tuple(sizes), tuple(sorted_caps), int(n_folds),
                  int(n_task_shards), int(max_width), mode,
-                 overhead_override, lane_cost_override)
+                 overhead_override, lane_cost_override, int(min_width))
     if reuse:
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)
@@ -471,6 +490,21 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     if overhead_override is not None or lane_cost_override is not None:
         snap = {**snap, "launch_overhead_s": overhead,
                 "lane_cost_s": lane_cost, "source": "override"}
+
+    # width floor: shard-multiple, never beyond the HBM bound
+    floor_w = 0
+    if min_width:
+        floor_w = min(max_width, _pad_up(int(min_width), n_task_shards))
+    # the width-affinity allowance: a preferred (already-compiled)
+    # width may cost up to this much more than the optimum before a
+    # fresh compile is judged worth it.  Manual overhead/lane overrides
+    # pin the geometry deterministically (tests, operators who know
+    # their costs), so they zero the allowance too — otherwise a
+    # measured compile wall would silently re-widen "deterministic"
+    # plans.
+    compile_cost = 0.0 if (overhead_override is not None
+                           or lane_cost_override is not None) \
+        else float(snap.get("compile_wall_s", 0.0) or 0.0)
 
     groups = []
     for gi, nc in enumerate(sizes):
@@ -495,12 +529,30 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                 if w >= hold_all:
                     break
                 w *= 2
+            if floor_w:
+                candidates = {w_ for w_ in candidates if w_ >= floor_w}
+                candidates.add(floor_w)
             # total order (cost, n_chunks, width): ties prefer fewer
             # launches, then the narrower (cheaper-HBM) width
             width = min(
                 sorted(candidates),
                 key=lambda w_: _chunk_cost(nc, w_, n_folds, overhead,
                                            lane_cost))
+            pref = preferred[gi] if preferred is not None else None
+            if pref is not None:
+                pref = int(pref)
+                if pref >= max(n_task_shards, floor_w) \
+                        and pref <= max_width \
+                        and pref % n_task_shards == 0 and pref != width:
+                    # width affinity: an already-compiled width wins
+                    # when its extra plan cost is under the measured
+                    # compile wall a new width would pay
+                    c_pref = _chunk_cost(nc, pref, n_folds, overhead,
+                                         lane_cost)[0]
+                    c_opt = _chunk_cost(nc, width, n_folds, overhead,
+                                        lane_cost)[0]
+                    if c_pref <= c_opt + compile_cost:
+                        width = pref
         groups.append(GroupGeometry(
             group=gi, n_candidates=nc, width=int(width),
             n_chunks=-(-nc // int(width)), sorted=cap is not None))
@@ -535,7 +587,10 @@ def _plan_key_from_json(j: Sequence[Any]) -> Tuple:
             tuple(None if c is None else int(c) for c in j[1]),
             int(j[2]), int(j[3]), int(j[4]), str(j[5]),
             None if j[6] is None else float(j[6]),
-            None if j[7] is None else float(j[7]))
+            None if j[7] is None else float(j[7]),
+            # min_width rode in after plans.json shipped: records
+            # persisted by older processes carry 8 elements (= floor 0)
+            int(j[8]) if len(j) > 8 else 0)
 
 
 def export_plan_state() -> Dict[str, Any]:

@@ -599,11 +599,50 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # accumulate and each call returns the results-so-far
         acc: Dict[str, Any] = {
             "params": [], "test": None, "train": None,
-            "fit_t": [], "score_t": [], "names": None, "results": None}
+            "fit_t": [], "score_t": [], "names": None, "results": None,
+            "more": {}}
 
         state = {"use_compiled": use_compiled}
 
-        def _dispatch(cands, eval_ctxs):
+        def _compact_for_rung(splits_used):
+            """Row-compact the dataset to the union of a halving rung's
+            subsampled fold indices (compiled tier only).
+
+            The fold-mask machinery makes a subsampled rung CORRECT by
+            zero-weighting the unused rows, but zero-weight rows still
+            multiply — rung 0 of a 1797-row search at n_resources=40
+            would pay full-dataset matmuls for every lane.  Slicing
+            X/y (and the weights) to the rows any fold actually uses,
+            with the split indices remapped, makes the rung's compute
+            proportional to its resource; every used row keeps its
+            exact value, so the per-cell scores are the same
+            computation on the same rows.  Returns None when
+            compaction cannot apply (exotic X containers, nothing to
+            drop, or a subsample that lost an entire class — the
+            compiled class structure must match the full dataset's)."""
+            import scipy.sparse as _sp
+            if not (isinstance(X_arr, np.ndarray) or _sp.issparse(X_arr)):
+                return None
+            used = np.unique(np.concatenate(
+                [np.concatenate([np.asarray(tr), np.asarray(te)])
+                 for tr, te in splits_used]))
+            if used.size == 0 or used.size >= X_arr.shape[0]:
+                return None
+            y_arr = None if y is None else np.asarray(y)
+            y_sub = None if y_arr is None else y_arr[used]
+            if y_arr is not None and is_classifier(self.estimator) \
+                    and np.unique(y_sub).size != np.unique(y_arr).size:
+                return None
+            splits_c = [(np.searchsorted(used, np.asarray(tr)),
+                         np.searchsorted(used, np.asarray(te)))
+                        for tr, te in splits_used]
+            fw = None if fit_weight is None \
+                else np.asarray(fit_weight)[used]
+            sw = None if score_weight is None \
+                else np.asarray(score_weight)[used]
+            return X_arr[used], y_sub, splits_c, fw, sw
+
+        def _dispatch(cands, eval_ctxs, splits_used, rung_compact=False):
             if self.n_splits_ == 0:
                 raise ValueError(
                     "No fits were performed. "
@@ -611,9 +650,15 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     "Were there no candidates?")
             if state["use_compiled"]:
                 try:
+                    X_c, y_c, splits_c = X_arr, y, splits_used
+                    fw_c, sw_c = fit_weight, score_weight
+                    if rung_compact:
+                        sub = _compact_for_rung(splits_used)
+                        if sub is not None:
+                            X_c, y_c, splits_c, fw_c, sw_c = sub
                     return self._fit_compiled(
-                        family, X_arr, y, cands, splits,
-                        fit_weight=fit_weight, score_weight=score_weight,
+                        family, X_c, y_c, cands, splits_c,
+                        fit_weight=fw_c, score_weight=sw_c,
                         eval_ctxs=eval_ctxs)
                 except (KeyboardInterrupt, SystemExit):
                     # an interactive abort / interpreter shutdown must
@@ -644,13 +689,29 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # the host path receives the CALLER's X (list, sparse, frame —
             # sklearn estimators may validate its exact type); only the
             # compiled path needs the dense array form
-            return self._fit_host(X, y, cands, splits, est_fit_params,
+            return self._fit_host(X, y, cands, splits_used, est_fit_params,
                                   score_params, eval_ctxs,
                                   fallback_exc=state.pop(
                                       "fallback_exc", None))
 
-        def evaluate_candidates(candidate_params, callback_ctx=None):
+        def evaluate_candidates(candidate_params, cv=None,
+                                more_results=None, callback_ctx=None):
+            # sklearn's full evaluate_candidates contract
+            # (_search.py:829): a subclass `_run_search` (successive
+            # halving) may pass a per-call cv — the rung's subsample
+            # splitter — and extra result columns (`iter`,
+            # `n_resources`) that accumulate into cv_results_.  The
+            # parameter deliberately shadows the outer validated cv.
             cands = list(candidate_params)
+            if cv is None:
+                splits_used = splits
+            else:
+                splits_used = list(cv.split(
+                    X_arr, y, **routed_params.splitter.split))
+                if len(splits_used) != self.n_splits_:
+                    raise ValueError(
+                        f"the per-call cv yielded {len(splits_used)} "
+                        f"splits, expected {self.n_splits_}")
             if self.verbose > 0:
                 # structured logger, stdout-parity channel: the line is
                 # byte-for-byte sklearn's (BaseSearchCV.fit)
@@ -680,7 +741,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             else:
                 eval_ctxs = None
             (test_scores, train_scores, fit_times, score_times,
-             scorer_names, scorer_attr) = _dispatch(cands, eval_ctxs)
+             scorer_names, scorer_attr) = _dispatch(
+                cands, eval_ctxs, splits_used,
+                # a per-call cv is a halving rung's subsample: compact
+                # the compiled tier's rows to what the rung uses (the
+                # host tier always receives the caller's full X)
+                rung_compact=cv is not None)
             if acc["names"] is None:
                 acc["names"] = scorer_names
                 acc["test"] = {s: [] for s in scorer_names}
@@ -698,13 +764,17 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     acc["train"][s].append(train_scores[s])
             acc["fit_t"].append(fit_times)
             acc["score_t"].append(score_times)
+            if more_results:
+                for k, v in more_results.items():
+                    acc["more"].setdefault(k, []).extend(v)
             acc["results"] = self._format_results(
                 acc["params"],
                 {s: np.concatenate(v) for s, v in acc["test"].items()},
                 ({s: np.concatenate(v) for s, v in acc["train"].items()}
                  if self.return_train_score else None),
                 np.concatenate(acc["fit_t"]),
-                np.concatenate(acc["score_t"]), acc["names"])
+                np.concatenate(acc["score_t"]), acc["names"],
+                more_results=acc["more"])
             return acc["results"]
 
         from inspect import signature as _signature
@@ -988,6 +1058,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         from spark_sklearn_tpu.parallel import programstore as _programstore
         pstore = _programstore.activate_store(config)
         ps_before = _programstore.snapshot_counters(pstore)
+        # successive-halving rung context (search/halving.py, duck-
+        # typed): when set, this evaluate_candidates call is ONE RUNG
+        # of a multi-rung search — the report registry, pipeline and
+        # counter baselines are shared across rungs so the final
+        # search_report covers the whole search, not the last rung
+        rung = getattr(self, "_rung_ctx", None)
+        if rung is not None:
+            if rung.ps_before is None:
+                rung.ps_before = ps_before
+            ps_before = rung.ps_before
         dtype = dtype_override or config.dtype or np.float32
         scorers, _ = resolve_scoring(self.scoring, family)
         scorer_names = list(scorers)
@@ -1171,6 +1251,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         from spark_sklearn_tpu.parallel import dataplane as _dataplane
         plane = _dataplane.plane_for(config)
         dp_before = _dataplane.snapshot_counters(plane)
+        if rung is not None:
+            if rung.dp_before is None:
+                rung.dp_before = dp_before
+            dp_before = rung.dp_before
         # a search submitted through a session's SearchExecutor charges
         # its broadcast residents to its tenant's data-plane quota
         from spark_sklearn_tpu import serve as _serve
@@ -1222,16 +1306,24 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # one device buffer per DISTINCT mask array: in the unweighted case
         # fit/train-scoring masks are the same object, so they share one
         # upload and one HBM allocation (the plane's content keys make
-        # the dedup hold even across separately-built equal arrays)
-        fit_dev = _bput(fit_masks, put_masks, "mask.fit")
-        test_dev = _bput(test_sc_masks, put_masks, "mask.test")
+        # the dedup hold even across separately-built equal arrays).
+        # A halving rung's subsampled masks carry a RUNG-SCOPED label
+        # ("mask.r1.fit"): the next rung's barrier then demotes exactly
+        # the previous rung's buffers — plane keys are shared by
+        # content, so a bare "mask." sweep could un-charge a sibling
+        # search's live masks under the same tenant
+        mask_ns = (f"mask.{rung.ns}." if rung is not None
+                   and rung.resource == "n_samples" else "mask.")
+        fit_dev = _bput(fit_masks, put_masks, mask_ns + "fit")
+        test_dev = _bput(test_sc_masks, put_masks, mask_ns + "test")
         train_sc_dev = (fit_dev if train_sc_masks is fit_masks
                         else _bput(train_sc_masks, put_masks,
-                                   "mask.train"))
+                                   mask_ns + "train"))
         if need_unweighted:
-            test_unw_dev = _bput(test_masks, put_masks, "mask.test_unw")
+            test_unw_dev = _bput(test_masks, put_masks,
+                                 mask_ns + "test_unw")
             train_unw_dev = _bput(train_masks, put_masks,
-                                  "mask.train_unw")
+                                  mask_ns + "train_unw")
         else:
             test_unw_dev, train_unw_dev = test_dev, train_sc_dev
         get_tracer().record_span(
@@ -1276,7 +1368,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 if fit_weight is not None else "none",
                 "scw",
                 np.asarray(score_weight, np.float64)
-                if score_weight is not None else "none")
+                if score_weight is not None else "none",
+                # halving rungs are distinct resumable units: the rung
+                # index (and its resource) joins the fingerprint even
+                # though the candidate set / masks already differ, so
+                # two rungs can never alias one journal file
+                *(("halving", rung.itr, rung.n_resources)
+                  if rung is not None else ()))
             ckpt = SearchCheckpoint(config.checkpoint_dir, key)
 
         profiler_cm = None
@@ -1289,9 +1387,23 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # search_report = the rendered view of a typed registry whose
         # schema lives in obs.metrics.SEARCH_REPORT_SCHEMA (keys
         # materialize here in the legacy order, so the report is
-        # key-for-key identical to the pre-registry dict)
-        metrics = search_registry("tpu")
-        metrics.gauge("n_compile_groups").set(len(groups))
+        # key-for-key identical to the pre-registry dict).  A halving
+        # search's rungs share ONE registry: counters (n_launches,
+        # walls, n_chunks_resumed) accumulate across rungs and the
+        # struct blocks render the whole search's deltas.
+        if rung is not None and rung.registry is not None:
+            metrics = rung.registry
+        else:
+            metrics = search_registry("tpu")
+            if rung is not None:
+                rung.registry = metrics
+        ncg = metrics.gauge("n_compile_groups")
+        if rung is not None:
+            # like the counters: the whole search's group total, not
+            # the last rung's
+            ncg.set(int(ncg.value) + len(groups))
+        else:
+            ncg.set(len(groups))
         metrics.counter("n_launches")
         metrics.counter("n_chunks_resumed")
         metrics.gauge("fit_wall_s")
@@ -1517,6 +1629,19 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             ChunkPipeline, LaunchItem, persistent_cache_counts)
         from spark_sklearn_tpu.parallel.taskgrid import pad_chunk
 
+        #: successive-halving rung context (search/halving.py): this
+        #: call is one rung of a multi-rung search.  Chunk ids carry
+        #: the rung namespace, geometry re-plans (or pins) the
+        #: survivors' widths, and the pipeline/registry/baselines are
+        #: shared across rungs.
+        rung = getattr(self, "_rung_ctx", None)
+        cid_ns = f"{rung.ns}:" if rung is not None else ""
+        # tiled-mask labels share the broadcast masks' rung namespace
+        # (see _fit_compiled_impl): the rung barrier's demote targets
+        # only the previous rung's buffers
+        mask_ns = (f"mask.{rung.ns}." if rung is not None
+                   and rung.resource == "n_samples" else "mask.")
+        tiled_label = mask_ns + "fit.tiled"
         task_batched = hasattr(family, "fit_task_batched")
         if config.n_data_shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1662,9 +1787,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # never a silent mix of chunk ids.
         # ------------------------------------------------------------------
         from spark_sklearn_tpu.parallel.taskgrid import (
-            GeometryMismatchError, GeometryPlan, geometry_cost_model,
-            plan_geometry)
-        geo = plan_geometry(
+            GeometryMismatchError, GeometryPlan, freeze,
+            geometry_cost_model, plan_geometry)
+        import dataclasses as _dc
+        geo_kwargs = dict(
             sizes=[p["nc"] for p in plans],
             sorted_caps=[p["sorted_cap"] for p in plans],
             n_folds=n_folds, n_task_shards=n_task_shards,
@@ -1673,8 +1799,56 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             cost_model=geometry_cost_model(),
             overhead_override=getattr(config, "geometry_overhead_s", None),
             lane_cost_override=getattr(config, "geometry_lane_cost_s",
-                                       None),
-            reuse=True)
+                                       None))
+        #: per-group structure identity ACROSS rungs: the static params
+        #: minus the budgeted resource (survivor groups at rung k+1
+        #: carry the same key as the rung-0 group they came from, even
+        #: when the resource itself is static for the family)
+        rung_keys = None
+        if rung is not None:
+            rung_keys = [
+                freeze({k: v for k, v in p["static"].items()
+                        if k != rung.resource})
+                for p in plans]
+        if rung is None or rung.itr == 0:
+            # the first rung (and every exhaustive search) prices the
+            # full grid exactly as before, plan-cache included
+            geo = plan_geometry(reuse=True, **geo_kwargs)
+        else:
+            # mid-search re-plan: the survivors' geometry is a
+            # search-local decision fed by the PREVIOUS rungs' measured
+            # timelines (the cost model observed each rung's pipeline
+            # on the way out), so it bypasses the cross-search plan
+            # cache.  With lane reclamation on, widths shrink to the
+            # surviving sizes — width-affine to already-compiled
+            # widths, priced by the model's measured compile wall;
+            # off, survivors stay pinned to rung-0 widths and ride
+            # along as padding (the A/B baseline).  Widths are pure
+            # geometry: cv_results_ is identical either way.
+            with get_tracer().span("geometry.replan", iter=rung.itr,
+                                   replan=bool(rung.replan)):
+                if rung.replan:
+                    geo = plan_geometry(
+                        reuse=False, min_width=rung.min_rung_width,
+                        preferred=[rung.last_widths.get(k)
+                                   for k in rung_keys],
+                        **geo_kwargs)
+                    geo = _dc.replace(geo, source="halving-replan")
+                else:
+                    geo = plan_geometry(reuse=False, **geo_kwargs)
+                    pinned = []
+                    for gg, k in zip(geo.groups, rung_keys):
+                        base_w = rung.base_widths.get(k)
+                        if base_w is not None \
+                                and base_w % n_task_shards == 0 \
+                                and base_w <= max_cand_per_batch:
+                            gg = _dc.replace(
+                                gg, width=int(base_w),
+                                n_chunks=-(-gg.n_candidates
+                                           // int(base_w)))
+                        pinned.append(gg)
+                    geo = _dc.replace(geo, groups=pinned,
+                                      source="halving-pinned")
         if ckpt is not None:
             journalled = ckpt.get_meta("geometry_plan")
             if journalled is not None:
@@ -1715,6 +1889,32 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             else:
                 ckpt.put_meta("geometry_plan", geo.to_dict())
         metrics.put("geometry", geo.report_block())
+        if rung is not None:
+            # rung bookkeeping: remember rung-0 widths (the pin/affinity
+            # anchors) and account the lanes this rung's re-plan
+            # reclaimed vs. running the SAME survivors at rung-0 widths
+            for gg, k in zip(geo.groups, rung_keys):
+                rung.base_widths.setdefault(k, int(gg.width))
+                rung.last_widths[k] = int(gg.width)
+            rung_rec = rung.current
+            if rung_rec is not None:
+                rung_rec["widths"] = [int(g.width) for g in geo.groups]
+                rung_rec["n_launches_planned"] = int(
+                    sum(g.n_chunks for g in geo.groups))
+                rung_rec["cost_observations"] = int(
+                    geo.cost_model.get("n_observations", 0))
+                if rung.itr > 0:
+                    base_lanes = act_lanes = 0
+                    for gg, k in zip(geo.groups, rung_keys):
+                        bw = rung.base_widths.get(k, gg.width)
+                        base_lanes += (-(-gg.n_candidates // bw)) \
+                            * bw * n_folds
+                        act_lanes += gg.n_chunks * gg.width * n_folds
+                    reclaimed = max(0, base_lanes - act_lanes)
+                    rung_rec["lanes_reclaimed"] = int(reclaimed)
+                    rung_rec["padding_saved_frac"] = round(
+                        reclaimed / base_lanes, 6) if base_lanes else 0.0
+                    rung.lanes_reclaimed_total += int(reclaimed)
 
         for plan, gg in zip(plans, geo.groups):
             gi, nc = plan["gi"], plan["nc"]
@@ -1728,9 +1928,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 hi = min(lo + nc_batch, nc)
                 # sorted chunks write cells through a PERMUTED index set:
                 # a checkpoint from an unsorted run must not resume into
-                # them (and vice versa), so the id carries the mode
-                chunk_id = f"{gi}:{lo}:{hi}" + (":s" if sorted_chunks
-                                                else "")
+                # them (and vice versa), so the id carries the mode.
+                # Halving rungs prefix their namespace ("r2:...") so the
+                # journal, fault events and trace stay rung-addressable
+                # and supervisor bisection keys can never collide
+                # across rungs
+                chunk_id = cid_ns + f"{gi}:{lo}:{hi}" + \
+                    (":s" if sorted_chunks else "")
                 rec = ckpt.get(chunk_id) if ckpt is not None else None
                 if rec is not None and return_train and \
                         rec.get("train") is None:
@@ -1934,14 +2138,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 if w is None:
                     w = plan["w_task_dev"] = plane.tiled(
                         fit_masks, fit_dev, plan["nc_batch"],
-                        tb_mask_shard, label="mask.fit.tiled",
+                        tb_mask_shard, label=tiled_label,
                         fp=fit_masks_fp(), tenant=sched_tenant)
                 return w
             w = plan.get("w_task_dev")
             if w is None:
                 w = _dataplane.upload(
                     np.tile(fit_masks, (plan["nc_batch"], 1)),
-                    tb_mask_shard, label="mask.fit.tiled")
+                    tb_mask_shard, label=tiled_label)
                 plan["w_task_dev"] = w
             return w
 
@@ -1952,13 +2156,30 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
         cache0 = persistent_cache_counts()
         builds0 = _program_build_count()
+        if rung is not None:
+            # whole-search baselines: the final pipeline block's
+            # n_compiles / persistent-cache deltas cover every rung
+            if rung.cache0 is None:
+                rung.cache0, rung.builds0 = cache0, builds0
+            cache0, builds0 = rung.cache0, rung.builds0
         # multi-controller runs gather through process_allgather — a
         # cross-process COLLECTIVE.  Issuing collectives from background
         # threads would need every process to interleave them in the
         # same order as its peers; the synchronous schedule guarantees
         # that, the pipelined one does not — so multihost forces depth 0
         # (`depth` was resolved with the data-plane setup above)
-        pipe = ChunkPipeline(depth, verbose=self.verbose)
+        if rung is not None and rung.pipeline is not None:
+            # rung barrier = drain + re-stage: the rungs of one halving
+            # search share ONE pipeline (run() accumulates the timeline
+            # and wall), so its compile thread stays warm and the final
+            # report covers the whole search.  The previous rung's
+            # close was a drain() — no straggler AOT job outlives its
+            # rung's jax config.
+            pipe = rung.pipeline
+        else:
+            pipe = ChunkPipeline(depth, verbose=self.verbose)
+            if rung is not None:
+                rung.pipeline = pipe
 
         def submit_precompile(plan):
             """AOT-lower/compile the group's fused program on the compile
@@ -2095,13 +2316,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     # relaunch (the old per-relaunch host np.tile)
                     w = (plane.tiled(fit_masks, fit_dev, width,
                                      tb_mask_shard,
-                                     label="mask.fit.tiled",
+                                     label=tiled_label,
                                      fp=fit_masks_fp(),
                                      tenant=sched_tenant)
                          if plane is not None else
                          _dataplane.upload(
                              np.tile(fit_masks, (width, 1)),
-                             tb_mask_shard, label="mask.fit.tiled"))
+                             tb_mask_shard, label=tiled_label))
                 else:
                     w = fit_dev
                 out = progs["fused"](dyn, data_dev, w, test_dev,
@@ -2193,7 +2414,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
         def per_group_rec(plan):
             pg = metrics.struct("per_group")
-            return pg.setdefault(plan["gi"], {
+            # rung-namespaced key: a halving search's shared registry
+            # must not merge rung 2's group 0 into rung 0's group 0
+            key = cid_ns + str(plan["gi"]) if rung is not None \
+                else plan["gi"]
+            return pg.setdefault(key, {
                 "static_params": repr(plan["group"].static_params),
                 "n_launches": 0, "fit_wall_s": 0.0, "score_wall_s": 0.0,
                 "score_path": ("wide-fused" if fused_mode else
@@ -2510,16 +2735,25 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         from spark_sklearn_tpu.parallel.faults import LaunchSupervisor
         supervisor = LaunchSupervisor(
             config, faults=metrics.struct("faults"), ckpt=ckpt,
-            verbose=self.verbose)
+            verbose=self.verbose,
+            # later rungs accumulate into the shared faults struct
+            # instead of zeroing the earlier rungs' recovery record
+            reset_faults=(rung is None or rung.itr == 0))
         items = chunk_items()
         if binding is not None:
             # executor wrapping sits UNDER the supervisor: a routed
             # launch that fails re-enters the supervisor on THIS
             # search's threads (retries re-queue fairly; one tenant's
             # OOM bisection never blocks the shared dispatch loop)
-            binding.executor.note_planned(
-                binding.handle, sum(p["n_live"] for p in plans))
+            n_live_total = sum(p["n_live"] for p in plans)
+            if rung is not None:
+                # progress() spans the whole halving search: planned
+                # chunks accumulate rung by rung as geometry resolves
+                rung.planned_total += n_live_total
+                n_live_total = rung.planned_total
+            binding.executor.note_planned(binding.handle, n_live_total)
             items = binding.executor.wrap_items(binding.handle, items)
+        resumed0 = int(metrics.data.get("n_chunks_resumed", 0))
         try:
             pipe.run(supervisor.wrap(items))
         finally:
@@ -2528,8 +2762,15 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # a standalone fit, so the report schema never changes
             metrics.put("scheduler", _serve.report_block(binding))
             # the compile thread traces under this search's jax config
-            # (e.g. temporarily-enabled x64): join it before returning
-            pipe.close()
+            # (e.g. temporarily-enabled x64): join it before returning.
+            # A halving rung only DRAINS it — no queued AOT job crosses
+            # the rung boundary's config restore, but the thread stays
+            # warm for the next rung (halving closes the shared
+            # pipeline when the last rung ends).
+            if rung is None:
+                pipe.close()
+            else:
+                pipe.drain()
             pr = pipe.report()
             cache1 = persistent_cache_counts()
             pr["persistent_cache_hits"] = cache1["hits"] - cache0["hits"]
@@ -2544,8 +2785,27 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # into the geometry planner's cost model: the NEXT search
             # over a new structure prices its widths from real walls
             # (plans already computed this process keep their widths via
-            # the plan cache, so drift never forces recompiles)
-            geometry_cost_model().observe(pr.get("launches"))
+            # the plan cache, so drift never forces recompiles).  For a
+            # halving search this runs at EVERY rung boundary over that
+            # rung's timeline slice — rung k+1's re-plan prices its
+            # widths from rung k's measured overhead and lane cost, not
+            # from cross-search priors.
+            launches = pr.get("launches") or []
+            if rung is not None:
+                new_launches = launches[rung.launches_seen:]
+                rung.launches_seen = len(launches)
+                geometry_cost_model().observe(new_launches)
+                rung_rec = rung.current
+                if rung_rec is not None:
+                    rung_rec["n_chunks_resumed"] = int(
+                        metrics.data.get("n_chunks_resumed", 0)) \
+                        - resumed0
+                    wall = float(pr.get("wall_s", 0.0))
+                    rung_rec["pipe_wall_s"] = round(
+                        max(0.0, wall - rung.prev_pipe_wall), 4)
+                    rung.prev_pipe_wall = wall
+            else:
+                geometry_cost_model().observe(launches)
             # persist the plan cache + cost-model state next to the AOT
             # artifacts: a fresh process then plans the SAME chunk
             # widths — and resolves the same stored programs — without
@@ -2733,11 +2993,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
     # (_search.py:1208-1290)
     # ------------------------------------------------------------------
     def _format_results(self, candidates, test_scores, train_scores,
-                        fit_times, score_times, scorer_names):
+                        fit_times, score_times, scorer_names,
+                        more_results=None):
         from scipy.stats import rankdata
 
         n_candidates = len(candidates)
-        results: Dict[str, Any] = {}
+        # extra columns from a halving-style _run_search come first,
+        # as arrays — sklearn's exact layout (_format_results:
+        # `results = dict(more_results or {})`, then np.asarray each)
+        results: Dict[str, Any] = {
+            k: np.asarray(v) for k, v in (more_results or {}).items()}
 
         def _store(key_name, array, weights=None, splits=False, rank=False):
             array = np.asarray(array, dtype=np.float64).reshape(

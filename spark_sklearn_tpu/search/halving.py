@@ -1,0 +1,505 @@
+"""Successive halving — adaptive search as a compiled scheduler.
+
+sklearn's ``HalvingGridSearchCV`` / ``HalvingRandomSearchCV``
+(model_selection/_search_successive_halving.py, the experimental
+``enable_halving_search_cv`` surface) spend a shrinking candidate set
+against a growing resource: rung k fits every survivor at resource
+``r_k = factor**k * min_resources``, keeps the top
+``ceil(n / factor)`` by mean test score, and repeats.  Exhaustive
+grids pay most of their warm wall fitting candidates that lose; the
+bandit argument (Karnin, Koren & Somekh, ICML'13 — and the same
+online, budget-aware case "Towards General and Efficient Online
+Tuning for Spark" makes for shared clusters) is that early stopping
+should be a first-class scheduler property, not a post-hoc filter.
+
+Here each rung is ONE ``evaluate_candidates`` call into the engine's
+rung seam (``search/grid.py``), which makes a rung a *planned set of
+compile groups*:
+
+  - **resource = 'n_samples'**: the rung's folds come from sklearn's
+    own ``_SubsampleMetaSplitter`` (identical subsampling RNG), and
+    the subsampled indices become fold masks through the existing
+    fold-mask machinery — the compiled programs never change shape;
+  - **resource = an estimator parameter** (e.g. ``n_estimators``):
+    the resource value lands in each candidate dict, riding the
+    masked-prefix trick the forest/boosting families already use for
+    dynamic tree counts;
+  - **elimination** runs host-side on gathered scores with sklearn's
+    own ``_top_k`` (NaN handling and tie order included), so the
+    surviving set is byte-for-byte sklearn's;
+  - **lane reclamation**: at every rung boundary the geometry planner
+    re-plans the survivors into narrower chunks
+    (``taskgrid.plan_geometry`` over the surviving sizes, fed by the
+    PREVIOUS rung's measured timeline through the cost model), so
+    eliminated candidates retire their device lanes instead of riding
+    along as padding.  ``TpuConfig(halving_replan=False)`` pins every
+    rung to the rung-0 widths — the A/B baseline; ``cv_results_`` is
+    identical either way because widths are pure geometry;
+  - the rung barrier drains (not closes) the shared chunk pipeline,
+    chunk ids carry a rung namespace (``r1:0:0:24``), and each rung
+    journals into its own checkpoint file — a search killed mid-rung
+    resumes bit-exact, including between a rung's score gather and
+    its elimination decision (fully-journalled rungs replay with zero
+    launches and re-decide identically).
+
+Observability: ``search_report["halving"]`` (schema pinned in
+``obs.metrics.HALVING_BLOCK_SCHEMA``) records per-rung candidate
+counts, resources, widths, walls and lanes reclaimed; a submitted
+halving search also tells the session executor about each rung
+(``SearchExecutor.note_rung``) so its effective in-flight cap and
+data-plane tenant charge shrink as candidates retire.
+"""
+
+from __future__ import annotations
+
+import time
+from math import ceil, floor, log
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sklearn.base import is_classifier
+from sklearn.model_selection import ParameterGrid, ParameterSampler, check_cv
+from sklearn.model_selection._search_successive_halving import (
+    _SubsampleMetaSplitter,
+    _top_k,
+)
+from sklearn.model_selection._split import _yields_constant_splits
+from sklearn.utils.multiclass import check_classification_targets
+from sklearn.utils.validation import _num_samples
+
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.parallel.mesh import TpuConfig
+from spark_sklearn_tpu.search.grid import BaseSearchTPU
+
+__all__ = ["HalvingGridSearchCV", "HalvingRandomSearchCV"]
+
+logger = get_logger("spark_sklearn_tpu.search.halving")
+
+
+class _RungContext:
+    """Mutable per-search state threaded from the halving scheduler
+    into the engine (``grid._run_groups`` reads it via the duck-typed
+    ``search._rung_ctx`` attribute, so grid never imports halving).
+
+    Single-threaded by construction: every field is written on the
+    search's own fit thread (geometry planning and the rung-boundary
+    accounting both run there), never on the pipeline workers.
+    """
+
+    def __init__(self, resource: str, replan: bool, min_rung_width: int,
+                 n_candidates0: int):
+        self.resource = resource
+        self.replan = bool(replan)
+        self.min_rung_width = int(min_rung_width)
+        self.n_candidates0 = int(n_candidates0)
+        self.itr = 0
+        self.ns = "r0"                 # chunk-id namespace
+        self.n_resources = 0
+        #: one record per rung (the halving block's `rungs` series)
+        self.records: List[Dict[str, Any]] = []
+        self.current: Optional[Dict[str, Any]] = None
+        #: shared across rungs so the final report covers the search
+        self.registry = None           # compiled-tier MetricsRegistry
+        self.pipeline = None           # shared ChunkPipeline
+        self.cache0 = None             # persistent-cache baseline
+        self.builds0 = None            # program-build baseline
+        self.dp_before = None          # data-plane counter baseline
+        self.ps_before = None          # program-store counter baseline
+        #: cross-rung geometry anchors, keyed by the group's static
+        #: params minus the resource (taskgrid.freeze)
+        self.base_widths: Dict[Any, int] = {}
+        self.last_widths: Dict[Any, int] = {}
+        self.planned_total = 0         # cumulative live chunks
+        self.launches_seen = 0         # timeline prefix already observed
+        self.prev_pipe_wall = 0.0
+        self.lanes_reclaimed_total = 0
+
+    def begin_rung(self, itr: int, n_resources: int,
+                   n_candidates: int) -> Dict[str, Any]:
+        self.itr = int(itr)
+        self.ns = f"r{int(itr)}"
+        self.n_resources = int(n_resources)
+        rec = {
+            "iter": int(itr),
+            "n_candidates": int(n_candidates),
+            "n_resources": int(n_resources),
+            "wall_s": 0.0,
+            "pipe_wall_s": 0.0,
+            "widths": [],
+            "n_launches_planned": 0,
+            "n_chunks_resumed": 0,
+            "lanes_reclaimed": 0,
+            "padding_saved_frac": 0.0,
+            "cost_observations": 0,
+        }
+        self.records.append(rec)
+        self.current = rec
+        return rec
+
+
+def _render_halving_block(search, rc: _RungContext) -> Dict[str, Any]:
+    """The ``search_report["halving"]`` block (schema pinned in
+    ``obs.metrics.HALVING_BLOCK_SCHEMA``)."""
+    return {
+        "enabled": True,
+        "factor": float(search.factor),
+        "resource": str(search.resource),
+        "replan": bool(rc.replan),
+        "min_rung_width": int(rc.min_rung_width),
+        "n_rungs": len(rc.records),
+        "lanes_reclaimed_total": int(rc.lanes_reclaimed_total),
+        "rungs": list(rc.records),
+    }
+
+
+class BaseSuccessiveHalvingTPU(BaseSearchTPU):
+    """Shared successive-halving engine: candidate generation is the
+    subclass hook (``_generate_candidate_params``), the rung loop is
+    sklearn's ``BaseSuccessiveHalving._run_search`` driving the
+    engine's ``evaluate_candidates(cands, cv, more_results)`` seam."""
+
+    def __init__(self, estimator, *, scoring=None, n_jobs=None, refit=True,
+                 cv=5, verbose=0, random_state=None, error_score=np.nan,
+                 return_train_score=True, max_resources="auto",
+                 min_resources="exhaust", resource="n_samples", factor=3,
+                 aggressive_elimination=False, backend=None,
+                 config: Optional[TpuConfig] = None):
+        super().__init__(
+            estimator, scoring=scoring, n_jobs=n_jobs, refit=refit, cv=cv,
+            verbose=verbose, error_score=error_score,
+            return_train_score=return_train_score, backend=backend,
+            config=config)
+        self.random_state = random_state
+        self.max_resources = max_resources
+        self.resource = resource
+        self.factor = factor
+        self.min_resources = min_resources
+        self.aggressive_elimination = aggressive_elimination
+
+    # -- sklearn's input contract ---------------------------------------
+    def _check_input_parameters(self, X, y, split_params):
+        """sklearn ``BaseSuccessiveHalving._check_input_parameters``,
+        reproduced exactly (messages included) so misconfigurations
+        fail identically on both engines."""
+        if not _yields_constant_splits(self._checked_cv_orig):
+            raise ValueError(
+                "The cv parameter must yield consistent folds across "
+                "calls to split(). Set its random_state to an int, or set "
+                "shuffle=False.")
+        if (self.resource != "n_samples"
+                and self.resource not in self.estimator.get_params()):
+            raise ValueError(
+                f"Cannot use resource={self.resource} which is not "
+                "supported by estimator "
+                f"{self.estimator.__class__.__name__}")
+        if isinstance(self, HalvingRandomSearchCV):
+            if self.min_resources == self.n_candidates == "exhaust":
+                raise ValueError(
+                    "n_candidates and min_resources cannot be both set "
+                    "to 'exhaust'.")
+        self.min_resources_ = self.min_resources
+        if self.min_resources_ in ("smallest", "exhaust"):
+            if self.resource == "n_samples":
+                n_splits = self._checked_cv_orig.get_n_splits(
+                    X, y, **split_params)
+                # sklearn's magic factor (see their source for the
+                # justification link)
+                magic_factor = 2
+                self.min_resources_ = n_splits * magic_factor
+                if is_classifier(self.estimator):
+                    check_classification_targets(y)
+                    n_classes = np.unique(np.asarray(y)).shape[0]
+                    self.min_resources_ *= n_classes
+            else:
+                self.min_resources_ = 1
+            # 'exhaust' may raise min_resources_ again in _run_search
+        self.max_resources_ = self.max_resources
+        if self.max_resources_ == "auto":
+            if not self.resource == "n_samples":
+                raise ValueError(
+                    "resource can only be 'n_samples' when "
+                    "max_resources='auto'")
+            self.max_resources_ = _num_samples(X)
+        if self.min_resources_ > self.max_resources_:
+            raise ValueError(
+                f"min_resources_={self.min_resources_} is greater "
+                f"than max_resources_={self.max_resources_}.")
+        if self.min_resources_ == 0:
+            raise ValueError(
+                f"min_resources_={self.min_resources_}: you might have "
+                "passed an empty dataset X.")
+
+    @staticmethod
+    def _select_best_index(refit, refit_metric, results):
+        """sklearn's halving override: the best candidate of the LAST
+        iteration (BaseSearchCV would pick over all iterations)."""
+        last_iter = np.max(results["iter"])
+        last_iter_indices = np.flatnonzero(results["iter"] == last_iter)
+        test_scores = results["mean_test_score"][last_iter_indices]
+        if np.isnan(test_scores).all():
+            best_idx = 0
+        else:
+            best_idx = np.nanargmax(test_scores)
+        return last_iter_indices[best_idx]
+
+    def fit(self, X, y=None, **params):
+        """Run the halving search.  Mirrors sklearn's
+        ``BaseSuccessiveHalving.fit``: validate the resource budget,
+        then hand the rung loop to the shared engine."""
+        if isinstance(self.scoring, (list, tuple, set, dict)):
+            # sklearn enforces this via _parameter_constraints: the
+            # halving elimination needs ONE mean_test_score column
+            raise ValueError(
+                "Multimetric scoring is not supported for successive "
+                "halving; pass a single scorer name or callable.")
+        self._checked_cv_orig = check_cv(
+            self.cv, y, classifier=is_classifier(self.estimator))
+        routed_params = self._get_routed_params_for_fit(params)
+        self._check_input_parameters(
+            X=X, y=y, split_params=routed_params.splitter.split)
+        self._n_samples_orig = _num_samples(X)
+        super().fit(X, y=y, **params)
+        # sklearn sets best_score_ explicitly (its refit selection is a
+        # custom callable there); ours lands on the same value via
+        # _select_best_index, but keep the assignment for the callable-
+        # refit corner where the base class skips it
+        self.best_score_ = self.cv_results_["mean_test_score"][
+            self.best_index_]
+        return self
+
+    # -- the rung loop ---------------------------------------------------
+    def _run_search(self, evaluate_candidates, *, callback_ctx=None):
+        candidate_params = list(self._generate_candidate_params())
+
+        if self.resource != "n_samples" and any(
+                self.resource in candidate
+                for candidate in candidate_params):
+            raise ValueError(
+                f"Cannot use parameter {self.resource} as the resource "
+                "since it is part of the searched parameters.")
+
+        n_required_iterations = 1 + floor(
+            log(len(candidate_params), self.factor))
+
+        if self.min_resources == "exhaust":
+            # start with the biggest min_resources so the last
+            # (required) iteration uses as many resources as possible
+            last_iteration = n_required_iterations - 1
+            self.min_resources_ = max(
+                self.min_resources_,
+                self.max_resources_ // self.factor ** last_iteration)
+
+        n_possible_iterations = 1 + floor(log(
+            self.max_resources_ // self.min_resources_, self.factor))
+
+        if self.aggressive_elimination:
+            n_iterations = n_required_iterations
+        else:
+            n_iterations = min(n_possible_iterations,
+                               n_required_iterations)
+
+        if self.verbose:
+            # stdout-parity channel: sklearn prints these via print()
+            logger.print(f"n_iterations: {n_iterations}")
+            logger.print(
+                f"n_required_iterations: {n_required_iterations}")
+            logger.print(
+                f"n_possible_iterations: {n_possible_iterations}")
+            logger.print(f"min_resources_: {self.min_resources_}")
+            logger.print(f"max_resources_: {self.max_resources_}")
+            logger.print(
+                f"aggressive_elimination: {self.aggressive_elimination}")
+            logger.print(f"factor: {self.factor}")
+
+        self.n_resources_ = []
+        self.n_candidates_ = []
+
+        cfg = self.config or TpuConfig()
+        rc = _RungContext(
+            resource=self.resource,
+            replan=bool(getattr(cfg, "halving_replan", True)),
+            min_rung_width=int(getattr(cfg, "min_rung_width", 0) or 0),
+            n_candidates0=len(candidate_params))
+        self._rung_ctx = rc
+        from spark_sklearn_tpu import serve as _serve
+        from spark_sklearn_tpu.parallel import dataplane as _dataplane
+        binding = _serve.current_binding()
+        plane = _dataplane.plane_for(cfg)
+        tracer = get_tracer()
+        try:
+            for itr in range(n_iterations):
+                power = itr
+                if self.aggressive_elimination:
+                    # hold n_resources at the floor while candidates
+                    # are still being eliminated, then grow as usual
+                    power = max(0, itr - n_required_iterations
+                                + n_possible_iterations)
+                n_resources = int(
+                    self.factor ** power * self.min_resources_)
+                n_resources = min(n_resources, self.max_resources_)
+                self.n_resources_.append(n_resources)
+                n_candidates = len(candidate_params)
+                self.n_candidates_.append(n_candidates)
+
+                if self.verbose:
+                    logger.print("-" * 10)
+                    logger.print(f"iter: {itr}")
+                    logger.print(f"n_candidates: {n_candidates}")
+                    logger.print(f"n_resources: {n_resources}")
+
+                if binding is not None:
+                    # the executor shrinks the tenant's effective
+                    # in-flight cap with the surviving fraction
+                    binding.executor.note_rung(
+                        binding.handle, itr, n_candidates,
+                        n_candidates / max(1, rc.n_candidates0))
+                if itr and plane is not None and binding is not None \
+                        and self.resource == "n_samples":
+                    # rung barrier: the PREVIOUS rung's subsampled
+                    # fold/tiled masks stop charging this tenant's
+                    # plane quota — retired candidates release their
+                    # bytes, not just their lanes.  The rung-scoped
+                    # label prefix ("mask.r0.") demotes exactly that
+                    # rung's buffers: never a sibling search's live
+                    # masks under the same tenant, and estimator-
+                    # parameter resources (which reuse the same
+                    # full-dataset masks every rung) skip demotion
+                    # entirely.
+                    freed = plane.demote(f"mask.r{itr - 1}.",
+                                         binding.tenant)
+                    if freed:
+                        logger.info(
+                            "halving rung %d: demoted %d stale mask "
+                            "byte(s) from tenant %s", itr, freed,
+                            binding.tenant, rung=itr)
+
+                if self.resource == "n_samples":
+                    # sklearn's own subsample splitter: identical RNG,
+                    # identical per-rung fold indices — they become
+                    # fold masks through the engine's existing
+                    # machinery
+                    cv = _SubsampleMetaSplitter(
+                        base_cv=self._checked_cv_orig,
+                        fraction=n_resources / self._n_samples_orig,
+                        subsample_test=True,
+                        random_state=self.random_state)
+                else:
+                    # copy so the next rung's value does not overwrite
+                    candidate_params = [dict(c)
+                                        for c in candidate_params]
+                    for candidate in candidate_params:
+                        candidate[self.resource] = n_resources
+                    cv = None     # the search's own (full) splits
+
+                more_results = {
+                    "iter": [itr] * n_candidates,
+                    "n_resources": [n_resources] * n_candidates,
+                }
+
+                rung_rec = rc.begin_rung(itr, n_resources, n_candidates)
+                t_rung0 = time.perf_counter()
+                with tracer.span("halving.rung", iter=itr,
+                                 n_candidates=n_candidates,
+                                 n_resources=n_resources):
+                    results = evaluate_candidates(
+                        candidate_params, cv, more_results=more_results)
+                rung_rec["wall_s"] = round(
+                    time.perf_counter() - t_rung0, 4)
+
+                n_candidates_to_keep = ceil(n_candidates / self.factor)
+                # sklearn's own top-k (NaN placement and tie order
+                # included) — the surviving set is byte-exact theirs
+                candidate_params = list(
+                    _top_k(results, n_candidates_to_keep, itr))
+        finally:
+            pipe = rc.pipeline
+            rc.pipeline = None
+            self._rung_ctx = None
+            if pipe is not None:
+                # the rungs only drained it; the search owns the close
+                pipe.close()
+
+        self.n_remaining_candidates_ = len(candidate_params)
+        self.n_required_iterations_ = n_required_iterations
+        self.n_possible_iterations_ = n_possible_iterations
+        self.n_iterations_ = n_iterations
+        # the whole-search halving block lands in whichever registry
+        # finished the search (compiled or host tier)
+        metrics = self._search_metrics
+        metrics.put("halving", _render_halving_block(self, rc))
+
+    def _generate_candidate_params(self):
+        raise NotImplementedError
+
+
+class HalvingGridSearchCV(BaseSuccessiveHalvingTPU):
+    """Successive-halving grid search on the TPU mesh — sklearn
+    ``HalvingGridSearchCV`` parity (``n_resources_``,
+    ``n_candidates_``, the ``iter``/``n_resources`` columns in
+    ``cv_results_``, last-iteration ``best_*`` selection) with each
+    rung executed as a planned set of compile groups and eliminated
+    candidates' lanes reclaimed mid-search (see the module
+    docstring)."""
+
+    def __init__(self, estimator, param_grid=None, *, factor=3,
+                 resource="n_samples", max_resources="auto",
+                 min_resources="exhaust", aggressive_elimination=False,
+                 cv=5, scoring=None, refit=True, error_score=np.nan,
+                 return_train_score=True, random_state=None, n_jobs=None,
+                 verbose=0, backend=None, config=None):
+        if param_grid is None:
+            raise TypeError("param_grid is required")
+        super().__init__(
+            estimator, scoring=scoring, n_jobs=n_jobs, refit=refit,
+            cv=cv, verbose=verbose, random_state=random_state,
+            error_score=error_score,
+            return_train_score=return_train_score,
+            max_resources=max_resources, min_resources=min_resources,
+            resource=resource, factor=factor,
+            aggressive_elimination=aggressive_elimination,
+            backend=backend, config=config)
+        self.param_grid = param_grid
+
+    def _generate_candidate_params(self):
+        return ParameterGrid(self.param_grid)
+
+
+class HalvingRandomSearchCV(BaseSuccessiveHalvingTPU):
+    """Successive-halving randomized search: candidates drawn by
+    sklearn's ``ParameterSampler`` (identical sampling semantics,
+    ``n_candidates='exhaust'`` included), evaluated rung by rung on
+    the mesh."""
+
+    def __init__(self, estimator, param_distributions=None, *,
+                 n_candidates="exhaust", factor=3, resource="n_samples",
+                 max_resources="auto", min_resources="smallest",
+                 aggressive_elimination=False, cv=5, scoring=None,
+                 refit=True, error_score=np.nan, return_train_score=True,
+                 random_state=None, n_jobs=None, verbose=0, backend=None,
+                 config=None):
+        if param_distributions is None:
+            raise TypeError("param_distributions is required")
+        super().__init__(
+            estimator, scoring=scoring, n_jobs=n_jobs, refit=refit,
+            cv=cv, verbose=verbose, random_state=random_state,
+            error_score=error_score,
+            return_train_score=return_train_score,
+            max_resources=max_resources, min_resources=min_resources,
+            resource=resource, factor=factor,
+            aggressive_elimination=aggressive_elimination,
+            backend=backend, config=config)
+        self.param_distributions = param_distributions
+        self.n_candidates = n_candidates
+
+    def _generate_candidate_params(self):
+        n_candidates_first_iter = self.n_candidates
+        if n_candidates_first_iter == "exhaust":
+            # enough candidates that the last iteration exhausts the
+            # resource budget (sklearn's rule)
+            n_candidates_first_iter = (
+                self.max_resources_ // self.min_resources_)
+        return ParameterSampler(
+            self.param_distributions, n_candidates_first_iter,
+            random_state=self.random_state)

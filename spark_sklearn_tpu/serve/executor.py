@@ -63,6 +63,7 @@ other's numbers — scores never do.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import threading
 import time
@@ -190,6 +191,13 @@ class SearchHandle:
         self.cost_dispatched = 0   # task units dispatched
         self.inflight = 0          # chunks dispatched, not yet finalized
         self.planned = 0           # live chunk estimate (progress())
+        #: successive-halving view (SearchExecutor.note_rung): current
+        #: rung index and the surviving-candidate fraction — the
+        #: tenant's EFFECTIVE in-flight cap scales by the fraction, so
+        #: a halving search's device claim shrinks as rungs retire
+        #: candidates instead of holding rung-0's reservation
+        self.rung = -1             # -1 = not a halving search
+        self.rung_frac = 1.0
         #: bounded {tenant, wait_s} records — tenant-stamped so samples
         #: merged across concurrent searches still attribute per tenant
         self.queue_waits: List[Dict[str, Any]] = []
@@ -620,19 +628,62 @@ class SearchExecutor:
         with self._lock:
             frac = (min(1.0, handle.n_dispatched / handle.planned)
                     if handle.planned else None)
-            return {
+            out = {
                 "state": handle.state,
                 "tenant": handle.tenant,
                 "dispatched": handle.n_dispatched,
                 "planned": handle.planned,
                 "frac": frac,
             }
+            if handle.rung >= 0:
+                out["rung"] = handle.rung
+                out["rung_frac"] = round(handle.rung_frac, 4)
+            return out
 
     def note_planned(self, handle: SearchHandle, n: int) -> None:
         """Live-chunk estimate from the search's geometry plan, for
         :meth:`SearchFuture.progress`."""
         with self._lock:
             handle.planned = int(n)
+
+    def note_rung(self, handle: SearchHandle, itr: int,
+                  n_candidates: int, frac: float) -> None:
+        """A halving search's rung transition (search/halving.py):
+        records the rung index and surviving-candidate fraction.  The
+        fraction scales the tenant's effective in-flight chunk cap in
+        :meth:`_pop_next` — as rungs retire candidates the search's
+        claim on the shared device shrinks with them, freeing dispatch
+        slots for other tenants mid-search instead of at search end."""
+        with self._lock:
+            handle.rung = int(itr)
+            handle.rung_frac = min(1.0, max(float(frac), 0.0)) or 1.0
+        logger.info(
+            "search %s entered halving rung %d (%d candidate(s), "
+            "share %.3f)", handle.id, itr, n_candidates,
+            handle.rung_frac, handle=handle.id, rung=int(itr))
+
+    def _effective_cap(self, tenant_name: str) -> int:
+        """The tenant's in-flight chunk cap, scaled by its active
+        halving searches' surviving fraction (caller holds the lock).
+        0 = unbounded.  Any active NON-halving search of the tenant
+        pins the fraction to 1.0 — the tenant-wide cap must never
+        starve an exhaustive search because a sibling halving search
+        reached a late rung."""
+        cap = self._tenant_cap
+        if not cap:
+            return 0
+        frac = 0.0
+        seen = False
+        for h in self._active:
+            if h.tenant != tenant_name:
+                continue
+            seen = True
+            frac = max(frac, 1.0 if h.rung < 0 else h.rung_frac)
+            if frac >= 1.0:
+                return cap
+        if not seen:
+            return cap
+        return max(1, int(math.ceil(cap * frac)))
 
     # -- item wrapping (the grid._run_groups seam) -----------------------
     def wrap_items(self, handle: SearchHandle, items):
@@ -822,9 +873,12 @@ class SearchExecutor:
                 t = self._tenants[names[idx]]
                 if not t.queue:
                     continue
-                if self._tenant_cap and t.inflight >= self._tenant_cap:
+                cap = self._effective_cap(t.name)
+                if cap and t.inflight >= cap:
                     # in-flight chunks count the head itself once it
-                    # dispatches, so >= holds the cap exactly
+                    # dispatches, so >= holds the cap exactly (the cap
+                    # shrinks with a halving tenant's surviving rung
+                    # fraction — see note_rung)
                     continue
                 runnable += 1
                 head = t.queue[0]

@@ -95,6 +95,25 @@ class TestLegsToyShapes:
         _assert_finite(d, ["wall_s", "models_per_sec"])
         assert d["backend"]
 
+    def test_halving_adaptive(self):
+        d = bench.leg_halving(n_rows=242, n_candidates=24, folds=2,
+                              max_iter=5)
+        _assert_finite(d, ["exhaustive_warm_wall_s",
+                           "halving_warm_wall_s",
+                           "halving_replan_off_warm_wall_s",
+                           "wall_ratio_exhaustive_over_halving",
+                           "lanes_reclaimed_total"])
+        assert d["n_rungs"] >= 2
+        assert len(d["rungs"]) == d["n_rungs"]
+        # halving spends strictly fewer candidate x resource units
+        # (its extra fits run at small resources; rung row-compaction
+        # makes their compute proportional)
+        assert d["resource_units_halving"] < \
+            d["resource_units_exhaustive"]
+        # lane reclamation is pure geometry: the control arm agrees
+        assert d["replan_off_cv_results_identical"] is True
+        assert d["best_params_agree"] is True
+
     def test_serve_contended(self):
         d = bench.leg_serve_contended(n_rows=96, n_candidates=16,
                                       folds=2, max_iter=5, levels=(2,))

@@ -207,6 +207,10 @@ class Project:
                     Producer("dict-keys", "serve/executor.py",
                              "SearchExecutor.search_block"),
                 )),
+                BlockSpec("halving", "HALVING_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "search/halving.py",
+                             "_render_halving_block"),
+                )),
                 BlockSpec("telemetry", "TELEMETRY_SNAPSHOT_SCHEMA", (
                     Producer("dict-keys", "obs/telemetry.py",
                              "TelemetryService.snapshot"),
