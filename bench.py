@@ -336,6 +336,24 @@ def _dataplane_summary(report):
     return out
 
 
+def _memory_summary(report):
+    """The search's device-memory ledger view (search_report["memory"]
+    minus the per-group series, which is summarized to its peak) —
+    recorded per leg so BENCH_r*.json files show the modeled footprint
+    trend and whether the HBM ceiling ever bound a width."""
+    m = dict(report.get("memory", {}))
+    if not m:
+        return {}
+    out = {k: m[k] for k in (
+        "measured", "budget_bytes", "peak_modeled_bytes",
+        "resident_bytes", "watermark_bytes", "model_error_frac",
+        "safety_margin") if k in m}
+    groups = m.get("groups") or []
+    out["n_group_footprints"] = len(groups)
+    out["n_capped_widths"] = sum(1 for g in groups if g.get("capped"))
+    return out
+
+
 def leg_sstlint():
     """Run the sstlint static-analysis gate in-process and record its
     cost (rule count, finding counts, wall) — the gate rides tier-1,
@@ -409,7 +427,14 @@ def leg_headline(cache_dir=None, n_candidates=1000, n_folds=5,
         # trend future BENCH_r*.json compare against
         "dataplane_cold": _dataplane_summary(gs.search_report),
         "dataplane_warm": _dataplane_summary(gs2.search_report),
+        # device-memory ledger view: the headline is the acceptance
+        # leg, so an unpopulated ledger is a bug, not a shrug
+        "memory_cold": _memory_summary(gs.search_report),
+        "memory_warm": _memory_summary(gs2.search_report),
     }
+    mem = gs2.search_report.get("memory") or {}
+    assert mem.get("enabled") and mem.get("peak_modeled_bytes", 0) > 0 \
+        and mem.get("groups"), f"memory ledger unpopulated: {mem}"
 
     # MFU accounting (honest: digits is latency-bound — 64 features
     # cannot fill the MXU; the number exists to quantify that, the
@@ -533,6 +558,7 @@ def leg_svc_mxu(cache_dir=None, n=10_000, d=784, folds=3, max_iter=100,
             svc.cv_results_["mean_test_score"].max()), 4),
         "faults": _faults_summary(rep),
         "dataplane": _dataplane_summary(rep),
+        "memory": _memory_summary(rep),
     }
 
 
@@ -564,7 +590,8 @@ def leg_svc_digits(cache_dir=None, n_C=8, n_gamma=8, folds=3,
             "best_score": round(float(
                 svc.cv_results_["mean_test_score"].max()), 4),
             "faults": _faults_summary(svc.search_report),
-            "dataplane": _dataplane_summary(svc.search_report)}
+            "dataplane": _dataplane_summary(svc.search_report),
+            "memory": _memory_summary(svc.search_report)}
 
 
 def leg_config3_rf(cache_dir=None, n=20_000, d=54, n_classes=7, n_iter=8,
@@ -596,7 +623,8 @@ def leg_config3_rf(cache_dir=None, n=20_000, d=54, n_classes=7, n_iter=8,
             "fits_per_sec": round(n_iter * folds / w, 2),
             "backend": rs.search_report["backend"],
             "faults": _faults_summary(rs.search_report),
-            "dataplane": _dataplane_summary(rs.search_report)}
+            "dataplane": _dataplane_summary(rs.search_report),
+            "memory": _memory_summary(rs.search_report)}
 
 
 def leg_config4_gbr(cache_dir=None, n=20_000, d=8, folds=3,
@@ -628,7 +656,8 @@ def leg_config4_gbr(cache_dir=None, n=20_000, d=8, folds=3,
             "fits_per_sec": round(n_fits / w, 2),
             "backend": gbr.search_report["backend"],
             "faults": _faults_summary(gbr.search_report),
-            "dataplane": _dataplane_summary(gbr.search_report)}
+            "dataplane": _dataplane_summary(gbr.search_report),
+            "memory": _memory_summary(gbr.search_report)}
 
 
 def leg_config5_mlp(cache_dir=None, hidden=64, max_iter=60, folds=3,
@@ -662,7 +691,8 @@ def leg_config5_mlp(cache_dir=None, hidden=64, max_iter=60, folds=3,
             "fits_per_sec": round(n_fits / w, 2),
             "backend": mlp.search_report["backend"],
             "faults": _faults_summary(mlp.search_report),
-            "dataplane": _dataplane_summary(mlp.search_report)}
+            "dataplane": _dataplane_summary(mlp.search_report),
+            "memory": _memory_summary(mlp.search_report)}
 
 
 #: tiny search run by the persistent-cache/program-store probe
@@ -833,6 +863,15 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
             for f in futs:
                 f.result()
             wall = time.perf_counter() - t0
+            # per-tenant data-plane residency (DataPlane.tenant_usage_
+            # all): the SLO view used to show queue-wait/throughput but
+            # silently omit residency, leaving quota-pressure
+            # starvation invisible.  Read before the next level's
+            # searches re-charge the plane.
+            tenant_resident = {
+                str(t): int(b) for t, b in sorted(
+                    sess.dataplane.tenant_usage_all().items())
+            } if sess.dataplane is not None else {}
             # the waits sample is tenant-stamped (ISSUE 8 satellite),
             # so the merged distribution still attributes per tenant
             by_tenant = {}
@@ -855,6 +894,7 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                     for t, ws in sorted(by_tenant.items())},
                 "interleave_frac": [round(f, 4) for f in interleave],
                 "n_queue_waits": len(waits),
+                "tenant_resident_bytes": tenant_resident,
             }
     finally:
         sess.stop()
@@ -933,6 +973,7 @@ def leg_halving(cache_dir=None, n_rows=484, n_candidates=96, folds=2,
         "replan_off_cv_results_identical": bool(parity),
         "best_params_agree": bool(
             on.best_params_ == off.best_params_),
+        "memory": _memory_summary(on.search_report),
     }
 
 

@@ -31,7 +31,7 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 # acquisition-order inversion
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
-    tests/test_halving.py tests/test_sstlint.py -q
+    tests/test_halving.py tests/test_memory.py tests/test_sstlint.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -248,6 +248,23 @@ for name, fut in (("alpha", a), ("beta", b)):
         (name, tenants[name], sch)
     assert tenants[name]["tasks_total"] > 0
 assert snap["device"]["busy_s_window"] > 0, snap["device"]
+# per-tenant data-plane residency (DataPlane.tenant_usage_all via the
+# dataplane provider): the SLO view must carry the residency column,
+# and the contending tenants' resident X/y shows up under whichever
+# tenant uploaded it (content-dedup means the second tenant hits)
+sess.telemetry.sample_once()
+snap = json.loads(urllib.request.urlopen(
+    url + "/snapshot.json", timeout=10).read())
+resid = {t: snap["tenants"][t]["residency_bytes"]
+         for t in ("alpha", "beta")}
+assert all(v >= 0 for v in resid.values()) and sum(resid.values()) > 0, \
+    resid
+assert resid == {t: sess.dataplane.tenant_usage_all().get(t, 0)
+                 for t in ("alpha", "beta")}, resid
+# the memory block carries the ledger gauges the searches agree with
+assert snap["memory"]["modeled_peak_bytes"] >= max(
+    a.search_report["memory"]["peak_modeled_bytes"],
+    b.search_report["memory"]["peak_modeled_bytes"]), snap["memory"]
 # the Prometheus payload parses line-for-line and carries the series
 body = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
 from spark_sklearn_tpu.obs.fleet import METRIC_LINE_RE
@@ -340,6 +357,74 @@ print("halving smoke:",
        "lanes_reclaimed": hb["lanes_reclaimed_total"],
        "widths": [r["widths"] for r in hb["rungs"]]})
 PY
+
+echo "== device-memory smoke (HBM width ceiling + ledger flight bundle) =="
+MEM_FLIGHT_DIR=$(mktemp -d /tmp/sst_mem_smoke_XXXX)
+JAX_PLATFORMS=cpu SST_MEM_FLIGHT_DIR="$MEM_FLIGHT_DIR" python - <<'PY'
+import glob
+import json
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+grid = {"C": np.logspace(-2, 1, 40).tolist()}
+
+base = sst.GridSearchCV(LogisticRegression(max_iter=10), grid, cv=2,
+                        refit=False, backend="tpu").fit(X, y)
+# tiny HBM budget: the planner caps widths BELOW the unconstrained
+# plan, the search completes with ZERO OOM bisections (the ceiling
+# made bisection unnecessary), and scores stay bit-exact
+gs = sst.GridSearchCV(
+    LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+    backend="tpu",
+    config=sst.TpuConfig(hbm_budget_bytes=7_000)).fit(X, y)
+mem = gs.search_report["memory"]
+widths = [g["width"] for g in gs.search_report["geometry"]["groups"]]
+base_w = [g["width"] for g in base.search_report["geometry"]["groups"]]
+assert mem["budget_bytes"] == 7_000 and mem["groups"], mem
+assert any(g["capped"] for g in gs.search_report["geometry"]["groups"])
+assert all(w <= b for w, b in zip(widths, base_w)) and widths < base_w
+f = gs.search_report["faults"]
+assert f["bisections"] == 0 and f["by_class"].get("oom", 0) == 0, f
+assert all(g["chunk_bytes"] + g["resident_bytes"]
+           <= 7_000 for g in mem["groups"]), mem["groups"]
+np.testing.assert_array_equal(base.cv_results_["mean_test_score"],
+                              gs.cv_results_["mean_test_score"])
+# injected OOM: the flight bundle carries the full ledger snapshot and
+# the fault events carry modeled-vs-budget bytes
+cfg = sst.TpuConfig(fault_plan="oom@4", retry_backoff_s=0.01,
+                    flight_dir=os.environ["SST_MEM_FLIGHT_DIR"],
+                    trace=True)
+oom = sst.GridSearchCV(LogisticRegression(max_iter=10), grid, cv=2,
+                       refit=False, backend="tpu", config=cfg).fit(X, y)
+np.testing.assert_array_equal(base.cv_results_["mean_test_score"],
+                              oom.cv_results_["mean_test_score"])
+ev = [e for e in oom.search_report["faults"]["events"]
+      if e["class"] == "oom"]
+assert ev and all("modeled_bytes" in e and "budget_bytes" in e
+                  for e in ev), ev
+bundles = glob.glob(os.path.join(os.environ["SST_MEM_FLIGHT_DIR"],
+                                 "flight-oom-*.json"))
+assert bundles, os.listdir(os.environ["SST_MEM_FLIGHT_DIR"])
+bundle = json.load(open(bundles[0]))
+assert bundle["memory"]["groups"] and \
+    bundle["memory"]["n_oom_observed"] >= 1, sorted(bundle["memory"])
+print("memory smoke:",
+      {"capped_widths": widths, "uncapped_widths": base_w,
+       "peak_modeled": mem["peak_modeled_bytes"],
+       "safety_margin_after_oom":
+           oom.search_report["memory"]["safety_margin"]})
+PY
+# the bundle's ledger section digests through the standard trace tool
+JAX_PLATFORMS=cpu python tools/trace_summary.py \
+    "$MEM_FLIGHT_DIR"/flight-oom-*.json | grep -q "flight-bundle ledger"
+rm -rf "$MEM_FLIGHT_DIR"
 
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'

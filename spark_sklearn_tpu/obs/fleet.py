@@ -191,6 +191,38 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
                mtype="counter" if key.endswith("_total") else "gauge",
                help_text="Program-store counter (see "
                          "search_report['programstore']).")
+    mem = snap.get("memory") or {}
+    for dev_id, d in sorted((mem.get("devices") or {}).items()):
+        lbl = {"device": dev_id}
+        ln.add("sst_memory_device_bytes_in_use",
+               d.get("bytes_in_use"), labels=lbl,
+               help_text="Allocator bytes in use per device (jax "
+                         "memory_stats).")
+        ln.add("sst_memory_device_bytes_limit",
+               d.get("bytes_limit"), labels=lbl,
+               help_text="Allocator byte limit per device (0 when the "
+                         "backend reports none).")
+        ln.add("sst_memory_device_pressure_frac",
+               d.get("pressure_frac"), labels=lbl,
+               help_text="Per-device occupancy fraction "
+                         "(bytes_in_use / bytes_limit).")
+    ln.add("sst_memory_measured", mem.get("measured"),
+           help_text="1 when a local device exposes allocator "
+                     "memory_stats (0 = ledger runs model-only).")
+    ln.add("sst_memory_watermark_bytes", mem.get("watermark_bytes"),
+           help_text="Measured bytes-in-use high-water mark sampled "
+                     "at launch boundaries.")
+    ln.add("sst_memory_modeled_peak_bytes",
+           mem.get("modeled_peak_bytes"),
+           help_text="Largest modeled in-flight footprint the ledger "
+                     "has registered (resident set + widest chunk).")
+    ln.add("sst_memory_safety_margin", mem.get("safety_margin"),
+           help_text="The footprint model's learned over-provisioning "
+                     "factor (trained by observed OOMs).")
+    ln.add("sst_memory_oom_observed_total", mem.get("n_oom_observed"),
+           mtype="counter",
+           help_text="OOM recoveries the ledger has folded into its "
+                     "safety margin.")
     faults = snap.get("faults") or {}
     for cls, n in (faults.get("by_class") or {}).items():
         ln.add("sst_faults_total", n, labels={"class": cls},

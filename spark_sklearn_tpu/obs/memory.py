@@ -1,0 +1,142 @@
+"""Device-memory observability — measuring the HBM side of the ledger.
+
+Every observable so far watched *time*: the span tracer (PR 2), the
+fleet telemetry service (PR 8) and the geometry cost model all price
+launches in seconds.  But the reference's whole value proposition was
+fitting many candidates inside FIXED per-executor memory, and memory
+pressure — not compute — is what kills such workloads at scale
+(arXiv:1612.01437's straggler analysis keeps landing on memory).  Until
+this module the engine discovered device memory exhaustion only by
+catching ``RESOURCE_EXHAUSTED`` and bisecting (PR 3): OOM was the
+*discovery* mechanism, not the fallback.
+
+This module is the measurement half of the device-memory ledger
+(:mod:`spark_sklearn_tpu.parallel.memledger` is the modeling half):
+
+  - :func:`device_memory_stats` reads every local device's
+    ``memory_stats()`` (bytes in use, peak, allocator limit) where the
+    backend provides it.  XLA:CPU typically does not — the reading then
+    degrades to ``measured: False`` and the ledger runs model-only,
+    exactly like the tracer's no-op discipline: nothing raises, nothing
+    allocates per call beyond the result dicts.
+  - :func:`detect_device_memory_bytes` is the budget default's input:
+    the smallest per-device allocator limit across the fleet (0 when no
+    backend reports one).
+  - :func:`resolve_hbm_budget` turns ``TpuConfig(hbm_budget_bytes)`` /
+    ``SST_HBM_BUDGET_BYTES`` into the planner's byte ceiling, defaulting
+    to :data:`DEFAULT_HBM_FRACTION` of the detected device memory so a
+    TPU process never *plans* a chunk it cannot fit — and to "no
+    ceiling" on backends (CPU) that report no limit.
+
+Readings are cheap (one runtime call per device); the ledger samples
+them at launch boundaries (``parallel/pipeline.py``) under a
+``memory.sample`` span and the PR 8 telemetry sampler polls them on its
+interval, so the pressure series in ``/metrics`` stays current between
+searches.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "DEFAULT_HBM_FRACTION",
+    "detect_device_memory_bytes",
+    "device_memory_stats",
+    "pressure",
+    "resolve_hbm_budget",
+]
+
+#: default planner budget as a fraction of the detected per-device
+#: allocator limit — headroom for XLA scratch/temp buffers the
+#: shape-level model cannot see (the ledger's safety margin tightens
+#: the rest from observed OOMs).
+DEFAULT_HBM_FRACTION = 0.8
+
+
+def _one_device_stats(dev) -> Dict[str, Any]:
+    """One device's memory reading.  ``measured`` is False when the
+    backend has no ``memory_stats`` (XLA:CPU) or returns nothing."""
+    rec: Dict[str, Any] = {
+        "id": int(getattr(dev, "id", -1)),
+        "platform": str(getattr(dev, "platform", "?")),
+        "measured": False,
+        "bytes_in_use": 0,
+        "peak_bytes_in_use": 0,
+        "bytes_limit": 0,
+    }
+    stats_fn = getattr(dev, "memory_stats", None)
+    if stats_fn is None:
+        return rec
+    try:
+        stats = stats_fn()
+    except (RuntimeError, NotImplementedError, OSError):
+        # a backend that raises instead of returning None (seen on some
+        # plugin PJRT clients) is the same "unmeasured" outcome
+        return rec
+    if not stats:
+        return rec
+    rec["measured"] = True
+    rec["bytes_in_use"] = int(stats.get("bytes_in_use", 0) or 0)
+    rec["peak_bytes_in_use"] = int(
+        stats.get("peak_bytes_in_use", rec["bytes_in_use"]) or 0)
+    rec["bytes_limit"] = int(stats.get("bytes_limit", 0) or 0)
+    return rec
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-local-device memory readings (``measured: False`` rows for
+    backends without allocator stats).  Import-light until called: the
+    jax import only happens on first use."""
+    import jax
+
+    return [_one_device_stats(d) for d in jax.local_devices()]
+
+
+def pressure(rec: Dict[str, Any]) -> float:
+    """One device's occupancy fraction (0.0 when unmeasured or the
+    backend reports no limit)."""
+    limit = rec.get("bytes_limit", 0)
+    if not rec.get("measured") or not limit:
+        return 0.0
+    return min(1.0, max(0.0, rec.get("bytes_in_use", 0) / limit))
+
+
+def detect_device_memory_bytes(
+        stats: Optional[List[Dict[str, Any]]] = None) -> int:
+    """The smallest measured per-device allocator limit across the
+    fleet — the number the default HBM budget is a fraction of.  0 when
+    no device reports a limit (ledger-only mode)."""
+    stats = device_memory_stats() if stats is None else stats
+    limits = [r["bytes_limit"] for r in stats
+              if r.get("measured") and r.get("bytes_limit", 0) > 0]
+    return min(limits) if limits else 0
+
+
+def resolve_hbm_budget(config=None,
+                       stats: Optional[List[Dict[str, Any]]] = None) -> int:
+    """The geometry planner's per-device byte ceiling.
+
+    ``TpuConfig.hbm_budget_bytes`` wins when set (0 disables the
+    ceiling explicitly); else the ``SST_HBM_BUDGET_BYTES`` env var;
+    else :data:`DEFAULT_HBM_FRACTION` of the detected device memory.
+    Backends with no measurable limit (XLA:CPU) default to 0 — no
+    ceiling, bit-identical planning to the pre-ledger engine."""
+    budget = getattr(config, "hbm_budget_bytes", None) \
+        if config is not None else None
+    if budget is None:
+        env = os.environ.get("SST_HBM_BUDGET_BYTES", "").strip()
+        if env:
+            try:
+                budget = int(env)
+            except ValueError:
+                from spark_sklearn_tpu.obs.log import get_logger
+                get_logger(__name__).warning(
+                    "SST_HBM_BUDGET_BYTES=%r is not an integer; the "
+                    "HBM width ceiling stays at its default", env)
+                budget = None
+    if budget is not None:
+        return max(0, int(budget))
+    detected = detect_device_memory_bytes(stats)
+    return int(detected * DEFAULT_HBM_FRACTION) if detected else 0

@@ -34,6 +34,7 @@ __all__ = [
     "PROGRAMSTORE_BLOCK_SCHEMA",
     "SCHEDULER_BLOCK_SCHEMA",
     "HALVING_BLOCK_SCHEMA",
+    "MEMORY_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
@@ -158,6 +159,15 @@ SEARCH_REPORT_SCHEMA = (
         "geometry re-planning (search/halving.py).  Absent on "
         "exhaustive searches.",
         backends="tpu,host"),
+    MetricDef(
+        "memory", "struct",
+        "The device-memory ledger's per-search view (see the "
+        "memory-block schema below): modeled per-compile-group "
+        "footprints, the HBM budget/width-ceiling state, the measured "
+        "watermark and the model-vs-measured error "
+        "(parallel/memledger.py).  Absent when "
+        "TpuConfig(memory_ledger=False) — the byte-identical "
+        "pre-ledger report shape."),
     MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
@@ -473,6 +483,59 @@ HALVING_BLOCK_SCHEMA = (
 )
 
 
+#: sub-keys of ``search_report["memory"]`` (written by
+#: ``parallel.memledger.report_block``) — the device-memory ledger's
+#: per-search view: what the search modeled, what the budget allowed,
+#: and what the allocator measured.
+MEMORY_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Always True when present: the block only renders when "
+              "the ledger is on (TpuConfig.memory_ledger, default "
+              "True); disabled, the report is byte-identical to the "
+              "pre-ledger shape."),
+    MetricDef("measured", "label",
+              "Whether any local device exposes allocator "
+              "memory_stats.  False (XLA:CPU) runs the ledger "
+              "model-only: watermark and error stay 0."),
+    MetricDef("budget_bytes", "gauge",
+              "The resolved HBM planning budget "
+              "(TpuConfig.hbm_budget_bytes / SST_HBM_BUDGET_BYTES; "
+              "default a fraction of detected device memory, 0 = no "
+              "width ceiling)."),
+    MetricDef("device_limit_bytes", "gauge",
+              "Smallest measured per-device allocator limit (0 when "
+              "no backend reports one)."),
+    MetricDef("safety_margin", "gauge",
+              "The footprint model's learned over-provisioning factor "
+              "— trained upward by observed OOM bisections, so the "
+              "width ceiling tightens instead of repeating a bad "
+              "plan."),
+    MetricDef("peak_modeled_bytes", "gauge",
+              "This search's largest modeled in-flight footprint: "
+              "resident broadcast set + the widest chunk's modeled "
+              "bytes."),
+    MetricDef("resident_bytes", "gauge",
+              "Modeled resident broadcast set (X/y + fold masks) this "
+              "search holds on device — the data plane's share of the "
+              "budget."),
+    MetricDef("watermark_bytes", "gauge",
+              "Measured per-device bytes-in-use high-water mark "
+              "sampled at launch boundaries (0 unmeasured)."),
+    MetricDef("model_error_frac", "gauge",
+              "Relative error between the modeled peak and the "
+              "measured watermark delta over this search (0.0 when "
+              "unmeasured) — how much to trust the model."),
+    MetricDef("n_samples", "counter",
+              "Device memory_stats samples taken during this search "
+              "(launch boundaries + telemetry sampler)."),
+    MetricDef("groups", "series",
+              "Per (compile group, width): modeled dyn/mask/output "
+              "byte breakdown, per-candidate slope, chunk_bytes, the "
+              "resident share and whether the HBM ceiling capped the "
+              "planned width."),
+)
+
+
 #: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
 #: telemetry service's JSON view (``obs/telemetry.py``), also served
 #: as ``/snapshot.json`` (and rendered to Prometheus text) by the
@@ -512,6 +575,13 @@ TELEMETRY_SNAPSHOT_SCHEMA = (
     MetricDef("programstore", "struct",
               "AOT-store hit/miss/publish/quarantine event totals "
               "plus the sampler's polled cumulative counters."),
+    MetricDef("memory", "struct",
+              "Device-memory view: per-device bytes-in-use / limit / "
+              "pressure (sampled from jax memory_stats where the "
+              "backend provides it), the ledger's modeled peak, "
+              "measured watermark, safety margin and a bounded recent "
+              "max-pressure series — these agree with the searches' "
+              "search_report['memory'] blocks."),
     MetricDef("faults", "struct",
               "Observed fault totals by taxonomy class and recovery "
               "action (fed by the launch supervisor's event hook)."),
@@ -735,6 +805,14 @@ def schema_markdown() -> str:
         "`HalvingRandomSearchCV` fits (`search/halving.py`).\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in HALVING_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"memory\"]` block\n")
+    out.append(
+        "\nPresent when the device-memory ledger is on "
+        "(`TpuConfig.memory_ledger`, default True; "
+        "`parallel/memledger.py`).\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in MEMORY_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
                "endpoint schema\n")

@@ -136,6 +136,17 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
     # obs/telemetry.py
     SpanDef("telemetry.sample", "span", "obs.telemetry",
             "One fleet-telemetry sampler tick (provider polls)."),
+    # parallel/memledger.py
+    SpanDef("memory.sample", "span", "parallel.memledger",
+            "One device-memory reconciliation tick: jax memory_stats "
+            "across the local devices (carries bytes_in_use and "
+            "whether the backend measures at all)."),
+    SpanDef("memory.footprint", "instant", "parallel.memledger",
+            "One compile group's modeled device footprint registered "
+            "with the ledger (carries group, width, chunk_bytes, "
+            "modeled_bytes and whether the HBM ceiling capped the "
+            "width) — trace_summary digests these into the per-group "
+            "memory line."),
     # utils/session.py
     SpanDef("session.init", "span", "utils.session",
             "TpuSession bootstrap (mesh, caches, fault plan)."),

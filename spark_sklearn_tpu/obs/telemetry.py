@@ -286,6 +286,10 @@ class FlightRecorder:
             from spark_sklearn_tpu.obs.export import chrome_trace_events
             trace_events = chrome_trace_events(tracer.events())
         svc = get_telemetry()
+        # the device-memory ledger's full state (resident set, modeled
+        # group footprints, watermark, safety margin) rides in every
+        # bundle — an OOM postmortem shows what was resident and why
+        from spark_sklearn_tpu.parallel.memledger import get_ledger
         bundle = {
             "flight_format": 1,
             "reason": reason,
@@ -297,6 +301,7 @@ class FlightRecorder:
             "scheduler": dict(scheduler or {}),
             "faults": dict(faults or {}),
             "telemetry": svc.snapshot() if svc.enabled else {},
+            "memory": get_ledger().snapshot(),
             "records": records,
             "traceEvents": trace_events,
         }
@@ -727,6 +732,22 @@ class TelemetryService:
             block.update(self._latest_poll("programstore"))
             return block
 
+    def _memory_block(self) -> Dict[str, Any]:
+        """Device-memory view from the sampled "memory" provider (the
+        ledger's gauges: per-device pressure, modeled peak, watermark)
+        plus a bounded recent max-pressure series from the poll
+        window."""
+        with self._lock:
+            block = dict(self._latest_poll("memory"))
+            win = self._polls.get("memory")
+            if win is not None:
+                series = [v.get("pressure_frac_max", 0.0)
+                          for v in win.values()]
+                if series:
+                    block["pressure_window"] = [
+                        round(float(x), 6) for x in series[-64:]]
+            return block
+
     def _faults_block(self) -> Dict[str, Any]:
         return {
             "total": sum(self._faults_by_class.values()),
@@ -752,6 +773,7 @@ class TelemetryService:
                 "scheduler": self._scheduler_block(now),
                 "dataplane": self._dataplane_block(now),
                 "programstore": self._programstore_block(),
+                "memory": self._memory_block(),
                 "faults": self._faults_block(),
                 "flight": _FLIGHT.stats(),
             }

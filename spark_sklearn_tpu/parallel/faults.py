@@ -341,7 +341,9 @@ class LaunchSupervisor:
     """
 
     def __init__(self, config=None, faults: Optional[Dict[str, Any]] = None,
-                 ckpt=None, verbose: int = 0, reset_faults: bool = True):
+                 ckpt=None, verbose: int = 0, reset_faults: bool = True,
+                 memory_info: Optional[
+                     Callable[[str, int], Dict[str, Any]]] = None):
         self.max_launch_retries = int(
             getattr(config, "max_launch_retries", 2) or 0)
         self.max_search_retries = int(
@@ -359,6 +361,11 @@ class LaunchSupervisor:
         #: kept for flight-recorder dumps (TpuConfig.flight_dir /
         #: SST_FLIGHT_DIR resolve at dump time)
         self._config = config
+        #: device-memory forensics hook (search/grid.py): (key, group)
+        #: -> {modeled_bytes, budget_bytes, ...} stamped onto every OOM
+        #: event, so bisection outcomes show what the footprint model
+        #: believed — and train its safety margin
+        self._memory_info = memory_info
         #: one OOM bundle per search — a deep bisection storm must not
         #: dump a bundle per sub-range (guarded by self._lock)
         self._oom_dumped = False
@@ -390,8 +397,24 @@ class LaunchSupervisor:
         with self._lock:
             self.faults[name] += n
 
+    def _mem_extra(self, key: str, group: int) -> Dict[str, Any]:
+        """Modeled-vs-budget bytes for an OOM event (the device-memory
+        ledger's forensics; empty when no hook is installed).  Must
+        never turn a recovery into a second failure."""
+        if self._memory_info is None:
+            return {}
+        try:
+            return dict(self._memory_info(key, group) or {})
+        # forensics only: a broken lookup loses the memory annotation,
+        # never the recovery itself — the fault being annotated is
+        # already classified by the caller
+        # sstlint: disable=broad-except-swallow,swallowed-exception,launch-except-taxonomy
+        except Exception:
+            return {}
+
     def _record_event(self, key: str, group: int, cls: str, action: str,
                       exc: Optional[BaseException], attempt: int) -> None:
+        mem = self._mem_extra(key, group) if cls == OOM else {}
         with self._lock:
             by = self.faults["by_class"]
             by[cls] = by.get(cls, 0) + 1
@@ -401,7 +424,8 @@ class LaunchSupervisor:
                     "key": key, "group": group, "class": cls,
                     "action": action, "attempt": attempt,
                     "error": (f"{type(exc).__name__}: {exc}"[:200]
-                              if exc is not None else "")})
+                              if exc is not None else ""),
+                    **mem})
         if self._ckpt is not None:
             # durable fault journal: a resume after a failed recovery
             # still knows which chunk was in trouble (and the completed
@@ -451,12 +475,14 @@ class LaunchSupervisor:
             return
         with self._lock:
             faults_copy = copy.deepcopy(self.faults)
+        mem = self._mem_extra(key, group) if cls == OOM else {}
         _telemetry.flight_recorder().dump(
             reason, config=self._config, faults=faults_copy,
             context={"key": key, "group": group, "class": cls,
                      "action": action, "attempt": attempt,
                      "error": (f"{type(exc).__name__}: {exc}"[:300]
-                               if exc is not None else "")})
+                               if exc is not None else ""),
+                     **mem})
 
     def record_bisection(self, key: str, group: int) -> None:
         """Called by the item's bisect hook once per split."""

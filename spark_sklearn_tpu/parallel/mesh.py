@@ -234,6 +234,24 @@ class TpuConfig:
     # from degrading into matmul-starved slivers on wide meshes.
     # 0 = no floor beyond the shard multiple.
     min_rung_width: int = 0
+    # ---- device-memory ledger (parallel/memledger.py) ----
+    # HBM accounting: model every launch's device footprint from its
+    # abstract shapes, reconcile against jax memory_stats at launch
+    # boundaries, render search_report["memory"], and cap planned
+    # chunk widths to the HBM budget below.  False is the exact-no-op
+    # escape hatch: reports and cv_results_ are byte-identical to the
+    # pre-ledger engine (no "memory" block, no sampling, no ceiling).
+    memory_ledger: bool = True
+    # per-device byte budget the geometry planner fits chunks into:
+    # widths are capped so (broadcast residents + the chunk's modeled
+    # dyn/mask/output bytes) x the ledger's learned safety margin stay
+    # under it — chunks that would not fit are never launched, and OOM
+    # bisection becomes the fallback instead of the discovery
+    # mechanism.  None defers to SST_HBM_BUDGET_BYTES, then a fraction
+    # (obs.memory.DEFAULT_HBM_FRACTION) of the detected device memory;
+    # backends with no measurable limit (XLA:CPU) default to 0 = no
+    # ceiling.  0 disables the ceiling explicitly.
+    hbm_budget_bytes: Optional[int] = None
     # ---- fleet telemetry (obs/telemetry.py + obs/fleet.py) ----
     # localhost metrics endpoint: the session serves Prometheus text at
     # /metrics and the JSON snapshot at /snapshot.json on this port

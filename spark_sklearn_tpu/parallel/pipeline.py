@@ -55,6 +55,7 @@ from spark_sklearn_tpu.obs.trace import (
     set_correlation,
 )
 from spark_sklearn_tpu.parallel import dataplane as _dataplane
+from spark_sklearn_tpu.parallel import memledger as _memledger
 from spark_sklearn_tpu.utils.locks import named_lock
 
 _slog = get_logger(__name__)
@@ -276,6 +277,12 @@ class ChunkPipeline:
             with self._tracer.span("compile", label=label):
                 exe = precompile(jit_fn, *args)
             self._n_precompiled += 1
+            # device-memory ledger: harvest the compiled executable's
+            # XLA memory_analysis (argument/output/temp bytes) where
+            # the backend provides one — ground truth for the parts
+            # the shape-level footprint model cannot see (exact no-op
+            # when no ledger-enabled search is active)
+            _memledger.note_compiled(label, exe)
             return exe
 
         fut = self._compile_executor.submit(job)
@@ -388,6 +395,10 @@ class ChunkPipeline:
         # fleet telemetry: the launch's device-busy estimate feeds the
         # rolling device-occupancy series (exact no-op when disabled)
         _telemetry.note_launch(tm.compute_s)
+        # device-memory ledger: reconcile model vs allocator at the
+        # launch boundary (exact no-op off; unmeasurable backends
+        # early-out after the first probe)
+        _memledger.note_launch_boundary()
         rec = {
             "key": item.key, "group": item.group, "kind": item.kind,
             "n_tasks": item.n_tasks,

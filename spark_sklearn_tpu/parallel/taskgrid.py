@@ -349,6 +349,10 @@ class GroupGeometry:
     width: int               # uniform chunk width (padded lane count / fold)
     n_chunks: int
     sorted: bool             # convergence-sorted chunking active
+    #: the HBM width ceiling (memledger.width_cap) bound this group's
+    #: width below the planner's cost-optimal choice.  Defaulted so
+    #: pre-ledger journalled plans still deserialize.
+    capped: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -430,6 +434,7 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                   reuse: bool = False,
                   min_width: int = 0,
                   preferred: Optional[Sequence[Optional[int]]] = None,
+                  width_caps: Optional[Sequence[Optional[int]]] = None,
                   ) -> GeometryPlan:
     """Choose every compile group's chunk width.
 
@@ -445,6 +450,13 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     hatch).  Deterministic: same inputs (including the model values)
     -> same plan; ``reuse=True`` additionally serves the first plan
     computed for this structure again for the process lifetime.
+
+    ``width_caps`` gives a per-group HBM width ceiling (the device-
+    memory ledger's ``memledger.width_cap``): a capped group's width
+    never exceeds it in EITHER mode — a chunk the footprint model says
+    cannot fit is never planned, so OOM bisection becomes the fallback
+    instead of the discovery mechanism.  Caps bound the floor and the
+    preferred-width affinity too, and join the plan-cache key.
 
     ``min_width`` floors every auto-chosen unsorted width (rounded up
     to the shard multiple, capped by ``max_width``) — the halving
@@ -467,9 +479,20 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
         raise ValueError(
             "preferred widths depend on process compile history and "
             "must not enter the plan cache; pass reuse=False")
+    caps = [None] * len(sizes)
+    if width_caps is not None:
+        for gi, c in enumerate(width_caps):
+            if c is None:
+                continue
+            c = int(c)
+            # normalize to a launchable width: shard-multiple, at least
+            # one shard stripe, never beyond the task cap
+            c -= c % max(1, n_task_shards)
+            caps[gi] = max(n_task_shards, min(int(max_width), c))
     cache_key = (tuple(sizes), tuple(sorted_caps), int(n_folds),
                  int(n_task_shards), int(max_width), mode,
-                 overhead_override, lane_cost_override, int(min_width))
+                 overhead_override, lane_cost_override, int(min_width),
+                 tuple(caps))
     if reuse:
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)
@@ -510,12 +533,14 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     for gi, nc in enumerate(sizes):
         base_w = min(_pad_up(nc, n_task_shards), max_width)
         base_w = max(base_w, n_task_shards)
+        hbm_cap = caps[gi]
         cap = sorted_caps[gi]
         if cap is not None:
-            # convergence grading pins the width in both modes
-            width = cap
+            # convergence grading pins the width in both modes — the
+            # HBM ceiling still bounds it (memory beats grading)
+            width = cap if hbm_cap is None else min(cap, hbm_cap)
         elif mode == "fixed":
-            width = base_w
+            width = base_w if hbm_cap is None else min(base_w, hbm_cap)
         else:
             # power-of-two buckets of the shard count, capped by the
             # HBM bound and by the first bucket able to hold the whole
@@ -532,6 +557,12 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
             if floor_w:
                 candidates = {w_ for w_ in candidates if w_ >= floor_w}
                 candidates.add(floor_w)
+            if hbm_cap is not None:
+                # the HBM ceiling wins over the min-width floor: a
+                # floor the budget cannot hold would plan a chunk the
+                # model already knows will not fit
+                candidates = {w_ for w_ in candidates if w_ <= hbm_cap}
+                candidates.add(hbm_cap)
             # total order (cost, n_chunks, width): ties prefer fewer
             # launches, then the narrower (cheaper-HBM) width
             width = min(
@@ -543,6 +574,7 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                 pref = int(pref)
                 if pref >= max(n_task_shards, floor_w) \
                         and pref <= max_width \
+                        and (hbm_cap is None or pref <= hbm_cap) \
                         and pref % n_task_shards == 0 and pref != width:
                     # width affinity: an already-compiled width wins
                     # when its extra plan cost is under the measured
@@ -555,7 +587,9 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                         width = pref
         groups.append(GroupGeometry(
             group=gi, n_candidates=nc, width=int(width),
-            n_chunks=-(-nc // int(width)), sorted=cap is not None))
+            n_chunks=-(-nc // int(width)), sorted=cap is not None,
+            capped=hbm_cap is not None and int(width) == hbm_cap
+            and hbm_cap < base_w))
     plan = GeometryPlan(mode=mode, groups=groups, cost_model=snap)
     if reuse:
         with _PLAN_CACHE_LOCK:
@@ -590,7 +624,11 @@ def _plan_key_from_json(j: Sequence[Any]) -> Tuple:
             None if j[7] is None else float(j[7]),
             # min_width rode in after plans.json shipped: records
             # persisted by older processes carry 8 elements (= floor 0)
-            int(j[8]) if len(j) > 8 else 0)
+            int(j[8]) if len(j) > 8 else 0,
+            # HBM width caps (memledger) rode in later still: older
+            # records carry no caps (= uncapped per group)
+            tuple(None if c is None else int(c) for c in j[9])
+            if len(j) > 9 else tuple([None] * len(j[0])))
 
 
 def export_plan_state() -> Dict[str, Any]:

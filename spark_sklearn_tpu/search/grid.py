@@ -1255,6 +1255,32 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             if rung.dp_before is None:
                 rung.dp_before = dp_before
             dp_before = rung.dp_before
+        # device-memory ledger (parallel/memledger.py): model each
+        # launch's footprint from its abstract shapes, reconcile
+        # against jax memory_stats at launch boundaries, cap planned
+        # widths to the HBM budget and render search_report["memory"].
+        # Disabled (memory_ledger=False) the report and cv_results_
+        # stay byte-identical to the pre-ledger engine.
+        from spark_sklearn_tpu.obs import memory as _obs_memory
+        from spark_sklearn_tpu.parallel import memledger as _memledger
+        ledger = _memledger.ledger_for(config)
+        mem_before = _memledger.snapshot_counters(ledger)
+        if ledger is not None and (rung is None or rung.itr == 0):
+            mem_stats = ledger.sample(force=True)
+            self._memory_ctx = {
+                "groups": [],
+                "resident_bytes": 0,
+                "budget_bytes": _obs_memory.resolve_hbm_budget(
+                    config, mem_stats),
+                "device_limit_bytes": _obs_memory.
+                detect_device_memory_bytes(mem_stats),
+                "measured_baseline_bytes": max(
+                    (r["bytes_in_use"] for r in mem_stats), default=0),
+            }
+        if rung is not None:
+            if rung.mem_before is None:
+                rung.mem_before = mem_before
+            mem_before = rung.mem_before
         # a search submitted through a session's SearchExecutor charges
         # its broadcast residents to its tenant's data-plane quota
         from spark_sklearn_tpu import serve as _serve
@@ -1500,6 +1526,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         tr[s][i, f] = trs.get(s, np.nan)
             return te, tr
 
+        if ledger is not None:
+            # launch-boundary sampling (pipeline._record) is live only
+            # while a ledger-enabled search runs — refcounted so
+            # concurrent searches compose and memory_ledger=False
+            # stays an exact no-op
+            ledger.activate()
         try:
             with debug_ctx:
                 self._run_groups(
@@ -1535,6 +1567,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # obs.metrics.PROGRAMSTORE_BLOCK_SCHEMA
             metrics.put("programstore", _programstore.report_block(
                 pstore, ps_before))
+            # this search's device-memory view (modeled per-group
+            # footprints, budget/ceiling state, measured watermark) —
+            # schema in obs.metrics.MEMORY_BLOCK_SCHEMA.  Rendered
+            # ONLY when the ledger is on: off, the report shape is
+            # byte-identical to the pre-ledger engine.
+            if ledger is not None:
+                ledger.deactivate()
+                metrics.put("memory", _memledger.report_block(
+                    ledger, mem_before,
+                    getattr(self, "_memory_ctx", {}) or {}))
         if preval_failed.any():
             # failed fits never ran: sklearn records 0.0 for their times
             fit_times[preval_failed, :] = 0.0
@@ -1659,6 +1701,15 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # buffers instead of allocating per chunk)
         from spark_sklearn_tpu.parallel import dataplane as _dataplane
         plane = _dataplane.plane_for(config)
+        # the device-memory ledger (parallel/memledger.py): the per-
+        # search accumulator was initialized by _fit_compiled_impl;
+        # this method models the per-group footprints once geometry
+        # resolves, caps planned widths to the HBM budget, and stamps
+        # modeled-vs-budget bytes onto OOM fault events
+        from spark_sklearn_tpu.parallel import memledger as _memledger
+        ledger = _memledger.ledger_for(config)
+        mem_ctx = getattr(self, "_memory_ctx", None) \
+            if ledger is not None else None
         # the multi-tenant executor binding (serve/executor.py): set
         # when this search was submitted to a TpuSession's
         # SearchExecutor — its LaunchItems then route through the
@@ -1790,6 +1841,44 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             GeometryMismatchError, GeometryPlan, freeze,
             geometry_cost_model, plan_geometry)
         import dataclasses as _dc
+        # ledger-informed width ceiling: resident broadcast bytes (one
+        # count per distinct device buffer) plus each group's modeled
+        # per-candidate slope bound the widest chunk the HBM budget
+        # holds — a chunk the model says cannot fit is never planned,
+        # so OOM bisection becomes the fallback, not the discovery
+        # mechanism.  No budget (CPU default, or hbm_budget_bytes=0)
+        # means no caps: planning is bit-identical to the pre-ledger
+        # engine.
+        mem_caps = None
+        resident_est = 0
+        mem_kw = None
+        if ledger is not None:
+            seen_bufs = set()
+            for dev_arr in list(data_dev.values()) + [
+                    fit_dev, test_dev, train_sc_dev, test_unw_dev,
+                    train_unw_dev]:
+                if id(dev_arr) in seen_bufs:
+                    continue
+                seen_bufs.add(id(dev_arr))
+                resident_est += int(getattr(dev_arr, "nbytes", 0))
+            mem_kw = dict(
+                task_batched=task_batched,
+                n_samples=int(fit_masks.shape[1]),
+                mask_itemsize=int(fit_masks.dtype.itemsize),
+                n_scorers=len(scorers), return_train=return_train,
+                dtype_itemsize=int(np.dtype(dtype).itemsize))
+            budget = int(mem_ctx.get("budget_bytes", 0)) \
+                if mem_ctx is not None else 0
+            if budget:
+                mem_caps = [
+                    _memledger.width_cap(
+                        budget, resident_est,
+                        _memledger.model_group_footprint(
+                            p["group"].dynamic_params, 1, n_folds,
+                            **mem_kw)["per_candidate_bytes"],
+                        n_task_shards, max_cand_per_batch,
+                        ledger.safety_margin)
+                    for p in plans]
         geo_kwargs = dict(
             sizes=[p["nc"] for p in plans],
             sorted_caps=[p["sorted_cap"] for p in plans],
@@ -1799,7 +1888,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             cost_model=geometry_cost_model(),
             overhead_override=getattr(config, "geometry_overhead_s", None),
             lane_cost_override=getattr(config, "geometry_lane_cost_s",
-                                       None))
+                                       None),
+            width_caps=mem_caps)
         #: per-group structure identity ACROSS rungs: the static params
         #: minus the budgeted resource (survivor groups at rung k+1
         #: carry the same key as the rung-0 group they came from, even
@@ -1942,6 +2032,25 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 chunks.append((lo, hi, chunk_id, rec))
             plan["chunks"] = chunks
             plan["n_live"] = sum(1 for c in chunks if c[3] is None)
+
+        if ledger is not None and mem_ctx is not None:
+            # register every (group, chosen width) footprint with the
+            # ledger — the per-group records search_report["memory"]
+            # renders, the memory.footprint trace instants
+            # trace_summary digests, and the modeled bytes OOM events
+            # report against the budget
+            mem_ctx["resident_bytes"] = resident_est
+            for plan, gg in zip(plans, geo.groups):
+                fp = _memledger.model_group_footprint(
+                    plan["group"].dynamic_params, plan["nc_batch"],
+                    n_folds, **mem_kw)
+                rec = {"group": cid_ns + str(plan["gi"]),
+                       "width": int(plan["nc_batch"]),
+                       "capped": bool(getattr(gg, "capped", False)),
+                       "resident_bytes": int(resident_est), **fp}
+                plan["mem_chunk_bytes"] = int(fp["chunk_bytes"])
+                ledger.note_group(rec)
+                mem_ctx["groups"].append(rec)
 
         def build_programs(plan, width=None):
             """The group's jitted programs (cross-search cached); built
@@ -2733,9 +2842,37 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # watchdog on the blocking wait, and deterministic injection for
         # tests — identical at every pipeline depth (same item order)
         from spark_sklearn_tpu.parallel.faults import LaunchSupervisor
+        memory_info = None
+        if ledger is not None:
+            # OOM forensics: every OOM fault event carries the failing
+            # chunk's modeled bytes next to the budget, and the FIRST
+            # OOM per chunk trains the ledger's safety margin — so
+            # bisection outcomes tighten the width ceiling instead of
+            # repeating.  Bisected sub-ranges ("id[lo:hi]") share
+            # their parent chunk's model.
+            mem_oom_lock = named_lock("grid.mem_oom_lock")
+            oom_trained: set = set()
+
+            def memory_info(key, group):
+                plan = plans[group] if 0 <= group < len(plans) else None
+                modeled = int(resident_est) + (
+                    int(plan.get("mem_chunk_bytes", 0))
+                    if plan is not None else 0)
+                budget = int(mem_ctx.get("budget_bytes", 0)) \
+                    if mem_ctx is not None else 0
+                base_key = key.split("[", 1)[0]
+                with mem_oom_lock:
+                    fresh = base_key not in oom_trained
+                    if fresh:
+                        oom_trained.add(base_key)
+                if fresh:
+                    ledger.observe_oom(modeled, budget)
+                return {"modeled_bytes": modeled,
+                        "budget_bytes": budget}
+
         supervisor = LaunchSupervisor(
             config, faults=metrics.struct("faults"), ckpt=ckpt,
-            verbose=self.verbose,
+            verbose=self.verbose, memory_info=memory_info,
             # later rungs accumulate into the shared faults struct
             # instead of zeroing the earlier rungs' recovery record
             reset_faults=(rung is None or rung.itr == 0))

@@ -107,6 +107,7 @@ class _RungContext:
         self.builds0 = None            # program-build baseline
         self.dp_before = None          # data-plane counter baseline
         self.ps_before = None          # program-store counter baseline
+        self.mem_before = None         # memory-ledger counter baseline
         #: cross-rung geometry anchors, keyed by the group's static
         #: params minus the resource (taskgrid.freeze)
         self.base_widths: Dict[Any, int] = {}

@@ -103,6 +103,23 @@ class TpuSession:
             f"{self.programstore.directory} "
             f"(prewarmed {self._prewarm_summary.get('loaded', 0)} "
             "artifact(s))")
+        from spark_sklearn_tpu.obs import memory as _obs_memory
+        from spark_sklearn_tpu.parallel import memledger as _memledger
+        self.memledger = _memledger.ledger_for(self.config)
+        if self.memledger is not None:
+            budget = _obs_memory.resolve_hbm_budget(self.config)
+            if budget:
+                why = f"{budget // 2 ** 20} MiB"
+            elif getattr(self.config, "hbm_budget_bytes", None) == 0 \
+                    or os.environ.get(
+                        "SST_HBM_BUDGET_BYTES", "").strip() == "0":
+                why = "no ceiling — disabled by configuration"
+            else:
+                why = "no ceiling — no measurable device limit"
+            logger.info("memory ledger: on (hbm_budget=%s)", why,
+                        hbm_budget_bytes=budget)
+        else:
+            logger.info("memory ledger: disabled (memory_ledger=False)")
         logger.info(
             "fault supervisor: max_launch_retries=%d "
             "max_search_retries=%d backoff=%.2fs timeout=%s "
@@ -144,6 +161,14 @@ class TpuSession:
         if self.programstore is not None:
             self._telemetry_providers["programstore"] = \
                 self.programstore.counts
+        if getattr(self.config, "memory_ledger", True):
+            # the device-memory ledger's gauges (per-device pressure,
+            # modeled peak, watermark) — the sampler keeps the
+            # /metrics pressure series current between searches
+            from spark_sklearn_tpu.parallel import (
+                memledger as _memledger)
+            self._telemetry_providers["memory"] = \
+                _memledger.get_ledger().gauges
         try:
             for name, fn in self._telemetry_providers.items():
                 svc.register_provider(name, fn)

@@ -50,6 +50,11 @@ class TestLegsToyShapes:
         assert detail["n_fits"] == 8
         assert math.isfinite(fps) and fps > 0
         assert math.isfinite(vs)
+        # the device-memory ledger must be populated (ISSUE 10: the
+        # headline leg asserts it, so an unpopulated ledger fails the
+        # bench, not just the report)
+        assert detail["memory_warm"]["peak_modeled_bytes"] > 0
+        assert "n_capped_widths" in detail["memory_warm"]
         # the MFU record exists whenever the engine reported iterations
         if "headline_mfu" in detail:
             _assert_finite(detail["headline_mfu"],
@@ -113,6 +118,7 @@ class TestLegsToyShapes:
         # lane reclamation is pure geometry: the control arm agrees
         assert d["replan_off_cv_results_identical"] is True
         assert d["best_params_agree"] is True
+        assert d["memory"]["peak_modeled_bytes"] > 0
 
     def test_serve_contended(self):
         d = bench.leg_serve_contended(n_rows=96, n_candidates=16,
@@ -123,6 +129,18 @@ class TestLegsToyShapes:
                             "queue_wait_p50_s", "queue_wait_p95_s"])
         assert len(c2["interleave_frac"]) == 2
         assert c2["queue_wait_p95_s"] >= c2["queue_wait_p50_s"]
+        # per-tenant data-plane residency (ISSUE 10 bugfix: the SLO
+        # view used to omit residency, hiding quota-pressure
+        # starvation).  The content-deduped plane charges whichever
+        # tenant uploaded first — here the solo warm-up ("default"),
+        # or NOBODY when an earlier test in the process already left
+        # the same digits rows resident unowned — so the contract is
+        # the column's presence and truthful attribution, not a
+        # particular owner.
+        resid = c2["tenant_resident_bytes"]
+        assert isinstance(resid, dict)
+        assert set(resid) <= {"default", "tenant0", "tenant1"}, resid
+        assert all(v > 0 for v in resid.values()), resid
         # tenant-stamped waits (ISSUE 8): the contended leg reports a
         # distinct per-tenant distribution, not just the aggregate
         # (a tenant whose dispatches all ran fastpath — e.g. the other

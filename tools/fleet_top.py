@@ -4,8 +4,10 @@
 :class:`~spark_sklearn_tpu.utils.session.TpuSession` serves when
 ``TpuConfig(telemetry_port)`` / ``SST_TELEMETRY_PORT`` is set, and
 prints the per-tenant SLO table (queue-wait p50/p95, throughput,
-share, residency), device occupancy, scheduler queue depth, data-plane
-and program-store traffic, fault totals and flight-recorder state:
+share, data-plane resident bytes), device occupancy, scheduler queue
+depth, data-plane and program-store traffic, the device-memory
+ledger's pressure line (per-device HBM %, modeled peak, watermark),
+fault totals and flight-recorder state:
 
     python tools/fleet_top.py --port 9090            # one shot
     python tools/fleet_top.py --port 9090 --watch 2  # refresh every 2s
@@ -92,6 +94,22 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
             f"cache {dp.get('hits', 0)} hits / "
             f"{dp.get('misses', 0)} misses, "
             f"{_fmt_bytes(dp.get('bytes_in_cache', 0))} resident")
+    mem = snap.get("memory") or {}
+    if mem:
+        devs = mem.get("devices") or {}
+        line = (f"memory: modeled peak "
+                f"{_fmt_bytes(mem.get('modeled_peak_bytes', 0))}, "
+                f"watermark {_fmt_bytes(mem.get('watermark_bytes', 0))}, "
+                f"safety margin {mem.get('safety_margin', 1.0)}x, "
+                f"{mem.get('n_oom_observed', 0)} OOM(s) observed")
+        if mem.get("measured") and devs:
+            pres = ", ".join(
+                f"dev{k}={100 * d.get('pressure_frac', 0.0):.1f}%"
+                for k, d in sorted(devs.items()))
+            line += f"; pressure {pres}"
+        else:
+            line += " (allocator unmeasured — ledger model only)"
+        out.append(line)
     ps = snap.get("programstore") or {}
     if ps:
         out.append(

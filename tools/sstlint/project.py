@@ -180,6 +180,19 @@ class Project:
                             "telemetry.FlightRecorder._lock",
                             cls="FlightRecorder",
                             attrs=("_ring", "_n_dumps", "_n_records")),
+                # parallel/memledger: the device-memory ledger, hit by
+                # the pipeline's launch-boundary hook (gather thread),
+                # the telemetry sampler, the geometry planner and the
+                # supervisor's OOM forensics
+                SharedState("parallel/memledger.py",
+                            "memledger.MemoryLedger._lock",
+                            cls="MemoryLedger",
+                            attrs=("_active", "_measured",
+                                   "watermark_bytes",
+                                   "peak_modeled_bytes",
+                                   "safety_margin", "n_samples",
+                                   "n_oom", "_devices", "_groups",
+                                   "_compiled")),
             ),
             blocks=(
                 BlockSpec("pipeline", "PIPELINE_BLOCK_SCHEMA", (
@@ -210,6 +223,10 @@ class Project:
                 BlockSpec("halving", "HALVING_BLOCK_SCHEMA", (
                     Producer("dict-keys", "search/halving.py",
                              "_render_halving_block"),
+                )),
+                BlockSpec("memory", "MEMORY_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/memledger.py",
+                             "report_block"),
                 )),
                 BlockSpec("telemetry", "TELEMETRY_SNAPSHOT_SCHEMA", (
                     Producer("dict-keys", "obs/telemetry.py",

@@ -35,8 +35,8 @@ import sys
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
-__all__ = ["filter_tenant", "load_events", "load_vocabulary",
-           "summarize", "format_summary", "main"]
+__all__ = ["filter_tenant", "load_bundle_memory", "load_events",
+           "load_vocabulary", "summarize", "format_summary", "main"]
 
 
 def load_vocabulary():
@@ -63,6 +63,19 @@ def load_events(path: str) -> List[Dict[str, Any]]:
     if isinstance(data, dict):
         data = data.get("traceEvents", [])
     return [e for e in data if isinstance(e, dict)]
+
+
+def load_bundle_memory(path: str) -> Dict[str, Any]:
+    """The device-memory ledger section of a flight-recorder bundle
+    (``obs/telemetry.py`` stamps ``memory`` into every dump), or {}
+    for a plain Chrome trace file."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        mem = data.get("memory")
+        if isinstance(mem, dict):
+            return mem
+    return {}
 
 
 def filter_tenant(events: List[Dict[str, Any]],
@@ -227,6 +240,37 @@ def summarize(events: List[Dict[str, Any]], top: int = 12,
             store_bytes_loaded += int(args.get("bytes", 0) or 0)
         elif e.get("name") == "programstore.save":
             store_bytes_saved += int(args.get("bytes", 0) or 0)
+    # device-memory digest from the ledger's trace events: the modeled
+    # peak footprint per compile group (memory.footprint instants) and
+    # the launch-boundary allocator samples (memory.sample spans) —
+    # the per-group HBM story next to the per-launch time story
+    mem_groups: Dict[str, int] = {}
+    mem_capped: Dict[str, bool] = {}
+    mem_samples = 0
+    mem_peak_in_use = 0
+    mem_measured = False
+    for e in events:
+        name = e.get("name")
+        args = e.get("args", {}) or {}
+        if name == "memory.footprint":
+            g = str(args.get("group", "?"))
+            b = int(args.get("modeled_bytes",
+                             args.get("chunk_bytes", 0)) or 0)
+            mem_groups[g] = max(mem_groups.get(g, 0), b)
+            if args.get("capped"):
+                mem_capped[g] = True
+        elif name == "memory.sample":
+            mem_samples += 1
+            mem_peak_in_use = max(
+                mem_peak_in_use, int(args.get("bytes_in_use", 0) or 0))
+            mem_measured = mem_measured or bool(args.get("measured"))
+    memory_digest = {
+        "per_group_peak_modeled_bytes": mem_groups,
+        "capped_groups": sorted(mem_capped),
+        "n_samples": mem_samples,
+        "peak_bytes_in_use": mem_peak_in_use,
+        "measured": mem_measured,
+    }
     compile_digest = {
         "compile_wall_ms": round(compile_ms, 3),
         "store_loads": store_loads,
@@ -238,6 +282,7 @@ def summarize(events: List[Dict[str, Any]], top: int = 12,
     }
     return {
         "h2d": h2d,
+        "memory": memory_digest,
         "compile": compile_digest,
         "tenants": _tenant_rollup(spans, events),
         "unknown_names": sorted(unknown),
@@ -288,6 +333,29 @@ def format_summary(s: Dict[str, Any]) -> str:
             f"({h2d['bytes_per_launch'] / 1e6:.3f} MB per launch); "
             f"{h2d['bytes_tiled_on_device'] / 1e6:.3f} MB tiled "
             "on-device (no transfer)")
+    mem = s.get("memory") or {}
+    if mem.get("per_group_peak_modeled_bytes"):
+        per_g = mem["per_group_peak_modeled_bytes"]
+        parts = ", ".join(
+            f"g{g}={per_g[g] / 1e6:.3f} MB"
+            + ("[capped]" if g in (mem.get("capped_groups") or ()) else "")
+            for g in sorted(per_g))
+        line = f"memory: peak modeled footprint per compile group: {parts}"
+        if mem.get("measured"):
+            line += (f"; measured peak {mem['peak_bytes_in_use'] / 1e6:.3f}"
+                     f" MB over {mem['n_samples']} sample(s)")
+        elif mem.get("n_samples"):
+            line += f" ({mem['n_samples']} unmeasured sample(s))"
+        out.append(line)
+    bm = s.get("bundle_memory") or {}
+    if bm:
+        out.append(
+            "flight-bundle ledger: modeled peak "
+            f"{bm.get('modeled_peak_bytes', 0) / 1e6:.3f} MB, watermark "
+            f"{bm.get('watermark_bytes', 0) / 1e6:.3f} MB, safety margin "
+            f"{bm.get('safety_margin', 1.0)}x, "
+            f"{len(bm.get('groups') or ())} group footprint(s), "
+            f"{bm.get('n_oom_observed', 0)} OOM(s) observed")
     tenants = s.get("tenants") or {}
     if tenants:
         out.append("\nper-tenant rollup (correlation-stamped spans):")
@@ -319,10 +387,24 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the digest as JSON instead of a table")
     args = ap.parse_args(argv)
-    events = load_events(args.trace)
+    # one parse serves both the trace slice and the bundle's ledger
+    # section — flight bundles can be tens of MB and must not be
+    # json.load'ed twice
+    with open(args.trace) as f:
+        data = json.load(f)
+    bundle_mem: Dict[str, Any] = {}
+    if isinstance(data, dict):
+        # flight-recorder bundles carry the device-memory ledger
+        # snapshot next to their trace slice: digest it alongside
+        if isinstance(data.get("memory"), dict):
+            bundle_mem = data["memory"]
+        data = data.get("traceEvents", [])
+    events = [e for e in data if isinstance(e, dict)]
     if args.tenant:
         events = filter_tenant(events, args.tenant)
     s = summarize(events, top=args.top)
+    if bundle_mem:
+        s["bundle_memory"] = bundle_mem
     try:
         if args.json:
             print(json.dumps(s, indent=2))
