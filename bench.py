@@ -1103,7 +1103,18 @@ def run_child(platform):
         # an unparseable module, which must not kill the bench payload
         detail["sstlint_gate_error"] = repr(exc)[:300]
 
+    # the cross-round trend digest (tools/bench_trend.py) over the
+    # BENCH_rNN.json history already in the repo root, so each payload
+    # carries its own before/after comparison context
+    try:
+        from tools.bench_trend import trend as _bench_trend
+        detail["bench_trend"] = _bench_trend(
+            os.path.dirname(os.path.abspath(__file__)))
+    except Exception as exc:  # noqa: BLE001 — bookkeeping only
+        detail["bench_trend_error"] = repr(exc)[:300]
+
     label = "TPU" if on_tpu else "CPU-fallback"
+    from spark_sklearn_tpu.obs.provenance import provenance_block
     payload = {
         "metric": f"GridSearchCV {n_candidates}x5 LogReg digits — "
                   f"fits/sec on {label} "
@@ -1112,6 +1123,10 @@ def run_child(platform):
         "unit": "fits/sec",
         "vs_baseline": round(vs_baseline, 2),
         "platform": real_platform if on_tpu else "cpu-fallback",
+        # the shared env-fingerprint stamp (obs/provenance.py) — the
+        # same block the flight recorder and the run log record, so
+        # artifacts from one box correlate by env_digest
+        "provenance": provenance_block(),
         "detail": detail,
     }
     if not on_tpu:

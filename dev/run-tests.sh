@@ -31,7 +31,8 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 # acquisition-order inversion
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
-    tests/test_halving.py tests/test_memory.py tests/test_sstlint.py -q
+    tests/test_halving.py tests/test_memory.py tests/test_sstlint.py \
+    tests/test_doctor.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -453,6 +454,69 @@ print("fault smoke:", {k: f[k] for k in
                        ("retries", "bisections", "host_fallbacks",
                         "timeouts", "injected")})
 PY
+
+echo "== search-doctor smoke (attribution + cross-run sentinel) =="
+RUNLOG_DIR=$(mktemp -d /tmp/sst_doctor_smoke_XXXX)
+JAX_PLATFORMS=cpu SST_RUNLOG_DIR="$RUNLOG_DIR" python - <<'PY'
+import json
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+# 40 candidates: wide enough that the fused path AOT-precompiles on
+# the compile thread, so the cold run's build is span-attributed
+grid = {"C": np.logspace(-2, 1, 40).tolist()}
+
+
+def run(**kw):
+    cfg = sst.TpuConfig(trace=True, **kw)
+    return sst.GridSearchCV(LogisticRegression(max_iter=10), grid,
+                            cv=2, refit=False, backend="tpu",
+                            config=cfg).fit(X, y)
+
+
+# two identical traced runs against one run-log store: the first has
+# no baseline, the second compares clean — and the lanes sum to the
+# wall exactly both times
+first, second = run(), run()
+for gs in (first, second):
+    attr = gs.search_report["attribution"]
+    lanes = ("compile_s", "stage_s", "compute_s", "gather_s",
+             "queue_wait_s", "fault_s", "padding_s", "narrowing_s",
+             "other_s")
+    assert abs(sum(attr[k] for k in lanes) - attr["wall_s"]) < 1e-5, attr
+    assert attr["compile_source"] == "traced" and attr["verdict"], attr
+assert first.search_report["attribution"]["n_compiles"] > 0
+a1 = first.search_report["attribution"]["regression"]
+a2 = second.search_report["attribution"]["regression"]
+assert a1["status"] == "no-baseline", a1
+assert a2["status"] == "none", a2
+# an injected transient fault shows up as a nonzero fault lane
+faulty = run(fault_plan="transient@2", retry_backoff_s=0.05)
+fa = faulty.search_report["attribution"]
+assert fa["fault_s"] > 0, fa
+with open(os.path.join(os.environ["SST_RUNLOG_DIR"],
+                       "report.json"), "w") as f:
+    json.dump(second.search_report, f, default=str)
+print("doctor smoke:", second.search_report["attribution"]["verdict"],
+      "| fault lane:", fa["fault_s"])
+PY
+# the offline doctor reproduces the verdict from the saved report and
+# exits 0 (no flagged regression)
+JAX_PLATFORMS=cpu python tools/sst_doctor.py "$RUNLOG_DIR/report.json" \
+    | grep -q "regression: none"
+rm -rf "$RUNLOG_DIR"
+
+echo "== bench-trend leg (cross-round regression gate) =="
+# tabulates the repo's BENCH_rNN.json history; exits nonzero when the
+# last two parsed rounds regressed beyond the (generous) threshold
+python tools/bench_trend.py
 
 echo "== vendored upstream sklearn suite =="
 # explicit path: the vendored file keeps upstream's name under a

@@ -233,6 +233,27 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
                mtype="counter",
                help_text="Recovery actions by kind "
                          "(retry/bisect/host_fallback/...).")
+    reg = snap.get("regression") or {}
+    ln.add("sst_regression_checks_total", reg.get("checks_total"),
+           mtype="counter",
+           help_text="Runs the cross-run sentinel compared against a "
+                     "run-log baseline.")
+    ln.add("sst_regression_flagged_total", reg.get("flagged_total"),
+           mtype="counter",
+           help_text="Runs the sentinel flagged as regressed.")
+    ln.add("sst_regression_active",
+           1 if reg.get("last_status") == "regressed" else
+           (0 if reg.get("last_status") else None),
+           help_text="1 while the most recent sentinel check flagged a "
+                     "regression.")
+    for f in (reg.get("last_flags") or []):
+        if not isinstance(f, dict):
+            continue
+        ln.add("sst_regression_delta_seconds", f.get("delta_s"),
+               labels={"metric": f.get("metric", ""),
+                       "family": reg.get("last_family", "")},
+               help_text="Per-lane wall regression vs the run-log "
+                         "baseline, from the last flagged check.")
     flight = snap.get("flight") or {}
     ln.add("sst_flight_records_total", flight.get("n_records"),
            mtype="counter",

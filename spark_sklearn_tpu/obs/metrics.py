@@ -35,6 +35,7 @@ __all__ = [
     "SCHEDULER_BLOCK_SCHEMA",
     "HALVING_BLOCK_SCHEMA",
     "MEMORY_BLOCK_SCHEMA",
+    "ATTRIBUTION_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
@@ -169,6 +170,17 @@ SEARCH_REPORT_SCHEMA = (
         "TpuConfig(memory_ledger=False) — the byte-identical "
         "pre-ledger report shape."),
     MetricDef(
+        "attribution", "struct",
+        "The search doctor's critical-path decomposition (see the "
+        "attribution-block schema below): the measured search wall "
+        "split into pinned causes (compile/stage/compute/gather/"
+        "queue wait/faults/padding/memory-cap narrowing), a one-line "
+        "verdict, per-rung lanes for halving searches and the "
+        "regression sentinel's judgment against the run log's "
+        "baseline (obs/attribution.py).  Absent when "
+        "TpuConfig(attribution=False) — the byte-identical "
+        "pre-doctor report shape."),
+    MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
         backends="host"),
@@ -223,12 +235,18 @@ PIPELINE_BLOCK_SCHEMA = (
               "Total host->device bytes the launches' stage phases "
               "transferred (data-plane accounting; cache hits "
               "transfer nothing and count zero)."),
+    MetricDef("epoch_s", "gauge",
+              "The run epoch: perf_counter timestamp of the first "
+              "run() call — per-launch t0_s/t1_s (and tracer spans) "
+              "are in this timebase."),
     MetricDef("launches", "series",
               "One record per launch: key, group, kind "
               "(fit/score/calibrate/fused), n_tasks, stage_bytes "
-              "(host->device transfer during its stage) and per-phase "
+              "(host->device transfer during its stage), per-phase "
               "walls (stage_s/stage_wait_s/dispatch_s/compute_s/"
-              "gather_s/finalize_s)."),
+              "gather_s/finalize_s) and the launch's t0_s/t1_s "
+              "window relative to the pipeline's run epoch (what the "
+              "attribution analyzer slices per halving rung)."),
 )
 
 #: sub-keys of ``search_report["dataplane"]`` (written by
@@ -476,10 +494,13 @@ HALVING_BLOCK_SCHEMA = (
               "One record per rung: iter, n_candidates, n_resources, "
               "wall_s, widths (per compile group), "
               "n_launches_planned, n_chunks_resumed, "
-              "lanes_reclaimed, padding_saved_frac, pipe_wall_s and "
+              "lanes_reclaimed, padding_saved_frac, pipe_wall_s, "
               "cost_observations (the geometry cost model's "
               "observation count when the rung planned — increasing "
-              "across rungs proves mid-search feedback)."),
+              "across rungs proves mid-search feedback) and "
+              "launches_end (the rung's end boundary in the shared "
+              "pipeline's cumulative launch timeline, consumed by "
+              "the attribution analyzer's per-rung slicing)."),
 )
 
 
@@ -536,6 +557,83 @@ MEMORY_BLOCK_SCHEMA = (
 )
 
 
+#: sub-keys of ``search_report["attribution"]`` (written by
+#: ``obs.attribution.attribution_block``) — the search doctor's
+#: critical-path decomposition.  The lane gauges are mutually
+#: exclusive seconds that sum to ``wall_s`` exactly (the analyzer
+#: normalizes), so every second of a slow search is charged to one
+#: pinned cause.
+ATTRIBUTION_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Always True when present: the block only renders when "
+              "the doctor is on (TpuConfig.attribution, default "
+              "True); disabled, the report is byte-identical to the "
+              "pre-doctor shape."),
+    MetricDef("wall_s", "gauge",
+              "The measured search wall the lanes decompose (timed "
+              "around the whole candidate loop, so it includes host "
+              "orchestration the pipeline never sees)."),
+    MetricDef("compile_s", "gauge",
+              "Seconds charged to traced-program construction: "
+              "summed 'compile' span walls when the search was "
+              "traced, else n_compiles x the geometry cost model's "
+              "compile_wall_s estimate."),
+    MetricDef("stage_s", "gauge",
+              "Seconds charged to host->device staging (h2d "
+              "transfer) that was not hidden behind device compute."),
+    MetricDef("compute_s", "gauge",
+              "Seconds charged to useful device compute (padding, "
+              "fault recovery and queue wait are carved out into "
+              "their own lanes)."),
+    MetricDef("gather_s", "gauge",
+              "Seconds charged to blocking device->host result "
+              "transfer."),
+    MetricDef("queue_wait_s", "gauge",
+              "Seconds charged to multi-tenant fair-share queue "
+              "contention (serve/executor.py)."),
+    MetricDef("fault_s", "gauge",
+              "Seconds charged to fault recovery: retry backoff, "
+              "OOM bisection relaunches and host fallbacks (summed "
+              "from the recovery spans)."),
+    MetricDef("padding_s", "gauge",
+              "Seconds of device compute charged to padded lanes "
+              "(chunk tails repeated to the group's uniform width) — "
+              "compute that produced no new result."),
+    MetricDef("narrowing_s", "gauge",
+              "Modeled seconds of extra launch overhead caused by "
+              "the HBM ceiling capping planned chunk widths "
+              "(memory-block groups with capped=True)."),
+    MetricDef("other_s", "gauge",
+              "The wall remainder: host orchestration (chunk prep, "
+              "result writes, sklearn bookkeeping) outside the "
+              "pipeline's per-launch timeline."),
+    MetricDef("compile_source", "label",
+              "Where compile_s came from: 'traced' (compile spans in "
+              "the tracer buffer) or 'modeled' (cost-model "
+              "estimate)."),
+    MetricDef("n_compiles", "gauge",
+              "Distinct traced-program constructions the pipeline "
+              "counted — the divisor behind the compile verdict."),
+    MetricDef("dominant", "label",
+              "The lane with the largest share of wall_s (its name "
+              "minus the _s suffix) — what the verdict leads with."),
+    MetricDef("verdict", "label",
+              "The one-line human judgment: dominant cause, its "
+              "share, and the remedy the lane implies (e.g. "
+              "'compile-bound: 61% of wall in 9 traced builds; a "
+              "prewarmed program store would recover ~5.2s')."),
+    MetricDef("rungs", "series",
+              "Halving searches only: one record per rung — iter, "
+              "wall_s and the same lane decomposition computed over "
+              "the rung's slice of the launch timeline."),
+    MetricDef("regression", "struct",
+              "The sentinel's judgment against the run log's stored "
+              "baseline: status (none/regressed/no-baseline/off), "
+              "the baseline's ts/wall and per-lane deltas that "
+              "breached the noise band (obs/runlog.py)."),
+)
+
+
 #: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
 #: telemetry service's JSON view (``obs/telemetry.py``), also served
 #: as ``/snapshot.json`` (and rendered to Prometheus text) by the
@@ -585,6 +683,12 @@ TELEMETRY_SNAPSHOT_SCHEMA = (
     MetricDef("faults", "struct",
               "Observed fault totals by taxonomy class and recovery "
               "action (fed by the launch supervisor's event hook)."),
+    MetricDef("regression", "struct",
+              "The cross-run regression sentinel's latest judgment "
+              "(obs/runlog.py): checks/flagged totals, the last "
+              "run's status and the lanes that breached the noise "
+              "band — also rendered as the sst_regression_* "
+              "Prometheus family."),
     MetricDef("flight", "struct",
               "Flight-recorder state: records seen, ring occupancy, "
               "black-box bundles dumped."),
@@ -813,6 +917,15 @@ def schema_markdown() -> str:
         "`parallel/memledger.py`).\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in MEMORY_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"attribution\"]` block\n")
+    out.append(
+        "\nPresent when the search doctor is on "
+        "(`TpuConfig.attribution`, default True; "
+        "`obs/attribution.py`).  The lane gauges sum to `wall_s` "
+        "exactly.\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in ATTRIBUTION_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
                "endpoint schema\n")

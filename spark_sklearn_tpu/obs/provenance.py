@@ -1,0 +1,95 @@
+"""Shared provenance stamp — ONE helper every persisted artifact uses.
+
+Bench payloads (``bench.py``), flight bundles (``obs/telemetry.py``)
+and run-log records (``obs/runlog.py``) all persist outside the
+process that produced them, and a postmortem comparing two of them
+must know whether they came from the same world.  Before this module
+each writer rolled its own fingerprint (or none: bench payloads
+carried no environment identity at all, so BENCH files from different
+machines compared apples-to-oranges silently).  Now the fingerprint,
+the stable environment digest and the repo version string are built
+here and stamped everywhere via :func:`provenance_block`.
+
+The module stays import-light: jax is imported lazily and every
+failure degrades to a partial fingerprint — provenance must never be
+the reason an artifact failed to write.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+from typing import Any, Dict
+
+__all__ = [
+    "env_digest",
+    "env_fingerprint",
+    "provenance_block",
+    "repo_version",
+]
+
+#: bump when the fingerprint's key set changes incompatibly — digests
+#: from different formats must never collide into one runlog baseline
+PROVENANCE_FORMAT = 1
+
+
+def repo_version() -> str:
+    """The package version string (``"?"`` when unimportable)."""
+    try:
+        import spark_sklearn_tpu
+
+        return str(getattr(spark_sklearn_tpu, "__version__", "?"))
+    except ImportError:
+        return "?"
+
+
+def env_fingerprint(include_pid: bool = True) -> Dict[str, Any]:
+    """Versions/platform/device-fleet identity of this process.
+
+    ``include_pid=False`` drops the per-process ``pid`` key, leaving
+    only fields stable across runs of the same environment — the
+    subset :func:`env_digest` hashes so run-log baselines match
+    across processes.
+    """
+    import platform
+
+    info: Dict[str, Any] = {
+        "python": platform.python_version(),
+        "platform": sys.platform,
+    }
+    if include_pid:
+        info["pid"] = os.getpid()
+    try:
+        import jax
+        import jaxlib
+
+        info["jax"] = jax.__version__
+        info["jaxlib"] = jaxlib.__version__
+        info["backend"] = jax.default_backend()
+        info["n_devices"] = len(jax.devices())
+    except (ImportError, AttributeError, RuntimeError):
+        # a stamp from a jax-less/uninitializable context still records
+        # the host identity above
+        pass
+    info["spark_sklearn_tpu"] = repo_version()
+    return info
+
+
+def env_digest(hexchars: int = 12) -> str:
+    """Stable digest of the pid-less fingerprint — the key run-log
+    directories (and baseline lookups) are partitioned by."""
+    fp = env_fingerprint(include_pid=False)
+    blob = repr(tuple(sorted(fp.items()))).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()[:hexchars]
+
+
+def provenance_block() -> Dict[str, Any]:
+    """The stamp persisted artifacts carry: fingerprint + stable
+    digest + version, under one pinned shape."""
+    return {
+        "provenance_format": PROVENANCE_FORMAT,
+        "env": env_fingerprint(),
+        "env_digest": env_digest(),
+        "version": repo_version(),
+    }

@@ -1,0 +1,431 @@
+"""Persistent run history + the cross-run regression sentinel.
+
+The attribution analyzer (``obs/attribution.py``) judges ONE run; this
+module remembers what "normal" looks like.  :class:`RunLog` is a
+ProgramStore-style persistent store (``parallel/programstore.py``):
+records live under a directory versioned by run-log format and the
+stable environment digest (``obs/provenance.py``), every append is an
+atomic checksummed write (tmp + fsync + ``os.replace`` via
+``utils/atomic.py``; a torn or bit-rotted record is skipped, never a
+failed search), and the store is byte-budgeted with oldest-first
+pruning.  Each record carries the search's attribution block, launch
+geometry, compile count, cost-model state and provenance stamp,
+keyed by ``(estimator family, compile-structure digest)`` — the same
+identity the program store uses, so "the same search" means the same
+compiled structure, not merely the same estimator class.
+
+The **regression sentinel** compares each new run's attribution lanes
+(wall / compile / queue wait / padding) against the newest stored
+baseline for its key: a lane that grew beyond the noise band
+(``TpuConfig.runlog_noise_frac``, plus an absolute floor so
+microsecond jitter never pages anyone) flags a regression into the
+search report (``attribution["regression"]``), the fleet-telemetry
+snapshot (``regression`` block, ``sst_regression_*`` on ``/metrics``)
+and a flight-style sentinel bundle (``obs/telemetry.FlightRecorder``)
+that ``tools/sst_doctor.py`` digests post-mortem.
+
+``TpuConfig(runlog=False)`` — or simply no configured directory
+(``runlog_dir`` / ``SST_RUNLOG_DIR``) — is an exact no-op: no store,
+no records, byte-identical reports.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from spark_sklearn_tpu.obs import provenance as _provenance
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.utils.atomic import atomic_write as _atomic_write
+from spark_sklearn_tpu.utils.locks import named_lock
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "DEFAULT_NOISE_FRAC",
+    "DEFAULT_RUNLOG_BUDGET",
+    "RUNLOG_FORMAT",
+    "RunLog",
+    "activate_runlog",
+    "active_runlog",
+    "compare_to_baseline",
+    "deactivate_runlog",
+    "note_run",
+    "structure_digest",
+]
+
+#: on-disk format version: bump when the record layout changes — old
+#: run logs become clean no-baseline lookups, never parse errors.
+RUNLOG_FORMAT = 1
+
+#: default store byte budget (32 MiB): thousands of bench-scale run
+#: records; oldest records prune beyond it.
+DEFAULT_RUNLOG_BUDGET = 32 * 2 ** 20
+
+#: default relative noise band: a lane must grow beyond baseline x
+#: (1 + frac) before the sentinel flags it.
+DEFAULT_NOISE_FRAC = 0.25
+
+#: absolute floor (seconds) under the relative band: sub-50ms growth
+#: is timer jitter at bench scale, never a regression.
+_ABS_FLOOR_S = 0.05
+
+#: the attribution lanes the sentinel watches (ISSUE: wall / compile /
+#: queue wait / padding)
+_SENTINEL_LANES = ("wall_s", "compile_s", "queue_wait_s", "padding_s")
+
+_SUFFIX = ".json"
+
+
+def _slug(s: str, n: int = 40) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in str(s))[:n]
+
+
+def structure_digest(*parts: Any) -> str:
+    """Stable digest of a search's structural identity (family,
+    estimator class, candidate/fold counts, data shape, dtype) — the
+    second half of a run record's baseline key."""
+    h = hashlib.blake2b(repr(tuple(parts)).encode(), digest_size=8)
+    return h.hexdigest()
+
+
+class RunLog:
+    """Byte-budgeted on-disk history of per-search run records.
+
+    Layout::
+
+        <directory>/v<RUNLOG_FORMAT>/<env_digest>/run-*.json
+
+    Records from other jax versions / device fleets live under other
+    ``env_digest`` directories, so a baseline can never be compared
+    across environments.  Thread-safe: concurrent searches submitted
+    to one session all append at fit end.
+    """
+
+    def __init__(self, directory: str,
+                 byte_budget: int = DEFAULT_RUNLOG_BUDGET,
+                 noise_frac: float = DEFAULT_NOISE_FRAC):
+        self.directory = os.path.abspath(directory)
+        self.env = _provenance.env_fingerprint(include_pid=False)
+        self.env_digest = _provenance.env_digest()
+        self.byte_budget = int(byte_budget)
+        self.noise_frac = float(noise_frac)
+        self._dir = os.path.join(
+            self.directory, f"v{RUNLOG_FORMAT}", self.env_digest)
+        os.makedirs(self._dir, exist_ok=True)
+        self._lock = named_lock("runlog.RunLog._lock")
+        self._seq = 0
+        self._counts = {"appends": 0, "corrupt": 0, "evictions": 0,
+                        "checks": 0, "flagged": 0}
+
+    # -- naming ------------------------------------------------------------
+    @staticmethod
+    def key(family: str, structure_digest: str) -> str:
+        return f"run-{_slug(family)}-{_slug(structure_digest, 16)}"
+
+    def path_for(self, name: str) -> str:
+        return os.path.join(self._dir, name)
+
+    # -- record IO ---------------------------------------------------------
+    def append(self, family: str, structure_digest: str,
+               record: Dict[str, Any]) -> Optional[str]:
+        """Atomically persist one run record and return its path (or
+        None on failure — history is an optimization, never a failed
+        search).  The payload is checksummed so a torn write is
+        detected at read time, and the store is pruned back under its
+        byte budget afterwards."""
+        payload = json.dumps(record, sort_keys=True, default=str)
+        doc = {
+            "runlog_format": RUNLOG_FORMAT,
+            "family": str(family),
+            "structure_digest": str(structure_digest),
+            "payload_sha256": hashlib.sha256(payload.encode()).hexdigest(),
+            "record": json.loads(payload),
+        }
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._counts["appends"] += 1
+        name = (f"{self.key(family, structure_digest)}"
+                f"-{os.getpid()}-{seq:04d}{_SUFFIX}")
+        path = self.path_for(name)
+        try:
+            _atomic_write(path, json.dumps(doc).encode())
+        except (OSError, TypeError, ValueError) as exc:
+            logger.warning("run log: append failed for %s (%r)",
+                           name, exc)
+            return None
+        self._evict_over_budget(keep=name)
+        return path
+
+    def _read_record(self, path: str) -> Optional[Dict[str, Any]]:
+        """One verified record document, or None (mismatched format or
+        failed checksum — a clean skip either way)."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            with self._lock:
+                self._counts["corrupt"] += 1
+            return None
+        if doc.get("runlog_format") != RUNLOG_FORMAT:
+            return None
+        payload = json.dumps(doc.get("record", {}), sort_keys=True,
+                             default=str)
+        sha = hashlib.sha256(payload.encode()).hexdigest()
+        if sha != doc.get("payload_sha256"):
+            with self._lock:
+                self._counts["corrupt"] += 1
+            return None
+        return doc
+
+    def records(self, family: Optional[str] = None,
+                structure_digest: Optional[str] = None,
+                ) -> List[Dict[str, Any]]:
+        """Verified record documents (newest first), optionally
+        filtered to one ``(family, structure digest)`` key."""
+        prefix = None
+        if family is not None and structure_digest is not None:
+            prefix = self.key(family, structure_digest)
+        entries = []
+        try:
+            for fn in os.listdir(self._dir):
+                if not fn.endswith(_SUFFIX):
+                    continue
+                if prefix is not None and not fn.startswith(prefix):
+                    continue
+                st = os.stat(os.path.join(self._dir, fn))
+                entries.append((st.st_mtime, fn))
+        except OSError:
+            return []
+        out = []
+        for _, fn in sorted(entries, reverse=True):
+            doc = self._read_record(self.path_for(fn))
+            if doc is not None:
+                out.append(doc)
+        return out
+
+    def baseline(self, family: str,
+                 structure_digest: str) -> Optional[Dict[str, Any]]:
+        """The newest verified record for this key — what the sentinel
+        compares a fresh run against."""
+        docs = self.records(family, structure_digest)
+        return docs[0]["record"] if docs else None
+
+    # -- pruning -----------------------------------------------------------
+    def _evict_over_budget(self, keep: Optional[str] = None) -> None:
+        try:
+            entries = []
+            for fn in os.listdir(self._dir):
+                if not fn.endswith(_SUFFIX):
+                    continue
+                st = os.stat(os.path.join(self._dir, fn))
+                entries.append((st.st_mtime, st.st_size, fn))
+            total = sum(e[1] for e in entries)
+            entries.sort()
+            evicted = 0
+            for _mtime, size, fn in entries:
+                if total <= self.byte_budget or fn == keep:
+                    continue
+                os.remove(self.path_for(fn))
+                total -= size
+                evicted += 1
+            if evicted:
+                with self._lock:
+                    self._counts["evictions"] += evicted
+        except OSError as exc:
+            logger.debug("run log eviction scan failed: %r", exc)
+
+    # -- stats -------------------------------------------------------------
+    def note_check(self, flagged: bool) -> None:
+        """Count one sentinel comparison (and whether it flagged)."""
+        with self._lock:
+            self._counts["checks"] += 1
+            if flagged:
+                self._counts["flagged"] += 1
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def disk_stats(self) -> Dict[str, int]:
+        n = total = 0
+        try:
+            for fn in os.listdir(self._dir):
+                if fn.endswith(_SUFFIX):
+                    n += 1
+                    total += os.stat(self.path_for(fn)).st_size
+        except OSError:
+            pass
+        return {"n_records": n, "log_bytes": total}
+
+
+# ---------------------------------------------------------------------------
+# the sentinel comparison
+# ---------------------------------------------------------------------------
+
+
+def compare_to_baseline(baseline: Optional[Dict[str, Any]],
+                        attribution: Dict[str, Any],
+                        noise_frac: float = DEFAULT_NOISE_FRAC,
+                        ) -> Dict[str, Any]:
+    """The ``attribution["regression"]`` struct: this run's watched
+    lanes vs the stored baseline's, flagged when a lane grew beyond
+    ``baseline x (1 + noise_frac)`` AND by more than the absolute
+    floor.  Deterministic and stdlib-pure so tests (and the doctor)
+    can re-judge a saved pair of records."""
+    if baseline is None:
+        return {"status": "no-baseline", "noise_frac": round(
+            float(noise_frac), 6), "flags": []}
+    base_attr = baseline.get("attribution") or {}
+    flags: List[Dict[str, Any]] = []
+    for lane in _SENTINEL_LANES:
+        base = float(base_attr.get(lane, 0.0) or 0.0)
+        cur = float(attribution.get(lane, 0.0) or 0.0)
+        delta = cur - base
+        band = max(noise_frac * base, _ABS_FLOOR_S)
+        if delta > band:
+            flags.append({
+                "metric": lane,
+                "baseline_s": round(base, 6),
+                "current_s": round(cur, 6),
+                "delta_s": round(delta, 6),
+                "ratio": round(cur / base, 4) if base > 0 else 0.0,
+            })
+    return {
+        "status": "regressed" if flags else "none",
+        "baseline_ts_unix_s": float(baseline.get("ts_unix_s", 0.0)),
+        "baseline_wall_s": round(float(
+            base_attr.get("wall_s", 0.0) or 0.0), 6),
+        "noise_frac": round(float(noise_frac), 6),
+        "flags": flags,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Process-global activation (mirrors programstore.activate_store)
+# ---------------------------------------------------------------------------
+
+_RUNLOG: Optional[RunLog] = None
+_RUNLOG_LOCK = named_lock("runlog._RUNLOG_LOCK")
+
+
+def _resolve_dir(config) -> Optional[str]:
+    if config is not None and not getattr(config, "runlog", True):
+        return None
+    d = getattr(config, "runlog_dir", None) if config is not None \
+        else None
+    if not d:
+        d = os.environ.get("SST_RUNLOG_DIR", "").strip() or None
+    return d
+
+
+def _resolve_budget(config) -> int:
+    b = getattr(config, "runlog_bytes", None) if config is not None \
+        else None
+    if b is None:
+        env = os.environ.get("SST_RUNLOG_BYTES", "").strip()
+        if env:
+            # a typo'd budget fails loudly at activation, not mid-search
+            b = int(env)
+    return DEFAULT_RUNLOG_BUDGET if b is None else int(b)
+
+
+def activate_runlog(config=None) -> Optional[RunLog]:
+    """The run log a search/session should use under ``config`` — or
+    ``None`` when disabled (``TpuConfig.runlog=False``), no directory
+    is configured (``TpuConfig.runlog_dir`` / ``SST_RUNLOG_DIR``), or
+    the byte budget disables it."""
+    directory = _resolve_dir(config)
+    if not directory:
+        return None
+    budget = _resolve_budget(config)
+    if budget <= 0:
+        return None
+    noise = float(getattr(config, "runlog_noise_frac",
+                          DEFAULT_NOISE_FRAC) or DEFAULT_NOISE_FRAC) \
+        if config is not None else DEFAULT_NOISE_FRAC
+    global _RUNLOG
+    with _RUNLOG_LOCK:
+        if _RUNLOG is None or \
+                _RUNLOG.directory != os.path.abspath(directory):
+            _RUNLOG = RunLog(directory, budget, noise_frac=noise)
+        else:
+            _RUNLOG.byte_budget = int(budget)
+            _RUNLOG.noise_frac = noise
+        return _RUNLOG
+
+
+def active_runlog() -> Optional[RunLog]:
+    """The currently active run log (``None`` when never activated)."""
+    with _RUNLOG_LOCK:
+        return _RUNLOG
+
+
+def deactivate_runlog() -> None:
+    """Drop the process-global run log (tests; a later
+    :func:`activate_runlog` builds a fresh one)."""
+    global _RUNLOG
+    with _RUNLOG_LOCK:
+        _RUNLOG = None
+
+
+# ---------------------------------------------------------------------------
+# fit-end orchestration — record + judge, called by the search engine
+# ---------------------------------------------------------------------------
+
+
+def note_run(report: Dict[str, Any], family: str,
+             structure_digest: str, config=None) -> None:
+    """Record this search into the run log and run the sentinel.
+
+    Mutates ``report["attribution"]["regression"]`` in place (the
+    block is already rendered into the registry), feeds the telemetry
+    aggregator, and on a flagged regression dumps a flight-style
+    sentinel bundle.  Exact no-op when no run log resolves — the
+    report keeps the sentinel-off placeholder."""
+    attribution = report.get("attribution")
+    if not attribution:
+        return
+    log = activate_runlog(config)
+    if log is None:
+        return
+    baseline = log.baseline(family, structure_digest)
+    regression = compare_to_baseline(
+        baseline, attribution, noise_frac=log.noise_frac)
+    attribution["regression"] = regression
+    log.note_check(regression["status"] == "regressed")
+    pipe = report.get("pipeline") or {}
+    geometry = report.get("geometry") or {}
+    record = {
+        "ts_unix_s": time.time(),
+        "family": str(family),
+        "structure_digest": str(structure_digest),
+        "provenance": _provenance.provenance_block(),
+        "attribution": {k: v for k, v in attribution.items()
+                        if k != "regression"},
+        "geometry": geometry,
+        "n_compiles": int(pipe.get("n_compiles", 0) or 0),
+        "cost_model": geometry.get("cost_model") or {},
+        "regression_status": regression["status"],
+    }
+    log.append(family, structure_digest, record)
+    from spark_sklearn_tpu.obs import telemetry as _telemetry
+    _telemetry.note_regression(regression["status"], str(family),
+                               regression["flags"])
+    if regression["status"] == "regressed":
+        logger.warning(
+            "regression sentinel: %s/%s regressed vs baseline "
+            "(%d lane(s) beyond the %.0f%% band)",
+            family, structure_digest, len(regression["flags"]),
+            100.0 * log.noise_frac)
+        _telemetry.flight_recorder().dump(
+            f"regression-{family}", config=config,
+            context={"regression": regression,
+                     "verdict": attribution.get("verdict", ""),
+                     "family": str(family),
+                     "structure_digest": str(structure_digest)})

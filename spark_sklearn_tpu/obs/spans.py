@@ -64,6 +64,13 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "Mid-search geometry re-plan of a halving rung's "
             "surviving candidates (lane reclamation; carries iter and "
             "whether replanning was on)."),
+    SpanDef("doctor.analyze", "span", "search.grid",
+            "Post-fit critical-path attribution: decomposing the "
+            "search wall into lanes (compile, stage, compute, gather, "
+            "queue wait, faults, padding, narrowing)."),
+    SpanDef("doctor.sentinel", "span", "search.grid",
+            "Cross-run regression check of the attribution block "
+            "against the persistent run-log baseline."),
     # search/halving.py
     SpanDef("halving.rung", "span", "search.halving",
             "One successive-halving rung: fit + score of the "

@@ -55,7 +55,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import sys
 import threading
 import time
 from collections import deque
@@ -169,34 +168,18 @@ def resolve_flight_dir(config=None) -> Optional[str]:
 
 def _env_fingerprint() -> Dict[str, Any]:
     """Versions/platform/device-fleet identity stamped into every
-    bundle, so a postmortem knows exactly which world produced it."""
-    import platform
+    bundle, so a postmortem knows exactly which world produced it —
+    the ONE shared stamp (``obs/provenance.py``) bench payloads and
+    run-log records carry too."""
+    from spark_sklearn_tpu.obs.provenance import env_fingerprint
 
-    info: Dict[str, Any] = {
-        "python": platform.python_version(),
-        "platform": sys.platform,
-        "pid": os.getpid(),
-    }
-    try:
-        import jax
-        import jaxlib
+    return env_fingerprint()
 
-        info["jax"] = jax.__version__
-        info["jaxlib"] = jaxlib.__version__
-        info["backend"] = jax.default_backend()
-        info["n_devices"] = len(jax.devices())
-    except (ImportError, AttributeError, RuntimeError):
-        # a bundle from a jax-less/uninitializable context still records
-        # the host identity above
-        pass
-    try:
-        import spark_sklearn_tpu
 
-        info["spark_sklearn_tpu"] = getattr(
-            spark_sklearn_tpu, "__version__", "?")
-    except ImportError:
-        pass
-    return info
+def _provenance_block() -> Dict[str, Any]:
+    from spark_sklearn_tpu.obs.provenance import provenance_block
+
+    return provenance_block()
 
 
 def _config_jsonable(config) -> Dict[str, Any]:
@@ -297,6 +280,11 @@ class FlightRecorder:
             "correlation": dict(corr),
             "context": dict(context or {}),
             "env": _env_fingerprint(),
+            # the shared stamp (obs/provenance.py): fingerprint +
+            # env_digest + repo version, the same block bench payloads
+            # and run-log records carry, so cross-artifact correlation
+            # is a digest comparison
+            "provenance": _provenance_block(),
             "config": _config_jsonable(config),
             "scheduler": dict(scheduler or {}),
             "faults": dict(faults or {}),
@@ -356,6 +344,12 @@ class _TenantStats:
         self.costs = RollingWindow(window_s)     # dispatched task units
 
 
+def _zero_regression() -> Dict[str, Any]:
+    """The regression block's zeroed shape (no comparisons yet)."""
+    return {"checks_total": 0, "flagged_total": 0, "last_status": "",
+            "last_family": "", "last_flags": []}
+
+
 class TelemetryService:
     """The process-global aggregator behind the fleet endpoint.
 
@@ -392,6 +386,10 @@ class TelemetryService:
         self._h2d = {"bytes_total": 0, "uploads_total": 0}
         self._h2d_window = RollingWindow(window_s)
         self._ps_events: Dict[str, int] = {}
+        #: the regression sentinel's running view (obs/runlog.py):
+        #: comparisons performed, regressions flagged, and the last
+        #: judgment's status/family/flagged-lane list
+        self._regression: Dict[str, Any] = _zero_regression()
         #: provider name -> STACK of zero-arg callables returning a
         #: JSON-able dict; the newest registration is polled, and
         #: unregistering it restores the previous one — so two
@@ -501,6 +499,7 @@ class TelemetryService:
             self._h2d = {"bytes_total": 0, "uploads_total": 0}
             self._h2d_window = RollingWindow(self.window_s)
             self._ps_events.clear()
+            self._regression = _zero_regression()
             self._polls.clear()
             self._n_samples = 0
 
@@ -639,6 +638,22 @@ class TelemetryService:
         with self._lock:
             self._ps_events[event] = self._ps_events.get(event, 0) + 1
 
+    def note_regression(self, status: str, family: str,
+                        flags: Optional[List[Dict[str, Any]]] = None,
+                        ) -> None:
+        """Regression-sentinel feed (obs/runlog.py): one baseline
+        comparison's judgment at fit end."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._regression["checks_total"] += 1
+            if status == "regressed":
+                self._regression["flagged_total"] += 1
+            self._regression["last_status"] = str(status)
+            self._regression["last_family"] = str(family)
+            self._regression["last_flags"] = [
+                dict(f) for f in (flags or [])]
+
     # -- snapshot --------------------------------------------------------
     def _tenant_block(self, now: float) -> Dict[str, Any]:
         total_window_cost = sum(
@@ -755,6 +770,13 @@ class TelemetryService:
             "by_action": dict(sorted(self._faults_by_action.items())),
         }
 
+    def _regression_block(self) -> Dict[str, Any]:
+        with self._lock:
+            block = dict(self._regression)
+            block["last_flags"] = [dict(f)
+                                   for f in block["last_flags"]]
+            return block
+
     def snapshot(self) -> Dict[str, Any]:
         """The whole telemetry state as one JSON-able dict.  Top-level
         keys are pinned in ``obs.metrics.TELEMETRY_SNAPSHOT_SCHEMA``;
@@ -775,6 +797,7 @@ class TelemetryService:
                 "programstore": self._programstore_block(),
                 "memory": self._memory_block(),
                 "faults": self._faults_block(),
+                "regression": self._regression_block(),
                 "flight": _FLIGHT.stats(),
             }
 
@@ -818,3 +841,9 @@ def note_h2d(nbytes: int) -> None:
 def note_programstore(event: str) -> None:
     if _GLOBAL.enabled:
         _GLOBAL.note_programstore(event)
+
+
+def note_regression(status: str, family: str,
+                    flags: Optional[List[Dict[str, Any]]] = None) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_regression(status, family, flags)

@@ -271,6 +271,33 @@ class TpuConfig:
     # disables dumping (the bounded in-memory event ring still
     # records).
     flight_dir: Optional[str] = None
+    # ---- search doctor (obs/attribution.py + obs/runlog.py) ----
+    # critical-path attribution: decompose each search's measured wall
+    # into pinned cause lanes (compile/stage/compute/gather/queue
+    # wait/faults/padding/memory narrowing) rendered as
+    # search_report["attribution"] with a one-line verdict.  False is
+    # the exact-no-op escape hatch: no block, reports and cv_results_
+    # byte-identical to the pre-doctor engine.
+    attribution: bool = True
+    # run history + regression sentinel: persist every search's
+    # attribution/geometry/cost-model record into the run log and
+    # compare against the stored baseline for the same (family,
+    # structure digest, env fingerprint) key.  False disables both
+    # even when a directory is configured — an exact no-op.
+    runlog: bool = True
+    # run-log directory (ProgramStore-style layout: records live under
+    # v<format>/<env_digest>/).  None defers to SST_RUNLOG_DIR; unset
+    # disables the run log and the sentinel.
+    runlog_dir: Optional[str] = None
+    # run-log byte budget: oldest records prune beyond it.  None
+    # defers to SST_RUNLOG_BYTES, then the 32 MiB default; <= 0
+    # disables the run log.
+    runlog_bytes: Optional[int] = None
+    # the sentinel's relative noise band: a watched lane (wall /
+    # compile / queue wait / padding) must grow beyond baseline x
+    # (1 + frac) — and by more than an absolute 50 ms floor — before
+    # a regression is flagged.
+    runlog_noise_frac: float = 0.25
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

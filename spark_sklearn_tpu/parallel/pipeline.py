@@ -246,6 +246,11 @@ class ChunkPipeline:
         self.verbose = int(verbose)
         self.timeline: List[Dict[str, Any]] = []
         self._wall_t0: Optional[float] = None
+        # the run epoch: the FIRST run()'s start, stable across rung
+        # barriers — per-launch t0_s/t1_s are relative to it, so the
+        # attribution analyzer can slice the timeline (and clip tracer
+        # spans, which carry the same perf_counter timebase) per rung
+        self._epoch: Optional[float] = None
         self._wall_s = 0.0
         self._n_precompiled = 0
         self._compile_executor: Optional[ThreadPoolExecutor] = None
@@ -296,6 +301,8 @@ class ChunkPipeline:
         pipeline drains; partial results written by earlier finalizes
         remain (checkpoint-resume picks them up)."""
         self._wall_t0 = time.perf_counter()
+        if self._epoch is None:
+            self._epoch = self._wall_t0
         try:
             if self.depth == 0:
                 self._run_sync(items)
@@ -377,6 +384,7 @@ class ChunkPipeline:
             "n_precompiled": self._n_precompiled,
             "stage_bytes_total": sum(
                 t.get("stage_bytes", 0) for t in tl),
+            "epoch_s": round(self._epoch or 0.0, 6),
             "launches": tl,
         }
 
@@ -391,7 +399,9 @@ class ChunkPipeline:
             return item.wait(out)
         return jax.block_until_ready(out)
 
-    def _record(self, item: LaunchItem, tm: LaunchTimings) -> None:
+    def _record(self, item: LaunchItem, tm: LaunchTimings,
+                t0: Optional[float] = None,
+                t1: Optional[float] = None) -> None:
         # fleet telemetry: the launch's device-busy estimate feeds the
         # rolling device-occupancy series (exact no-op when disabled)
         _telemetry.note_launch(tm.compute_s)
@@ -411,6 +421,10 @@ class ChunkPipeline:
             "gather_s": round(tm.gather_s, 6),
             "finalize_s": round(tm.finalize_s, 6),
         }
+        epoch = self._epoch
+        if t0 is not None and t1 is not None and epoch is not None:
+            rec["t0_s"] = round(t0 - epoch, 6)
+            rec["t1_s"] = round(t1 - epoch, 6)
         self.timeline.append(rec)
         if self.verbose > 0:
             # logging channel only (never stdout: launch records have
@@ -473,7 +487,7 @@ class ChunkPipeline:
                             kind=item.kind, group=item.group,
                             n_tasks=item.n_tasks)
             self._note_group(item.group, t1, t_end)
-            self._record(item, tm)
+            self._record(item, tm, t0, t_end)
 
     def _run_pipelined(self, items) -> None:
         depth = self.depth
@@ -542,7 +556,7 @@ class ChunkPipeline:
                             kind=item.kind, group=item.group,
                             n_tasks=item.n_tasks)
             self._note_group(item.group, t_dispatch0, t_end)
-            self._record(item, tm)
+            self._record(item, tm, t_dispatch0, t_end)
 
         try:
             top_up()

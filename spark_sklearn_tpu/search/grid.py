@@ -778,12 +778,27 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             return acc["results"]
 
         from inspect import signature as _signature
+        # the search doctor's wall: timed around the WHOLE candidate
+        # loop (every rung for halving), so host orchestration the
+        # pipeline never sees is attributable too
+        _doctor_t0 = time.perf_counter()
         if "callback_ctx" in _signature(self._run_search).parameters:
             self._run_search(evaluate_candidates,
                              callback_ctx=root_callback_ctx)
         else:
             # custom subclasses predating the callback API
             self._run_search(evaluate_candidates)
+        _doctor_wall = time.perf_counter() - _doctor_t0
+        # critical-path attribution + run-log sentinel (exact no-op
+        # when attribution=False or on the host tier)
+        self._doctor_finalize(
+            _doctor_wall, _doctor_t0,
+            family_name=(family.name if family is not None
+                         else type(estimator).__name__),
+            structure_parts=(
+                type(estimator).__name__, len(acc["params"]),
+                self.n_splits_, tuple(getattr(X_arr, "shape", ())),
+                str(getattr(self.config, "dtype", ""))))
 
         if not acc["params"]:
             raise ValueError(
@@ -846,6 +861,49 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         root_callback_ctx.call_on_fit_task_end(
             estimator=self, X=X, y=y, metadata=metadata_callbacks)
         return self
+
+    def _doctor_finalize(self, wall_s, t0_s, family_name,
+                         structure_parts):
+        """Search doctor: render ``search_report["attribution"]`` from
+        the blocks the search just recorded, then let the run log
+        persist the record and judge it against the stored baseline
+        (``obs/attribution.py`` + ``obs/runlog.py``).
+
+        Runs AFTER ``_run_search`` returns, so every block the
+        analyzer consumes (pipeline, scheduler, faults, memory,
+        geometry, halving) is already rendered.  Exact no-op when
+        ``TpuConfig.attribution`` is off or the fit never reached the
+        compiled tier (no pipeline timeline to decompose) — the
+        report stays byte-identical to the pre-doctor shape."""
+        if not getattr(self.config, "attribution", True):
+            return
+        metrics = getattr(self, "_search_metrics", None)
+        if metrics is None or "pipeline" not in metrics.data:
+            return
+        from spark_sklearn_tpu.obs import attribution as _attribution
+        from spark_sklearn_tpu.obs import runlog as _runlog
+        tracer = get_tracer()
+        # the tracer ring is process-global: clip to THIS search's
+        # wall window so a previous search's compile/recovery spans
+        # cannot leak into these lanes
+        t1_s = t0_s + wall_s
+        spans = [(name, max(a, t0_s), min(b, t1_s))
+                 for name, a, b in _attribution.spans_from_tracer(
+                     tracer.events())
+                 if a < t1_s and b > t0_s] if len(tracer) else []
+        with tracer.span("doctor.analyze", family=family_name):
+            block = _attribution.attribution_block(
+                metrics.data, wall_s, spans)
+            metrics.put("attribution", block)
+        digest = _runlog.structure_digest(family_name, *structure_parts)
+        with tracer.span("doctor.sentinel", family=family_name):
+            _runlog.note_run(metrics.data, family_name, digest,
+                             config=self.config)
+        logger.info(
+            "search doctor: %s", block["verdict"],
+            family=family_name, dominant=block["dominant"],
+            wall_s=block["wall_s"],
+            regression=block["regression"].get("status", "off"))
 
     @staticmethod
     def _hashable_labels(y):
@@ -2941,6 +2999,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     rung_rec["pipe_wall_s"] = round(
                         max(0.0, wall - rung.prev_pipe_wall), 4)
                     rung.prev_pipe_wall = wall
+                    # the rung's end boundary in the shared pipeline's
+                    # cumulative launch timeline — what the attribution
+                    # analyzer slices per-rung lanes from
+                    rung_rec["launches_end"] = len(launches)
             else:
                 geometry_cost_model().observe(launches)
             # persist the plan cache + cost-model state next to the AOT

@@ -134,6 +134,10 @@ class _RungContext:
             "lanes_reclaimed": 0,
             "padding_saved_frac": 0.0,
             "cost_observations": 0,
+            # end boundary in the shared pipeline's cumulative launch
+            # timeline (written at rung close) — the attribution
+            # analyzer slices its per-rung lanes with it
+            "launches_end": 0,
         }
         self.records.append(rec)
         self.current = rec

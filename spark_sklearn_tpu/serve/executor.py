@@ -513,6 +513,20 @@ class SearchExecutor:
             finally:
                 _TLS.binding = None
                 set_correlation(None)
+                if exc is None:
+                    # surface the search doctor's one-line diagnosis on
+                    # the serving channel, so a fleet operator sees the
+                    # critical path without opening the report
+                    attr = (getattr(search, "search_report", None)
+                            or {}).get("attribution") or {}
+                    if attr.get("verdict"):
+                        logger.info(
+                            "search %s doctor: %s", handle.id,
+                            attr["verdict"], handle=handle.id,
+                            tenant=handle.tenant,
+                            dominant=attr.get("dominant", ""),
+                            regression=(attr.get("regression") or {})
+                            .get("status", ""))
                 self._finish_search(handle, exc)
                 future._finish(exc)
         return run

@@ -63,6 +63,12 @@ class TpuSession:
             if self.programstore is not None and manifest and \
                     os.path.isfile(manifest):
                 self._prewarm_summary = self.prewarm(manifest)
+            # persistent run history (obs/runlog.py): the search
+            # doctor's cross-run regression sentinel appends one
+            # attribution record per fit and compares against the
+            # stored baseline for the same (family, structure, env)
+            from spark_sklearn_tpu.obs import runlog as _runlog
+            self.runlog = _runlog.activate_runlog(self.config)
             # parse the fault-injection plan NOW so a typo in
             # TpuConfig(fault_plan=...) / SST_FAULT_PLAN fails loudly at
             # session construction, not halfway through a long search
@@ -103,6 +109,10 @@ class TpuSession:
             f"{self.programstore.directory} "
             f"(prewarmed {self._prewarm_summary.get('loaded', 0)} "
             "artifact(s))")
+        logger.info(
+            "run log: %s",
+            "disabled" if self.runlog is None else
+            f"{self.runlog.directory} (env={self.runlog.env_digest})")
         from spark_sklearn_tpu.obs import memory as _obs_memory
         from spark_sklearn_tpu.parallel import memledger as _memledger
         self.memledger = _memledger.ledger_for(self.config)

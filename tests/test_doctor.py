@@ -1,0 +1,766 @@
+"""Search doctor (ISSUE 12): critical-path attribution, the persistent
+run log, and the cross-run regression sentinel.
+
+Contracts under test:
+  - `search_report["attribution"]` renders exactly the pinned
+    ATTRIBUTION_BLOCK_SCHEMA keys and its lanes sum to `wall_s`
+    EXACTLY — pinned at pipeline depth 0 and 2, exhaustive and
+    halving, traced and untraced;
+  - `TpuConfig(attribution=False)` drops the block and leaves the
+    rest of the report and `cv_results_` byte-identical;
+    `runlog=False` never touches disk and keeps the sentinel-off
+    placeholder;
+  - RunLog is a ProgramStore-style store: env-digest-keyed dirs,
+    checksummed atomic appends (a corrupted record is skipped, never
+    a failed search), oldest-first byte-budget eviction;
+  - the sentinel: identical reruns compare `none`; a run slower than
+    its stored baseline beyond the noise band flags `regressed` into
+    the report, the telemetry snapshot, `/metrics`
+    (`sst_regression_*`) and a sentinel flight bundle that
+    `tools/sst_doctor.py` digests (exit 1);
+  - tools: sst_doctor digests saved reports / run-log records /
+    bundles; bench_trend tabulates BENCH_rNN.json rounds and exits
+    nonzero on a cross-round regression; trace_summary handles
+    rung-namespaced halving traces and bundles whose
+    `memory.footprint` instants are empty (CPU `measured: false`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.obs import attribution
+from spark_sklearn_tpu.obs import provenance
+from spark_sklearn_tpu.obs import runlog
+from spark_sklearn_tpu.obs import telemetry as obs_telemetry
+from spark_sklearn_tpu.obs.metrics import (
+    ATTRIBUTION_BLOCK_SCHEMA,
+    schema_markdown,
+)
+from spark_sklearn_tpu.obs.trace import get_tracer
+
+from sklearn.linear_model import LogisticRegression
+from sklearn.naive_bayes import GaussianNB
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] + 0.25 * rng.randn(96) > 0).astype(np.int64)
+GRID = {"C": np.logspace(-2, 1, 24).tolist()}
+HGRID = {"var_smoothing": np.logspace(-9, -5, 24).tolist()}
+
+LANES = attribution.LANES
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    os.pardir)
+
+
+def small_search(param_grid=GRID, **cfg_kw):
+    cfg = sst.TpuConfig(**cfg_kw)
+    return sst.GridSearchCV(LogisticRegression(max_iter=10), param_grid,
+                            cv=2, refit=False, backend="tpu", config=cfg)
+
+
+def halving_search(**cfg_kw):
+    cfg = sst.TpuConfig(**cfg_kw)
+    return sst.HalvingGridSearchCV(
+        GaussianNB(), HGRID, cv=2, factor=3, random_state=7,
+        backend="tpu", config=cfg)
+
+
+def lanes_sum(block):
+    return sum(block[k] for k in LANES)
+
+
+@pytest.fixture(autouse=True)
+def clean_runlog():
+    """Every test starts and ends without a process-global run log —
+    an activation from one test must never serve as another test's
+    baseline store."""
+    runlog.deactivate_runlog()
+    yield
+    runlog.deactivate_runlog()
+
+
+@pytest.fixture
+def clean_tracer_local():
+    tr = get_tracer()
+    tr.disable()
+    tr.clear()
+    yield tr
+    tr.disable()
+    tr.clear()
+
+
+# ---------------------------------------------------------------------------
+# analyzer units
+# ---------------------------------------------------------------------------
+
+class TestAnalyzerUnits:
+    def test_normalize_remainder_lands_in_other(self):
+        lanes = attribution._normalize(
+            {"compile_s": 1.0, "stage_s": 0.5}, 4.0)
+        assert lanes["other_s"] == pytest.approx(2.5)
+        assert sum(lanes.values()) == pytest.approx(4.0, abs=1e-9)
+
+    def test_normalize_overshoot_scales_proportionally(self):
+        # pipelined overlap: raw sums exceed the wall -> proportional
+        # scale-down, zero residual lane
+        lanes = attribution._normalize(
+            {"compile_s": 6.0, "stage_s": 2.0}, 4.0)
+        assert lanes["other_s"] == 0.0
+        assert lanes["compile_s"] == pytest.approx(3.0)
+        assert lanes["stage_s"] == pytest.approx(1.0)
+        assert sum(lanes.values()) == pytest.approx(4.0, abs=1e-9)
+
+    def test_normalize_exact_after_rounding(self):
+        lanes = attribution._normalize(
+            {"compile_s": 1.0 / 3.0, "stage_s": 1.0 / 7.0}, 1.0)
+        # the 6-decimal rendering must not break the exact-sum pin
+        assert sum(lanes.values()) == pytest.approx(1.0, abs=1e-9)
+
+    def test_spans_from_chrome_filters_and_scales(self):
+        events = [
+            {"ph": "X", "name": "compile", "ts": 1_000_000, "dur": 500_000},
+            {"ph": "X", "name": "launch.retry", "ts": 0, "dur": 250_000},
+            {"ph": "X", "name": "stage", "ts": 0, "dur": 9_000_000},
+            {"ph": "b", "name": "compile", "ts": 0},
+        ]
+        spans = attribution.spans_from_chrome(events)
+        assert sorted(s[0] for s in spans) == ["compile", "launch.retry"]
+        compile_s, fault_s, n = attribution._span_walls(spans)
+        assert compile_s == pytest.approx(0.5)
+        assert fault_s == pytest.approx(0.25)
+        assert n == 1
+
+    def test_block_is_deterministic(self):
+        report = {
+            "pipeline": {"n_compiles": 2, "dispatch_wall_s": 0.8,
+                         "epoch_s": 0.0,
+                         "launches": [{"stage_s": 0.1, "gather_s": 0.05,
+                                       "queue_wait_s": 0.0,
+                                       "compute_s": 0.4}]},
+            "padding_waste": {"mean": 0.25},
+            "geometry": {"cost_model": {"compile_wall_s": 0.3,
+                                        "launch_overhead_s": 0.01}},
+        }
+        a = attribution.attribution_block(report, 2.0)
+        b = attribution.attribution_block(report, 2.0)
+        assert a == b
+        assert a["compile_s"] == pytest.approx(0.6)   # 2 x 0.3 modeled
+        assert a["padding_s"] == pytest.approx(0.1)   # 0.4 x 0.25
+        assert lanes_sum(a) == pytest.approx(a["wall_s"], abs=1e-9)
+
+    def test_uncalibrated_cost_model_falls_back_to_dispatch_wall(self):
+        report = {"pipeline": {"n_compiles": 3, "dispatch_wall_s": 0.9,
+                               "launches": []},
+                  "geometry": {"cost_model": {"compile_wall_s": 0.0}}}
+        block = attribution.attribution_block(report, 2.0)
+        assert block["compile_source"] == "modeled"
+        assert block["compile_s"] == pytest.approx(0.9)
+
+    def test_zero_wall_zeroes_every_lane(self):
+        block = attribution.attribution_block({}, 0.0)
+        assert all(block[k] == 0.0 for k in LANES)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the block in a real search report
+# ---------------------------------------------------------------------------
+
+class TestAttributionEndToEnd:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_exhaustive_lanes_sum_to_wall(self, depth):
+        gs = small_search(pipeline_depth=depth).fit(X, y)
+        block = gs.search_report["attribution"]
+        assert block["enabled"] is True
+        assert block["wall_s"] > 0
+        assert lanes_sum(block) == pytest.approx(block["wall_s"],
+                                                 abs=1e-5)
+        assert block["dominant"] in {n[:-2] for n in LANES}
+        assert block["verdict"]
+        assert block["rungs"] == []
+        assert block["regression"] == {"status": "off"}
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_halving_lanes_and_rungs(self, depth):
+        hs = halving_search(pipeline_depth=depth).fit(X, y)
+        block = hs.search_report["attribution"]
+        assert lanes_sum(block) == pytest.approx(block["wall_s"],
+                                                 abs=1e-5)
+        hb = hs.search_report["halving"]
+        assert len(block["rungs"]) == hb["n_rungs"] > 0
+        for rec, rung in zip(block["rungs"], hb["rungs"]):
+            assert rec["iter"] == rung["iter"]
+            assert rec["wall_s"] == pytest.approx(
+                round(rung["wall_s"], 6), abs=1e-5)
+            assert sum(rec[k] for k in LANES) == pytest.approx(
+                rec["wall_s"], abs=1e-5)
+            assert rec["dominant"] in {n[:-2] for n in LANES}
+
+    def test_halving_rungs_record_launch_boundaries(self):
+        hs = halving_search().fit(X, y)
+        rungs = hs.search_report["halving"]["rungs"]
+        ends = [r["launches_end"] for r in rungs]
+        assert ends == sorted(ends) and ends[0] > 0
+        assert ends[-1] == hs.search_report["pipeline"]["n_launches"]
+
+    def test_traced_compile_source_and_launch_timestamps(
+            self, clean_tracer_local):
+        import spark_sklearn_tpu.search.grid as g
+
+        # the cross-search program cache persists in-process; a warm
+        # hit would mean no compile span for the tracer to attribute.
+        # 40 candidates: wide enough that the fused path AOT-compiles
+        # on the compile thread (only those builds carry spans)
+        saved = dict(g._PROGRAM_CACHE), dict(g._PROGRAM_CACHE_FAMILY_COUNTS)
+        g._PROGRAM_CACHE.clear()
+        g._PROGRAM_CACHE_FAMILY_COUNTS.clear()
+        try:
+            gs = small_search({"C": np.logspace(-2, 1, 40).tolist()},
+                              trace=True).fit(X, y)
+        finally:
+            g._PROGRAM_CACHE.clear()
+            g._PROGRAM_CACHE_FAMILY_COUNTS.clear()
+            g._PROGRAM_CACHE.update(saved[0])
+            g._PROGRAM_CACHE_FAMILY_COUNTS.update(saved[1])
+        block = gs.search_report["attribution"]
+        assert gs.search_report["pipeline"]["n_compiles"] > 0
+        assert block["compile_source"] == "traced"
+        assert lanes_sum(block) == pytest.approx(block["wall_s"],
+                                                 abs=1e-5)
+        pipe = gs.search_report["pipeline"]
+        assert pipe["epoch_s"] > 0
+        for rec in pipe["launches"]:
+            assert 0.0 <= rec["t0_s"] <= rec["t1_s"]
+
+    def test_fault_injection_shows_in_fault_lane(
+            self, clean_tracer_local):
+        gs = small_search({"C": np.logspace(-2, 1, 40).tolist()},
+                          trace=True, fault_plan="transient@2",
+                          retry_backoff_s=0.05).fit(X, y)
+        block = gs.search_report["attribution"]
+        assert block["fault_s"] > 0, block
+        assert lanes_sum(block) == pytest.approx(block["wall_s"],
+                                                 abs=1e-5)
+
+    def test_block_matches_pinned_schema(self):
+        gs = small_search().fit(X, y)
+        block = gs.search_report["attribution"]
+        assert set(block) == {d.name for d in ATTRIBUTION_BLOCK_SCHEMA}
+
+    def test_schema_markdown_documents_attribution_block(self):
+        md = schema_markdown()
+        assert 'search_report["attribution"]' in md
+        for d in ATTRIBUTION_BLOCK_SCHEMA:
+            assert f"`{d.name}`" in md
+
+
+# ---------------------------------------------------------------------------
+# the off switches are exact no-ops
+# ---------------------------------------------------------------------------
+
+class TestOffSwitches:
+    def test_attribution_off_is_absent_and_byte_identical(self):
+        on = small_search().fit(X, y)
+        off = small_search(attribution=False).fit(X, y)
+        assert "attribution" in on.search_report
+        assert "attribution" not in off.search_report
+        assert set(on.search_report) - set(off.search_report) == \
+            {"attribution"}
+        for k in on.cv_results_:
+            if "time" in k or k == "params":
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(on.cv_results_[k]),
+                np.asarray(off.cv_results_[k]), err_msg=k)
+
+    def test_runlog_off_never_touches_disk(self, tmp_path):
+        gs = small_search(runlog=False,
+                          runlog_dir=str(tmp_path)).fit(X, y)
+        block = gs.search_report["attribution"]
+        assert block["regression"] == {"status": "off"}
+        assert os.listdir(tmp_path) == []
+        assert runlog.active_runlog() is None
+
+    def test_runlog_zero_budget_disables(self, tmp_path):
+        cfg = sst.TpuConfig(runlog_dir=str(tmp_path), runlog_bytes=0)
+        assert runlog.activate_runlog(cfg) is None
+
+    def test_host_tier_report_has_no_attribution(self):
+        gs = sst.GridSearchCV(LogisticRegression(max_iter=10),
+                              {"C": [0.1, 1.0]}, cv=2, refit=False,
+                              backend="host")
+        gs.fit(X, y)
+        assert "attribution" not in gs.search_report
+
+    def test_configless_unsupervised_search_survives_doctor(self):
+        # no TpuConfig and y=None: the doctor's structure digest must
+        # not assume either exists (KMeans rides the compiled tier)
+        from sklearn.cluster import KMeans
+
+        gs = sst.GridSearchCV(KMeans(n_init=2, random_state=0),
+                              {"n_clusters": [2, 3]}, cv=2, refit=False)
+        gs.fit(X)
+        block = gs.search_report["attribution"]
+        assert block["regression"] == {"status": "off"}
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class TestRunLogStore:
+    def test_layout_is_format_and_env_digest_keyed(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path))
+        path = log.append("fam", "abc123", {"attribution": {}})
+        assert path is not None
+        rel = os.path.relpath(path, tmp_path)
+        parts = rel.split(os.sep)
+        assert parts[0] == f"v{runlog.RUNLOG_FORMAT}"
+        assert parts[1] == provenance.env_digest()
+        assert parts[2].startswith("run-fam-abc123-")
+
+    def test_baseline_is_newest_verified_record(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path))
+        p1 = log.append("fam", "k1", {"n": 1})
+        p2 = log.append("fam", "k1", {"n": 2})
+        log.append("fam", "OTHER", {"n": 99})
+        # same mtime resolution race: make p2 strictly newer
+        os.utime(p1, (os.stat(p1).st_mtime - 10,) * 2)
+        assert log.baseline("fam", "k1") == {"n": 2}
+        assert [d["record"]["n"] for d in log.records("fam", "k1")] == \
+            [2, 1]
+        assert log.counts()["appends"] == 3
+        assert p2 is not None
+
+    def test_corrupt_record_is_skipped_not_fatal(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path))
+        path = log.append("fam", "k1", {"n": 1})
+        with open(path) as f:
+            doc = json.load(f)
+        doc["record"]["n"] = 999   # payload no longer matches checksum
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        assert log.baseline("fam", "k1") is None
+        assert log.counts()["corrupt"] >= 1
+        # torn JSON too
+        with open(path, "w") as f:
+            f.write('{"runlog_format": 1, "rec')
+        assert log.baseline("fam", "k1") is None
+
+    def test_byte_budget_evicts_oldest_first(self, tmp_path):
+        log = runlog.RunLog(str(tmp_path), byte_budget=1)
+        p1 = log.append("fam", "k1", {"pad": "x" * 256})
+        # its own append always survives the eviction pass, even over
+        # budget — history keeps at least the newest record
+        assert os.path.exists(p1)
+        os.utime(p1, (os.stat(p1).st_mtime - 10,) * 2)
+        p2 = log.append("fam", "k1", {"pad": "y" * 256})
+        assert os.path.exists(p2)
+        assert not os.path.exists(p1)   # oldest went first
+        assert log.counts()["evictions"] >= 1
+        assert log.disk_stats()["n_records"] == 1
+
+    def test_activation_mirrors_programstore(self, tmp_path):
+        cfg = sst.TpuConfig(runlog_dir=str(tmp_path),
+                            runlog_bytes=12345,
+                            runlog_noise_frac=0.5)
+        log = runlog.activate_runlog(cfg)
+        assert log is not None and runlog.active_runlog() is log
+        assert log.byte_budget == 12345 and log.noise_frac == 0.5
+        # same directory -> same instance, refreshed knobs
+        cfg2 = sst.TpuConfig(runlog_dir=str(tmp_path),
+                             runlog_bytes=999)
+        assert runlog.activate_runlog(cfg2) is log
+        assert log.byte_budget == 999
+        assert runlog.activate_runlog(sst.TpuConfig()) is None
+
+    def test_env_var_activation(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SST_RUNLOG_DIR", str(tmp_path))
+        monkeypatch.setenv("SST_RUNLOG_BYTES", "4096")
+        log = runlog.activate_runlog(None)
+        assert log is not None
+        assert log.byte_budget == 4096
+        monkeypatch.setenv("SST_RUNLOG_BYTES", "not-a-number")
+        runlog.deactivate_runlog()
+        with pytest.raises(ValueError):
+            runlog.activate_runlog(None)
+
+    def test_session_activates_runlog(self, tmp_path):
+        sess = sst.createLocalTpuSession(
+            "runlog-session",
+            config=sst.TpuConfig(runlog_dir=str(tmp_path)))
+        try:
+            assert sess.runlog is not None
+            assert sess.runlog is runlog.active_runlog()
+            assert os.path.isdir(sess.runlog._dir)
+        finally:
+            sess.stop()
+
+
+# ---------------------------------------------------------------------------
+# the sentinel
+# ---------------------------------------------------------------------------
+
+def _baseline_record(wall=0.001, **lanes):
+    attr = {k: 0.0 for k in LANES}
+    attr["wall_s"] = wall
+    attr.update(lanes)
+    return {"ts_unix_s": 123.0, "attribution": attr}
+
+
+class TestSentinel:
+    def test_compare_no_baseline(self):
+        reg = runlog.compare_to_baseline(None, {"wall_s": 1.0})
+        assert reg["status"] == "no-baseline" and reg["flags"] == []
+
+    def test_compare_within_band_is_none(self):
+        base = _baseline_record(wall=10.0)
+        reg = runlog.compare_to_baseline(base, {"wall_s": 11.0},
+                                         noise_frac=0.25)
+        assert reg["status"] == "none"
+        assert reg["baseline_wall_s"] == pytest.approx(10.0)
+
+    def test_compare_flags_watched_lanes_beyond_band(self):
+        base = _baseline_record(wall=1.0, compile_s=0.2)
+        cur = {"wall_s": 2.0, "compile_s": 0.5, "queue_wait_s": 0.0,
+               "padding_s": 0.0}
+        reg = runlog.compare_to_baseline(base, cur, noise_frac=0.25)
+        assert reg["status"] == "regressed"
+        assert {f["metric"] for f in reg["flags"]} == \
+            {"wall_s", "compile_s"}
+        wall_flag = next(f for f in reg["flags"]
+                         if f["metric"] == "wall_s")
+        assert wall_flag["delta_s"] == pytest.approx(1.0)
+        assert wall_flag["ratio"] == pytest.approx(2.0)
+
+    def test_absolute_floor_suppresses_jitter(self):
+        # 10x relative growth but only 20ms absolute: never a flag
+        base = _baseline_record(wall=0.002)
+        reg = runlog.compare_to_baseline(base, {"wall_s": 0.02},
+                                         noise_frac=0.25)
+        assert reg["status"] == "none"
+
+    def test_identical_reruns_compare_none(self, tmp_path):
+        first = small_search(runlog_dir=str(tmp_path)).fit(X, y)
+        second = small_search(runlog_dir=str(tmp_path)).fit(X, y)
+        r1 = first.search_report["attribution"]["regression"]
+        r2 = second.search_report["attribution"]["regression"]
+        assert r1["status"] == "no-baseline"
+        assert r2["status"] in ("none", "regressed")
+        log = runlog.active_runlog()
+        assert log.counts()["appends"] == 2
+        assert log.counts()["checks"] == 2
+
+    def test_regressed_run_flags_everywhere(self, tmp_path):
+        """The acceptance scenario: a stored fast baseline makes the
+        next (real) run regress — flagged in the report, the telemetry
+        snapshot, /metrics, and a sentinel bundle sst_doctor digests
+        with exit 1."""
+        from spark_sklearn_tpu.obs.fleet import prometheus_text
+
+        flight_dir = tmp_path / "flight"
+        store_dir = tmp_path / "log"
+        svc = obs_telemetry.get_telemetry()
+
+        def force_off():
+            # disable() is refcounted; drain every outstanding enable
+            while svc.enabled:
+                if svc.disable():
+                    break
+
+        force_off()
+        svc.reset()
+        svc.enable(interval_s=3600.0)
+        try:
+            cfg = sst.TpuConfig(runlog_dir=str(store_dir),
+                                flight_dir=str(flight_dir))
+            probe = small_search(runlog_dir=str(store_dir)).fit(X, y)
+            log = runlog.active_runlog()
+            fam = probe.search_report["attribution"]  # noqa: F841
+            # fabricate an implausibly fast baseline for the SAME key
+            # the next fit will use (newest record wins)
+            docs = log.records()
+            assert docs, "probe run did not append"
+            family = docs[0]["family"]
+            digest = docs[0]["structure_digest"]
+            log.append(family, digest, _baseline_record(wall=1e-4))
+            # the retry backoff guarantees the rerun's wall clears the
+            # sentinel's 50ms absolute jitter floor over the baseline
+            # (fault_plan is config, not structure: same digest; @0 so
+            # the warm-cache run's very first launch trips it)
+            gs = small_search(runlog_dir=str(store_dir),
+                              flight_dir=str(flight_dir),
+                              fault_plan="transient@0",
+                              retry_backoff_s=0.2).fit(X, y)
+            reg = gs.search_report["attribution"]["regression"]
+            assert reg["status"] == "regressed", reg
+            assert any(f["metric"] == "wall_s" for f in reg["flags"])
+            # telemetry snapshot + Prometheus families
+            snap = svc.snapshot()
+            assert snap["regression"]["flagged_total"] >= 1
+            assert snap["regression"]["last_status"] == "regressed"
+            assert snap["regression"]["last_family"] == family
+            body = prometheus_text(snap)
+            assert "sst_regression_flagged_total" in body
+            assert "sst_regression_active 1" in body
+            assert "sst_regression_delta_seconds" in body
+            # the sentinel bundle landed and the doctor reads it
+            bundles = sorted(flight_dir.glob("flight-regression-*.json"))
+            assert bundles, list(flight_dir.iterdir())
+            bundle = json.loads(bundles[-1].read_text())
+            assert bundle["context"]["regression"]["status"] == \
+                "regressed"
+            assert bundle["context"]["family"] == family
+            assert bundle["provenance"]["env_digest"] == \
+                provenance.env_digest()
+            p = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "sst_doctor.py"),
+                 str(bundles[-1])],
+                capture_output=True, text=True)
+            assert p.returncode == 1, (p.stdout, p.stderr)
+            assert "regression: regressed" in p.stdout
+            assert cfg is not None
+        finally:
+            force_off()
+            svc.reset()
+
+    def test_note_run_without_attribution_is_noop(self, tmp_path):
+        cfg = sst.TpuConfig(runlog_dir=str(tmp_path))
+        runlog.note_run({}, "fam", "k", config=cfg)
+        assert runlog.active_runlog() is None or \
+            runlog.active_runlog().counts()["appends"] == 0
+
+
+# ---------------------------------------------------------------------------
+# provenance — the one shared stamp
+# ---------------------------------------------------------------------------
+
+class TestProvenance:
+    def test_fingerprint_and_digest(self):
+        fp = provenance.env_fingerprint()
+        assert fp["pid"] == os.getpid()
+        assert fp["python"] and fp["platform"]
+        stable = provenance.env_fingerprint(include_pid=False)
+        assert "pid" not in stable
+        # the digest ignores the pid: stable across processes
+        assert provenance.env_digest() == provenance.env_digest()
+        assert len(provenance.env_digest()) == 12
+
+    def test_provenance_block_shape(self):
+        block = provenance.provenance_block()
+        assert set(block) == {"provenance_format", "env", "env_digest",
+                              "version"}
+        assert block["env_digest"] == provenance.env_digest()
+        # the full fingerprint (with pid) identifies the writing
+        # process; only the digest is pid-free
+        assert block["env"]["pid"] == os.getpid()
+
+    def test_runlog_records_carry_provenance(self, tmp_path):
+        small_search(runlog_dir=str(tmp_path)).fit(X, y)
+        doc = runlog.active_runlog().records()[0]
+        prov = doc["record"]["provenance"]
+        assert prov["env_digest"] == provenance.env_digest()
+        assert prov["version"]
+
+
+# ---------------------------------------------------------------------------
+# tools: sst_doctor
+# ---------------------------------------------------------------------------
+
+class TestDoctorCLI:
+    def _run(self, path, *flags):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "sst_doctor.py"),
+             str(path), *flags],
+            capture_output=True, text=True)
+
+    def test_saved_report_digest(self, tmp_path):
+        gs = small_search().fit(X, y)
+        path = tmp_path / "report.json"
+        path.write_text(json.dumps(gs.search_report, default=str))
+        p = self._run(path)
+        assert p.returncode == 0, p.stderr
+        assert "stored attribution" in p.stdout
+        assert "verdict:" in p.stdout and "regression:" in p.stdout
+        assert "<- dominant" in p.stdout
+
+    def test_reanalyzes_doctorless_report(self, tmp_path):
+        off = small_search(attribution=False).fit(X, y)
+        path = tmp_path / "report.json"
+        path.write_text(json.dumps(off.search_report, default=str))
+        p = self._run(path, "--json")
+        assert p.returncode == 0, p.stderr
+        d = json.loads(p.stdout)
+        assert d["source"] == "re-analyzed"
+        block = d["attribution"]
+        assert lanes_sum(block) == pytest.approx(block["wall_s"],
+                                                 abs=1e-5)
+        # offline re-analysis reproduces the in-process block
+        on = small_search().fit(X, y)
+        ref = dict(on.search_report["attribution"])
+        for key in ("wall_s", "verdict", "dominant"):
+            assert type(block[key]) is type(ref[key])
+
+    def test_runlog_record_digest(self, tmp_path):
+        small_search(runlog_dir=str(tmp_path)).fit(X, y)
+        recs = []
+        for dirpath, _dirs, files in os.walk(tmp_path):
+            recs += [os.path.join(dirpath, f) for f in files]
+        p = self._run(recs[0])
+        assert p.returncode == 0, p.stderr
+        assert "run-log record" in p.stdout
+
+    def test_unrecognized_artifact_exits_2(self, tmp_path):
+        path = tmp_path / "junk.json"
+        path.write_text('{"hello": 1}')
+        p = self._run(path)
+        assert p.returncode == 2
+        assert "unrecognized" in p.stderr
+
+
+# ---------------------------------------------------------------------------
+# tools: bench_trend
+# ---------------------------------------------------------------------------
+
+def _bench_round(n, warm, cold, rc=0, speedup=7.0, hits=2, misses=0):
+    return {
+        "n": n, "rc": rc, "cmd": "python bench.py", "tail": [],
+        "parsed": {"detail": {
+            "wall_s_cold": cold, "wall_s_warm": warm,
+            "halving_adaptive":
+                {"wall_ratio_exhaustive_over_halving": speedup},
+            "persistent_cache_probe": {"prewarmed": {
+                "store_hits": hits, "store_misses": misses}},
+        }},
+    }
+
+
+class TestBenchTrend:
+    def _write(self, tmp_path, rounds):
+        for i, payload in enumerate(rounds, start=1):
+            (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+                json.dumps(payload))
+
+    def test_ok_trend(self, tmp_path):
+        from tools.bench_trend import trend
+
+        self._write(tmp_path, [_bench_round(1, 50.0, 60.0),
+                               _bench_round(2, 52.0, 61.0)])
+        digest = trend(str(tmp_path))
+        assert [r["round"] for r in digest["rows"]] == [1, 2]
+        assert digest["comparison"]["status"] == "ok"
+        assert digest["comparison"]["rounds_compared"] == [1, 2]
+
+    def test_wall_regression_flags_and_exits_nonzero(self, tmp_path):
+        from tools.bench_trend import format_table, main, trend
+
+        self._write(tmp_path, [_bench_round(1, 50.0, 60.0),
+                               _bench_round(2, 120.0, 61.0)])
+        digest = trend(str(tmp_path))
+        cmp_ = digest["comparison"]
+        assert cmp_["status"] == "regressed"
+        assert [f["metric"] for f in cmp_["flags"]] == ["wall_s_warm"]
+        assert "REGRESSED wall_s_warm" in format_table(digest)
+        assert main(["--dir", str(tmp_path)]) == 1
+
+    def test_speedup_and_hit_rate_regress_downward(self, tmp_path):
+        from tools.bench_trend import trend
+
+        self._write(tmp_path,
+                    [_bench_round(1, 50.0, 60.0, speedup=8.0, hits=2),
+                     _bench_round(2, 50.0, 60.0, speedup=2.0,
+                                  hits=0, misses=2)])
+        cmp_ = trend(str(tmp_path))["comparison"]
+        assert {f["metric"] for f in cmp_["flags"]} == \
+            {"halving_speedup", "store_hit_rate"}
+
+    def test_unparsed_rounds_are_skipped(self, tmp_path):
+        from tools.bench_trend import trend
+
+        self._write(tmp_path, [
+            _bench_round(1, 50.0, 60.0),
+            {"n": 2, "rc": 124, "cmd": "", "tail": [], "parsed": {}},
+            _bench_round(3, 55.0, 62.0)])
+        cmp_ = trend(str(tmp_path))["comparison"]
+        assert cmp_["rounds_compared"] == [1, 3]
+        assert cmp_["status"] == "ok"
+
+    def test_insufficient_data(self, tmp_path):
+        from tools.bench_trend import main, trend
+
+        self._write(tmp_path, [_bench_round(1, 50.0, 60.0)])
+        cmp_ = trend(str(tmp_path))["comparison"]
+        assert cmp_["status"] == "insufficient-data"
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_repo_history_passes_the_gate(self):
+        from tools.bench_trend import main
+
+        # the committed BENCH_rNN.json rounds must never trip the gate
+        assert main(["--dir", REPO]) == 0
+
+    def test_no_rounds_exits_2(self, tmp_path):
+        from tools.bench_trend import main
+
+        assert main(["--dir", str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# tools: trace_summary on halving traces and thin bundles
+# ---------------------------------------------------------------------------
+
+class TestTraceSummaryDoctorScenarios:
+    def test_halving_trace_digests_with_rung_spans(
+            self, tmp_path, clean_tracer_local):
+        from tools.trace_summary import load_events, main, summarize
+
+        path = str(tmp_path / "halving_trace.json")
+        halving_search(trace=path).fit(X, y)
+        events = load_events(path)
+        digest = summarize(events)
+        # the rung spans are vocabulary-registered, not unknown
+        assert digest["unknown_names"] == []
+        names = {e.get("name") for e in events}
+        assert "halving.rung" in names
+        assert "doctor.analyze" in names
+        # rung-namespaced async launch groups (e.g. "launch r0:...")
+        # still group under the registered prefix
+        assert digest["async_tracks"].get("launch", 0) > 0
+        assert main([path]) == 0
+
+    def test_bundle_with_empty_footprint_instants(self, capsys):
+        """CPU bundles record memory.footprint instants whose args can
+        be empty / measured:false — the digest must not crash and must
+        report the unmeasured sample count."""
+        from tools.trace_summary import format_summary, summarize
+
+        events = [
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "MainThread"}},
+            {"ph": "X", "name": "stage", "pid": 1, "tid": 1,
+             "ts": 0, "dur": 1000, "args": {}},
+            {"ph": "i", "name": "memory.footprint", "pid": 1, "tid": 1,
+             "ts": 10, "args": {}},
+            {"ph": "i", "name": "memory.footprint", "pid": 1, "tid": 1,
+             "ts": 20, "args": {"group": "0", "capped": False}},
+            {"ph": "X", "name": "memory.sample", "pid": 1, "tid": 1,
+             "ts": 30, "dur": 5,
+             "args": {"measured": False, "bytes_in_use": 0}},
+        ]
+        digest = summarize(events)
+        mem = digest["memory"]
+        assert mem["measured"] is False
+        assert mem["n_samples"] == 1
+        assert mem["peak_bytes_in_use"] == 0
+        assert set(mem["per_group_peak_modeled_bytes"]) == {"?", "0"}
+        assert mem["capped_groups"] == []
+        text = format_summary(digest)
+        assert "unmeasured sample(s)" in text

@@ -400,7 +400,7 @@ class TestTracedUntracedParity:
         assert set(ra) == set(rb)
         sa, sb = _strip_walls(ra), _strip_walls(rb)
         for k in sa:
-            if k == "pipeline":
+            if k in ("pipeline", "attribution"):
                 continue               # per-launch float rounding varies
             assert sa[k] == sb[k], k
         # pipeline block: same structure and same counted values
@@ -408,6 +408,12 @@ class TestTracedUntracedParity:
         assert set(pa) == set(pb)
         for k in ("depth", "n_launches", "n_compiles"):
             assert pa[k] == pb[k], k
+        # attribution: timing-derived lanes (and the verdict's percent)
+        # vary run to run; the doctor's structure and counters must not
+        aa, ab = ra["attribution"], rb["attribution"]
+        assert set(aa) == set(ab)
+        for k in ("enabled", "n_compiles", "rungs", "regression"):
+            assert aa[k] == ab[k], k
 
     def test_overhead_within_budget(self, clean_tracer):
         """The documented <2% tracing budget (obs/trace.py).

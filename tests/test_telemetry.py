@@ -793,9 +793,15 @@ class TestParityAndOverhead:
         assert set(ra) == set(rb)
         sa, sb = _strip_walls(ra), _strip_walls(rb)
         for k in sa:
-            if k == "pipeline":
+            if k in ("pipeline", "attribution"):
                 continue                # per-launch float rounding
             assert sa[k] == sb[k], k
+        # attribution: lanes and the verdict's percent are timing-
+        # derived; compare the structural/counted parts only
+        aa, ab = ra["attribution"], rb["attribution"]
+        assert set(aa) == set(ab)
+        for k in ("enabled", "n_compiles", "rungs", "regression"):
+            assert aa[k] == ab[k], k
 
     def test_standalone_traced_fit_has_no_correlation_attrs(
             self, clean_telemetry, tmp_path):

@@ -1,0 +1,183 @@
+"""Tabulate the benchmark history and flag cross-round regressions.
+
+The repo keeps one ``BENCH_rNN.json`` payload per benchmark round
+(``bench.py`` writes them).  This tool reads them all and prints the
+trend a reviewer wants at a glance — cold/warm walls, the halving
+speedup ratio, the program-store hit rate — then compares the last
+two *parsed* rounds and exits nonzero when a headline metric moved
+the wrong way by more than the threshold:
+
+    python tools/bench_trend.py [--dir REPO] [--threshold PCT] [--json]
+
+Wall seconds regress UP; the halving speedup and the store hit rate
+regress DOWN.  The default threshold is deliberately generous (50%):
+the rounds run on shared CPU boxes where tens-of-percent noise is
+normal, and the gate exists to catch step changes, not jitter.
+Rounds whose payload carries no parsed detail (infra failures,
+timeouts) are listed but skipped by the comparison.
+
+Stdlib-only, like the other ``tools/`` CLIs: the CI trend leg and
+``bench.py`` (which embeds :func:`trend` output in its payload) must
+never pay the jax import for bookkeeping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["collect_rounds", "compare_last_two", "format_table",
+           "trend", "main"]
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: headline metrics: (row key, direction) — "up" means an increase is
+#: a regression, "down" means a decrease is
+_WATCHED = (
+    ("wall_s_cold", "up"),
+    ("wall_s_warm", "up"),
+    ("halving_speedup", "down"),
+    ("store_hit_rate", "down"),
+)
+
+
+def _round_row(path: str) -> Dict[str, Any]:
+    """One trend-table row distilled from a bench payload; metric
+    values are None when the round carries no parsed detail."""
+    n = int(_ROUND_RE.search(os.path.basename(path)).group(1))
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        payload = {}
+    det = (payload.get("parsed") or {}).get("detail") or {}
+    ha = det.get("halving_adaptive") or {}
+    store = (det.get("persistent_cache_probe") or {}).get("prewarmed") \
+        or {}
+    hits = store.get("store_hits")
+    misses = store.get("store_misses")
+    hit_rate = None
+    if hits is not None and misses is not None and (hits + misses) > 0:
+        hit_rate = round(hits / (hits + misses), 4)
+    return {
+        "round": n,
+        "rc": payload.get("rc"),
+        "wall_s_cold": det.get("wall_s_cold"),
+        "wall_s_warm": det.get("wall_s_warm"),
+        "halving_speedup": ha.get("wall_ratio_exhaustive_over_halving"),
+        "store_hit_rate": hit_rate,
+        "parsed": bool(det),
+    }
+
+
+def collect_rounds(directory: str) -> List[Dict[str, Any]]:
+    """All ``BENCH_rNN.json`` rows under ``directory``, in round
+    order."""
+    paths = [p for p in glob.glob(os.path.join(directory,
+                                               "BENCH_r*.json"))
+             if _ROUND_RE.search(os.path.basename(p))]
+    return sorted((_round_row(p) for p in paths),
+                  key=lambda r: r["round"])
+
+
+def compare_last_two(rows: List[Dict[str, Any]],
+                     threshold_pct: float) -> Dict[str, Any]:
+    """The regression comparison over the last two parsed rounds:
+    per-metric deltas plus the flagged subset.  ``{"status":
+    "insufficient-data"}`` when fewer than two rounds parsed."""
+    parsed = [r for r in rows if r["parsed"]]
+    if len(parsed) < 2:
+        return {"status": "insufficient-data",
+                "threshold_pct": threshold_pct, "flags": []}
+    prev, last = parsed[-2], parsed[-1]
+    flags: List[Dict[str, Any]] = []
+    deltas: Dict[str, Any] = {}
+    for key, direction in _WATCHED:
+        a, b = prev.get(key), last.get(key)
+        if a is None or b is None or a == 0:
+            continue
+        change_pct = round(100.0 * (b - a) / abs(a), 2)
+        deltas[key] = change_pct
+        regressed = change_pct > threshold_pct if direction == "up" \
+            else change_pct < -threshold_pct
+        if regressed:
+            flags.append({"metric": key, "prev": a, "last": b,
+                          "change_pct": change_pct,
+                          "direction": direction})
+    return {
+        "status": "regressed" if flags else "ok",
+        "rounds_compared": [prev["round"], last["round"]],
+        "threshold_pct": threshold_pct,
+        "deltas": deltas,
+        "flags": flags,
+    }
+
+
+def trend(directory: str,
+          threshold_pct: float = 50.0) -> Dict[str, Any]:
+    """The whole digest (rows + comparison) as one JSON-able dict —
+    ``bench.py`` embeds this in its payload."""
+    rows = collect_rounds(directory)
+    return {"rows": rows,
+            "comparison": compare_last_two(rows, threshold_pct)}
+
+
+def _fmt(v: Any, nd: int = 2) -> str:
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def format_table(digest: Dict[str, Any]) -> str:
+    out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
+           f"{'halving x':>10} {'hit rate':>9}"]
+    for r in digest["rows"]:
+        out.append(
+            f"  {r['round']:>5} {str(r['rc']):>4} "
+            f"{_fmt(r['wall_s_cold']):>9} {_fmt(r['wall_s_warm']):>9} "
+            f"{_fmt(r['halving_speedup']):>10} "
+            f"{_fmt(r['store_hit_rate']):>9}"
+            + ("" if r["parsed"] else "   (no parsed detail)"))
+    cmp_ = digest["comparison"]
+    out.append(f"comparison: {cmp_['status']} "
+               f"(threshold {cmp_['threshold_pct']:.0f}%)")
+    for k, pct in (cmp_.get("deltas") or {}).items():
+        out.append(f"  {k:<18} {pct:+.1f}%")
+    for f in cmp_["flags"]:
+        out.append(f"  REGRESSED {f['metric']}: {f['prev']} -> "
+                   f"{f['last']} ({f['change_pct']:+.1f}%)")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="directory holding BENCH_rNN.json (default: "
+                         "the repo root above this tool)")
+    ap.add_argument("--threshold", type=float, default=50.0,
+                    help="regression threshold in percent "
+                         "(default 50; CPU rounds are noisy)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digest as JSON instead of a table")
+    args = ap.parse_args(argv)
+    directory = args.dir or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir)
+    digest = trend(directory, args.threshold)
+    try:
+        if args.json:
+            print(json.dumps(digest, indent=2))
+        else:
+            print(format_table(digest))
+    except BrokenPipeError:      # `... | head` is a legitimate use
+        pass
+    if not digest["rows"]:
+        print("error: no BENCH_rNN.json rounds found", file=sys.stderr)
+        return 2
+    return 1 if digest["comparison"]["status"] == "regressed" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Digest a search's critical-path attribution in a terminal.
+
+The search doctor's offline half: point it at a saved artifact and it
+prints the lane decomposition (where the wall went), the one-line
+verdict, and the cross-run regression status:
+
+    python tools/sst_doctor.py ARTIFACT.json [--json]
+
+Three artifact shapes digest here, auto-detected:
+
+  - a saved ``search_report`` (``json.dumps(search.search_report)``):
+    the stored ``attribution`` block prints directly; a report saved
+    WITHOUT one (``TpuConfig(attribution=False)``, or predating the
+    doctor) is re-analyzed from its pipeline/geometry/memory blocks,
+    reproducing the in-process decomposition bit-for-bit;
+  - a flight-recorder bundle (``obs/telemetry.py``; ``flight_format``
+    key) — including the sentinel's ``regression-*`` bundles: the
+    dump context's verdict/regression print next to compile and
+    fault-recovery walls distilled from the embedded ``traceEvents``;
+  - a run-log record (``obs/runlog.py``; ``runlog_format`` key): the
+    archived attribution, provenance and geometry of one historical
+    run.
+
+Exit status: 0 healthy, 1 when the artifact carries a flagged
+regression (CI legs assert on this), 2 on an unrecognized file.
+
+Stdlib-only: the analyzer (``spark_sklearn_tpu/obs/attribution.py``)
+is loaded by file path — same pattern as ``tools/trace_summary.py`` —
+so digesting a report never pays the jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["digest", "format_digest", "load_analyzer", "main"]
+
+
+def load_analyzer():
+    """The attribution module, loaded directly by file path so the
+    digest never pays the package (jax) import; None when the source
+    tree is not alongside this tool."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "spark_sklearn_tpu", "obs", "attribution.py")
+    if not os.path.isfile(path):
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_sst_attribution", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_sst_attribution"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _classify(data: Any) -> str:
+    """Which artifact shape is this? report / bundle / runlog / ?"""
+    if not isinstance(data, dict):
+        return "?"
+    if "flight_format" in data:
+        return "bundle"
+    if "runlog_format" in data:
+        return "runlog"
+    if "attribution" in data or "pipeline" in data:
+        return "report"
+    return "?"
+
+
+def _digest_report(data: Dict[str, Any], mod) -> Dict[str, Any]:
+    block = data.get("attribution")
+    source = "stored"
+    if not isinstance(block, dict) or not block:
+        if mod is None:
+            return {"kind": "report", "error":
+                    "report carries no attribution block and the "
+                    "analyzer source is not alongside this tool"}
+        # re-analyze from the raw blocks: wall is the pipeline's when
+        # the report predates the doctor (no tracer spans offline, so
+        # compile falls back to the modeled estimate)
+        wall = float((data.get("pipeline") or {}).get("wall_s", 0.0)
+                     or 0.0)
+        block = mod.attribution_block(data, wall)
+        source = "re-analyzed"
+    return {"kind": "report", "source": source, "attribution": block,
+            "regression": block.get("regression") or {}}
+
+
+def _digest_bundle(data: Dict[str, Any], mod) -> Dict[str, Any]:
+    ctx = data.get("context") or {}
+    reg = ctx.get("regression") or {}
+    out: Dict[str, Any] = {
+        "kind": "bundle",
+        "reason": data.get("reason", ""),
+        "ts_unix_s": data.get("ts_unix_s"),
+        "verdict": ctx.get("verdict", ""),
+        "family": ctx.get("family", ""),
+        "regression": reg,
+    }
+    if mod is not None:
+        spans = mod.spans_from_chrome(data.get("traceEvents") or [])
+        compile_s, fault_s, n_compile = mod._span_walls(spans)
+        out["trace"] = {"compile_s": round(compile_s, 6),
+                        "fault_s": round(fault_s, 6),
+                        "n_compile_spans": n_compile}
+    return out
+
+
+def _digest_runlog(data: Dict[str, Any]) -> Dict[str, Any]:
+    rec = data.get("record") or {}
+    return {
+        "kind": "runlog",
+        "family": data.get("family", ""),
+        "structure_digest": data.get("structure_digest", ""),
+        "ts_unix_s": rec.get("ts_unix_s"),
+        "provenance": rec.get("provenance") or {},
+        "attribution": rec.get("attribution") or {},
+        "regression": {"status": rec.get("regression_status", "")},
+    }
+
+
+def digest(data: Any, mod=None) -> Dict[str, Any]:
+    """Distill one loaded artifact into the printed digest's data
+    structure (``kind`` names the detected shape; ``?`` when none
+    matched)."""
+    kind = _classify(data)
+    if kind == "report":
+        return _digest_report(data, mod)
+    if kind == "bundle":
+        return _digest_bundle(data, mod)
+    if kind == "runlog":
+        return _digest_runlog(data)
+    return {"kind": "?",
+            "error": "unrecognized artifact: expected a search report, "
+                     "flight bundle or run-log record"}
+
+
+def _lane_table(block: Dict[str, Any], lanes) -> List[str]:
+    wall = float(block.get("wall_s", 0.0) or 0.0)
+    out = [f"  {'lane':<14} {'seconds':>10} {'share':>7}"]
+    for name in lanes:
+        v = float(block.get(name, 0.0) or 0.0)
+        pct = 100.0 * v / wall if wall > 0 else 0.0
+        mark = "  <- dominant" \
+            if name[:-2] == block.get("dominant") else ""
+        out.append(f"  {name[:-2]:<14} {v:>10.3f} {pct:>6.1f}%{mark}")
+    return out
+
+
+def _regression_lines(reg: Dict[str, Any]) -> List[str]:
+    status = reg.get("status", "")
+    out = [f"regression: {status or '?'}"]
+    for f in reg.get("flags") or []:
+        out.append(
+            f"  {f.get('metric', '?'):<14} "
+            f"{f.get('baseline_s', 0.0):>8.3f}s -> "
+            f"{f.get('current_s', 0.0):>8.3f}s  "
+            f"(+{f.get('delta_s', 0.0):.3f}s, "
+            f"x{f.get('ratio', 0.0):.2f})")
+    return out
+
+
+def format_digest(d: Dict[str, Any], mod=None) -> str:
+    lanes = mod.LANES if mod is not None else (
+        "compile_s", "stage_s", "compute_s", "gather_s",
+        "queue_wait_s", "fault_s", "padding_s", "narrowing_s",
+        "other_s")
+    out: List[str] = []
+    if d["kind"] == "report":
+        block = d["attribution"]
+        out.append(f"search report ({d['source']} attribution): "
+                   f"wall {block.get('wall_s', 0.0):.3f} s, "
+                   f"{block.get('n_compiles', 0)} compile(s) "
+                   f"[{block.get('compile_source', '?')}]")
+        out.extend(_lane_table(block, lanes))
+        out.append(f"verdict: {block.get('verdict', '')}")
+        for r in block.get("rungs") or []:
+            out.append(f"  rung {r.get('iter')}: "
+                       f"wall {r.get('wall_s', 0.0):.3f} s, "
+                       f"dominant {r.get('dominant', '?')}")
+        out.extend(_regression_lines(d["regression"]))
+    elif d["kind"] == "bundle":
+        out.append(f"flight bundle: reason {d['reason']!r}"
+                   + (f", family {d['family']!r}" if d["family"] else ""))
+        if d.get("verdict"):
+            out.append(f"verdict: {d['verdict']}")
+        tr = d.get("trace") or {}
+        if tr:
+            out.append(f"trace: compile {tr['compile_s']:.3f} s over "
+                       f"{tr['n_compile_spans']} span(s), fault "
+                       f"recovery {tr['fault_s']:.3f} s")
+        out.extend(_regression_lines(d["regression"]))
+    elif d["kind"] == "runlog":
+        prov = d.get("provenance") or {}
+        out.append(f"run-log record: family {d['family']!r}, structure "
+                   f"{d['structure_digest']}, env "
+                   f"{prov.get('env_digest', '?')}")
+        block = d.get("attribution") or {}
+        if block:
+            out.extend(_lane_table(block, lanes))
+            out.append(f"verdict: {block.get('verdict', '')}")
+        out.extend(_regression_lines(d["regression"]))
+    else:
+        out.append(f"error: {d.get('error', 'unrecognized artifact')}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="search report, flight bundle or "
+                                     "run-log record (JSON)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digest as JSON instead of a table")
+    args = ap.parse_args(argv)
+    with open(args.artifact) as f:
+        data = json.load(f)
+    mod = load_analyzer()
+    d = digest(data, mod)
+    try:
+        if args.json:
+            print(json.dumps(d, indent=2))
+        else:
+            print(format_digest(d, mod))
+    except BrokenPipeError:      # `... | head` is a legitimate use
+        pass
+    if d["kind"] == "?":
+        print(f"error: {d.get('error')}", file=sys.stderr)
+        return 2
+    if (d.get("regression") or {}).get("status") == "regressed":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

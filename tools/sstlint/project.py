@@ -173,6 +173,7 @@ class Project:
                                    "_faults_by_class",
                                    "_faults_by_action", "_h2d",
                                    "_h2d_window", "_ps_events",
+                                   "_regression",
                                    "_providers", "_polls",
                                    "_n_samples")),
                 # obs/telemetry: the always-on flight-recorder ring
@@ -193,6 +194,13 @@ class Project:
                                    "safety_margin", "n_samples",
                                    "n_oom", "_devices", "_groups",
                                    "_compiled")),
+                # obs/runlog: the persistent run-history store, hit by
+                # the doctor's end-of-fit append and by any concurrent
+                # session sharing the process-wide active log
+                SharedState("obs/runlog.py",
+                            "runlog.RunLog._lock",
+                            cls="RunLog",
+                            attrs=("_seq", "_counts")),
             ),
             blocks=(
                 BlockSpec("pipeline", "PIPELINE_BLOCK_SCHEMA", (
@@ -227,6 +235,10 @@ class Project:
                 BlockSpec("memory", "MEMORY_BLOCK_SCHEMA", (
                     Producer("dict-keys", "parallel/memledger.py",
                              "report_block"),
+                )),
+                BlockSpec("attribution", "ATTRIBUTION_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "obs/attribution.py",
+                             "attribution_block"),
                 )),
                 BlockSpec("telemetry", "TELEMETRY_SNAPSHOT_SCHEMA", (
                     Producer("dict-keys", "obs/telemetry.py",
