@@ -823,12 +823,15 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
     queue-wait distribution both in aggregate and PER TENANT (p50/p95
     from the scheduler block's tenant-stamped wait sample).  A solo
     run first warms every program, so the contended levels measure
-    scheduling, not compilation."""
+    scheduling, not compilation.  Telemetry is on for the session, so
+    each level also records its admission ledger (admitted / deferred
+    / rejected deltas) and the protection-actuation counters."""
     import numpy as np
     from sklearn.datasets import load_digits
     from sklearn.linear_model import LogisticRegression
 
     import spark_sklearn_tpu as sst
+    from spark_sklearn_tpu.obs import telemetry as tel
 
     X, y = load_digits(return_X_y=True)
     X = (X[:n_rows] / 16.0).astype(np.float32)
@@ -849,7 +852,13 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                 int(round(p / 100.0 * (len(sorted_vals) - 1))))
         return round(sorted_vals[i], 6)
 
-    sess = sst.createLocalTpuSession("bench-serve")
+    def prot_counters():
+        return tel.get_telemetry().snapshot()["protection"]
+
+    # ephemeral-port telemetry: the admission/protection counters this
+    # leg records are the ones tools/fleet_top.py renders in production
+    sess = sst.createLocalTpuSession(
+        "bench-serve", config=sst.TpuConfig(telemetry_port=0))
     out = {"shape": f"digits[{n_rows}], {n_candidates} C x {folds} "
                     f"folds per search"}
     try:
@@ -858,11 +867,13 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
         out["solo_wall_s"] = round(time.perf_counter() - t0, 2)
         for k in levels:
             searches = [search(tenant=f"tenant{i}") for i in range(k)]
+            p0 = prot_counters()
             t0 = time.perf_counter()
             futs = [sess.submit(s, X, y) for s in searches]
             for f in futs:
                 f.result()
             wall = time.perf_counter() - t0
+            p1 = prot_counters()
             # per-tenant data-plane residency (DataPlane.tenant_usage_
             # all): the SLO view used to show queue-wait/throughput but
             # silently omit residency, leaving quota-pressure
@@ -895,6 +906,25 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                 "interleave_frac": [round(f, 4) for f in interleave],
                 "n_queue_waits": len(waits),
                 "tenant_resident_bytes": tenant_resident,
+                "admission": {
+                    "admitted": p1["admitted_total"]
+                    - p0["admitted_total"],
+                    "deferred": p1["queued_total"]
+                    - p0["queued_total"],
+                    "rejected": p1["rejected_total"]
+                    - p0["rejected_total"],
+                },
+                "protection": {
+                    "shed": p1["shed_total"] - p0["shed_total"],
+                    "quarantined": p1["quarantined_total"]
+                    - p0["quarantined_total"],
+                    "deadline_hits": p1["deadline_hits_total"]
+                    - p0["deadline_hits_total"],
+                    "declared_partial": sum(
+                        1 for s in searches
+                        if s.search_report.get(
+                            "protection", {}).get("partial")),
+                },
             }
     finally:
         sess.stop()

@@ -32,7 +32,7 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
     tests/test_halving.py tests/test_memory.py tests/test_sstlint.py \
-    tests/test_doctor.py -q
+    tests/test_doctor.py tests/test_protection.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -454,6 +454,17 @@ print("fault smoke:", {k: f[k] for k in
                        ("retries", "bisections", "host_fallbacks",
                         "timeouts", "injected")})
 PY
+
+echo "== overload + chaos soak (admission, deadlines, quarantine, brownout) =="
+# two tenants x three searches under a chaos plan mixing a transient,
+# a deep OOM, a sticky FATAL (poison-candidate quarantine), a 300ms
+# brownout, a hang and a submit storm; the harness exits nonzero on
+# any crash, any un-declared partial result, overflow submits that do
+# not shed with a clean structured AdmissionError, or a p95 queue
+# wait past the bound
+JAX_PLATFORMS=cpu python tools/sst_soak.py --tenants 2 --searches 3 \
+    --plan "transient@1;oom_deep@2;fatal_deep@3;slow@3:0.3;hung@5;submit_storm@0x6" \
+    --deadline 120 --max-p95 60
 
 echo "== search-doctor smoke (attribution + cross-run sentinel) =="
 RUNLOG_DIR=$(mktemp -d /tmp/sst_doctor_smoke_XXXX)

@@ -254,6 +254,34 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
                        "family": reg.get("last_family", "")},
                help_text="Per-lane wall regression vs the run-log "
                          "baseline, from the last flagged check.")
+    prot = snap.get("protection") or {}
+    ln.add("sst_protection_admitted_total", prot.get("admitted_total"),
+           mtype="counter",
+           help_text="Searches admitted straight into a running slot.")
+    ln.add("sst_protection_queued_total", prot.get("queued_total"),
+           mtype="counter",
+           help_text="Searches admitted into the bounded waiting line.")
+    ln.add("sst_protection_rejected_total", prot.get("rejected_total"),
+           mtype="counter",
+           help_text="Submissions refused with AdmissionError before "
+                     "any device work.")
+    for reason, n in (prot.get("rejected_by_reason") or {}).items():
+        ln.add("sst_protection_rejected_by_reason_total", n,
+               labels={"reason": str(reason)}, mtype="counter",
+               help_text="Admission rejections by machine-readable "
+                         "reason.")
+    ln.add("sst_protection_shed_total", prot.get("shed_total"),
+           mtype="counter",
+           help_text="Candidates shed to error_score by deadline or "
+                     "persistent-fault degradation.")
+    ln.add("sst_protection_quarantined_total",
+           prot.get("quarantined_total"), mtype="counter",
+           help_text="Poison candidates quarantined to error_score "
+                     "after K single-lane FATALs.")
+    ln.add("sst_protection_deadline_hits_total",
+           prot.get("deadline_hits_total"), mtype="counter",
+           help_text="Searches whose search_deadline_s expired "
+                     "mid-run.")
     flight = snap.get("flight") or {}
     ln.add("sst_flight_records_total", flight.get("n_records"),
            mtype="counter",

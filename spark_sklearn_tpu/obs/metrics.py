@@ -36,6 +36,7 @@ __all__ = [
     "HALVING_BLOCK_SCHEMA",
     "MEMORY_BLOCK_SCHEMA",
     "ATTRIBUTION_BLOCK_SCHEMA",
+    "PROTECTION_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
@@ -180,6 +181,16 @@ SEARCH_REPORT_SCHEMA = (
         "baseline (obs/attribution.py).  Absent when "
         "TpuConfig(attribution=False) — the byte-identical "
         "pre-doctor report shape."),
+    MetricDef(
+        "protection", "struct",
+        "The self-protecting service's verdict for this search (see "
+        "the protection-block schema below): deadline state, shed and "
+        "quarantined candidates, and whether the returned cv_results_ "
+        "is declared partial (parallel/faults.py protection_block).  "
+        "Absent when protection is off (no search_deadline_s, "
+        "partial_results='raise', admission_mode='static') — the "
+        "byte-identical pre-protection report shape.",
+        backends="tpu,host"),
     MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
@@ -634,6 +645,62 @@ ATTRIBUTION_BLOCK_SCHEMA = (
 )
 
 
+#: sub-keys of ``search_report["protection"]`` (written by
+#: ``parallel.faults.protection_block``) — the self-protecting
+#: service's per-search verdict.  Present only when protection is on
+#: (``TpuConfig.search_deadline_s`` / ``partial_results`` /
+#: ``admission_mode``); off, the report is byte-identical to the
+#: pre-protection shape.
+PROTECTION_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Always True when present: the block only renders when "
+              "the protection layer is on."),
+    MetricDef("mode", "label",
+              "TpuConfig.admission_mode the search ran under: "
+              "'static' (slot-count admission only) or 'predictive' "
+              "(ledger-modeled footprint + SLO forecast priced at "
+              "submit)."),
+    MetricDef("partial_results", "label",
+              "TpuConfig.partial_results policy: 'raise' (deadline/"
+              "persistent faults propagate) or 'best_effort' "
+              "(declared-partial cv_results_)."),
+    MetricDef("deadline_s", "gauge",
+              "TpuConfig.search_deadline_s the search ran under (0 = "
+              "no deadline)."),
+    MetricDef("deadline_hit", "label",
+              "Whether the deadline expired before every candidate "
+              "ran."),
+    MetricDef("elapsed_s", "gauge",
+              "Seconds from the deadline clock's start (submit time "
+              "for executor-submitted searches — queue wait counts — "
+              "else fit()) to the block's rendering."),
+    MetricDef("partial", "label",
+              "Whether any candidate was shed or quarantined: True "
+              "means cv_results_ carries error_score cells that were "
+              "never run (sklearn-exact semantics) and is DECLARED "
+              "partial."),
+    MetricDef("n_candidates_shed", "counter",
+              "Candidates written to error_score without running "
+              "(deadline shedding + persistent-fault degradation)."),
+    MetricDef("n_quarantined", "counter",
+              "Poison candidates quarantined to error_score after K "
+              "single-lane FATAL faults "
+              "(TpuConfig.quarantine_fatal_k)."),
+    MetricDef("shed", "series",
+              "One record per shed event: chunk key, the candidate "
+              "indices shed, and the reason ('deadline' or "
+              "'fault')."),
+    MetricDef("quarantined", "series",
+              "One record per quarantined candidate: chunk key, "
+              "candidate index, fault count and the final error "
+              "(each also dumps a protection flight bundle)."),
+    MetricDef("verdict", "label",
+              "The one-line judgment: 'complete', or 'partial-' plus "
+              "the causes ('deadline', 'quarantine', 'fault') that "
+              "shed work."),
+)
+
+
 #: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
 #: telemetry service's JSON view (``obs/telemetry.py``), also served
 #: as ``/snapshot.json`` (and rendered to Prometheus text) by the
@@ -689,6 +756,12 @@ TELEMETRY_SNAPSHOT_SCHEMA = (
               "run's status and the lanes that breached the noise "
               "band — also rendered as the sst_regression_* "
               "Prometheus family."),
+    MetricDef("protection", "struct",
+              "The self-protecting service's process totals: "
+              "admission decisions (admitted/queued/rejected, by "
+              "reason), candidates shed, poison candidates "
+              "quarantined and deadline expiries — also rendered as "
+              "the sst_protection_* Prometheus family."),
     MetricDef("flight", "struct",
               "Flight-recorder state: records seen, ring occupancy, "
               "black-box bundles dumped."),
@@ -926,6 +999,14 @@ def schema_markdown() -> str:
         "exactly.\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in ATTRIBUTION_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"protection\"]` block\n")
+    out.append(
+        "\nPresent when the self-protecting service is on "
+        "(`TpuConfig.search_deadline_s` / `partial_results` / "
+        "`admission_mode`; `parallel/faults.py`).\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in PROTECTION_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
                "endpoint schema\n")

@@ -131,6 +131,9 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "OOM recovery: chunk bisected into half-width launches."),
     SpanDef("launch.host_fallback", "span", "parallel.faults",
             "OOM recovery bottomed out into per-candidate host runs."),
+    SpanDef("launch.isolate", "span", "parallel.faults",
+            "FATAL recovery: chunk re-run through the quarantine "
+            "bisect hook to isolate the poison candidate."),
     # serve/executor.py
     SpanDef("serve.submit", "span", "serve.executor",
             "Admission + enqueue of one submitted search."),

@@ -72,11 +72,13 @@ __all__ = [
     "TelemetryService",
     "flight_recorder",
     "get_telemetry",
+    "note_admission",
     "note_dispatch",
     "note_fault",
     "note_h2d",
     "note_launch",
     "note_programstore",
+    "note_protection",
     "note_sched_busy",
     "percentile",
     "resolve_flight_dir",
@@ -316,6 +318,24 @@ class FlightRecorder:
             len(trace_events), reason=reason, path=path)
         return path
 
+    def protection_dump(self, verdict: str, reason: Optional[str] = None,
+                        flight_dir: Optional[str] = None, config=None,
+                        faults: Optional[Dict[str, Any]] = None,
+                        scheduler: Optional[Dict[str, Any]] = None,
+                        context: Optional[Dict[str, Any]] = None,
+                        ) -> Optional[str]:
+        """The ONE trigger path for protection-verdict bundles: a
+        deadline-expired cancel, a quarantined poison candidate, or a
+        retry-budget exhaustion all land here, so every such bundle
+        carries its verdict under ``context["protection_verdict"]``
+        and is greppable the same way."""
+        ctx = dict(context or {})
+        ctx["protection_verdict"] = str(verdict)
+        return self.dump(reason or f"protection-{verdict}",
+                         flight_dir=flight_dir, config=config,
+                         faults=faults, scheduler=scheduler,
+                         context=ctx)
+
 
 _FLIGHT = FlightRecorder()
 
@@ -390,6 +410,14 @@ class TelemetryService:
         #: comparisons performed, regressions flagged, and the last
         #: judgment's status/family/flagged-lane list
         self._regression: Dict[str, Any] = _zero_regression()
+        #: admission decisions (admitted/queued/rejected) and, for
+        #: rejections, the machine-readable reason breakdown — the
+        #: self-protecting service's shed/deferred counters
+        self._admission: Dict[str, int] = {}
+        self._admission_reasons: Dict[str, int] = {}
+        #: protection actuations: candidates shed, poison candidates
+        #: quarantined, deadlines expired
+        self._protection: Dict[str, int] = {}
         #: provider name -> STACK of zero-arg callables returning a
         #: JSON-able dict; the newest registration is polled, and
         #: unregistering it restores the previous one — so two
@@ -500,6 +528,9 @@ class TelemetryService:
             self._h2d_window = RollingWindow(self.window_s)
             self._ps_events.clear()
             self._regression = _zero_regression()
+            self._admission.clear()
+            self._admission_reasons.clear()
+            self._protection.clear()
             self._polls.clear()
             self._n_samples = 0
 
@@ -637,6 +668,30 @@ class TelemetryService:
             return
         with self._lock:
             self._ps_events[event] = self._ps_events.get(event, 0) + 1
+
+    def note_admission(self, decision: str, tenant: str = "",
+                       reason: str = "") -> None:
+        """Admission-control feed (serve/executor.py): one submit's
+        verdict — "admitted", "queued" (deferred to the waiting line)
+        or "rejected" (with its machine-readable reason)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._admission[decision] = \
+                self._admission.get(decision, 0) + 1
+            if decision == "rejected" and reason:
+                self._admission_reasons[reason] = \
+                    self._admission_reasons.get(reason, 0) + 1
+
+    def note_protection(self, kind: str, n: int = 1) -> None:
+        """Protection-actuation feed: "shed" (candidates written to
+        error_score without running), "quarantined" (poison candidates
+        isolated) or "deadline_hit" (search deadlines expired)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._protection[kind] = self._protection.get(kind, 0) \
+                + int(n)
 
     def note_regression(self, status: str, family: str,
                         flags: Optional[List[Dict[str, Any]]] = None,
@@ -777,6 +832,21 @@ class TelemetryService:
                                    for f in block["last_flags"]]
             return block
 
+    def _protection_block(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "admitted_total": self._admission.get("admitted", 0),
+                "queued_total": self._admission.get("queued", 0),
+                "rejected_total": self._admission.get("rejected", 0),
+                "rejected_by_reason": dict(
+                    sorted(self._admission_reasons.items())),
+                "shed_total": self._protection.get("shed", 0),
+                "quarantined_total": self._protection.get(
+                    "quarantined", 0),
+                "deadline_hits_total": self._protection.get(
+                    "deadline_hit", 0),
+            }
+
     def snapshot(self) -> Dict[str, Any]:
         """The whole telemetry state as one JSON-able dict.  Top-level
         keys are pinned in ``obs.metrics.TELEMETRY_SNAPSHOT_SCHEMA``;
@@ -798,6 +868,7 @@ class TelemetryService:
                 "memory": self._memory_block(),
                 "faults": self._faults_block(),
                 "regression": self._regression_block(),
+                "protection": self._protection_block(),
                 "flight": _FLIGHT.stats(),
             }
 
@@ -847,3 +918,14 @@ def note_regression(status: str, family: str,
                     flags: Optional[List[Dict[str, Any]]] = None) -> None:
     if _GLOBAL.enabled:
         _GLOBAL.note_regression(status, family, flags)
+
+
+def note_admission(decision: str, tenant: str = "",
+                   reason: str = "") -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_admission(decision, tenant, reason)
+
+
+def note_protection(kind: str, n: int = 1) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_protection(kind, n)

@@ -72,8 +72,11 @@ __all__ = [
     "InjectedFault",
     "LaunchTimeoutError",
     "LaunchSupervisor",
+    "SearchDeadlineError",
     "classify_error",
     "is_oom",
+    "protection_block",
+    "protection_enabled",
     "register_classifier",
 ]
 
@@ -96,7 +99,18 @@ FATAL = "fatal"
 #: bisected sub-range, forcing recovery all the way to the host path
 OOM_DEEP = "oom_deep"
 
-_CLASSES = (TRANSIENT, OOM, HUNG, FATAL, OOM_DEEP)
+#: plan-only pseudo-class: FATAL that stays sticky through bisection —
+#: every isolated sub-range re-fails down to single-lane, which is how
+#: tests drive a poison candidate into quarantine deterministically
+FATAL_DEEP = "fatal_deep"
+
+#: plan-only brownout: the launch is not failed, it is STALLED for the
+#: token's factor seconds before running (``slow@5:0.05`` = a 50 ms
+#: brownout at launch index 5) — the chaos harness's degraded-device
+#: event
+SLOW = "slow"
+
+_CLASSES = (TRANSIENT, OOM, HUNG, FATAL, OOM_DEEP, FATAL_DEEP, SLOW)
 
 #: message substrings marking a device error as OOM / transient.  XLA
 #: runtime errors carry their grpc-style status name in the message
@@ -129,6 +143,10 @@ class InjectedFault(RuntimeError):
         #: OOM_DEEP faults stay sticky through bisection: every
         #: multi-candidate sub-range re-fails, forcing host fallback
         self.sst_sticky_oom = fault_class == OOM_DEEP
+        #: FATAL_DEEP faults stay sticky through isolation: every
+        #: sub-range re-fails down to single-lane, so the quarantine
+        #: counter deterministically reaches its K
+        self.sst_sticky_fatal = fault_class == FATAL_DEEP
 
 
 class LaunchTimeoutError(TimeoutError):
@@ -151,6 +169,40 @@ class LaunchTimeoutError(TimeoutError):
         self.injected = injected
 
 
+class SearchDeadlineError(RuntimeError):
+    """The search exceeded ``TpuConfig.search_deadline_s`` under
+    ``partial_results="raise"``.  Under ``"best_effort"`` the deadline
+    sheds the remaining candidates to ``error_score`` instead of
+    raising this."""
+
+    #: consumed by grid._dispatch: an expired budget on the compiled
+    #: path must not buy a full host re-run of the same search
+    _sst_no_fallback = True
+
+    def __init__(self, deadline_s: float, elapsed_s: float,
+                 n_remaining: int = 0):
+        super().__init__(
+            f"search exceeded search_deadline_s={deadline_s:g}s "
+            f"(elapsed {elapsed_s:.3f}s, {n_remaining} candidate(s) "
+            "un-run); set partial_results='best_effort' for a declared-"
+            "partial cv_results_ instead")
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+        self.n_remaining = n_remaining
+
+
+def _normalize_class(cls: str) -> str:
+    """Collapse the plan-only pseudo-classes onto the 4-way taxonomy
+    recovery actually dispatches on."""
+    if cls == OOM_DEEP:
+        return OOM
+    if cls == FATAL_DEEP:
+        return FATAL
+    if cls == SLOW:
+        return TRANSIENT
+    return cls
+
+
 def classify_error(exc: BaseException) -> str:
     """Map an exception to its taxonomy class.
 
@@ -161,9 +213,9 @@ def classify_error(exc: BaseException) -> str:
     for fn in _CUSTOM_CLASSIFIERS:
         cls = fn(exc)
         if cls in _CLASSES:
-            return OOM if cls == OOM_DEEP else cls
+            return _normalize_class(cls)
     if isinstance(exc, InjectedFault):
-        return OOM if exc.fault_class == OOM_DEEP else exc.fault_class
+        return _normalize_class(exc.fault_class)
     if isinstance(exc, LaunchTimeoutError):
         return HUNG
     if isinstance(exc, MemoryError):
@@ -189,15 +241,18 @@ def is_oom(exc: BaseException) -> bool:
 class FaultSpec:
     """Inject `fault_class` at launch `index` for its first `count`
     attempts (count=1: the launch fails once and the first retry
-    succeeds)."""
+    succeeds).  ``factor`` only applies to the ``slow`` brownout class:
+    absolute seconds the launch is stalled before running."""
 
     index: int
     fault_class: str
     count: int = 1
+    factor: float = 0.0
 
 
 _PLAN_TOKEN = re.compile(
-    r"(?i)^(transient|oom_deep|oom|hung|fatal)@(\d+)(?:x(\d+))?$")
+    r"(?i)^(transient|oom_deep|oom|hung|fatal_deep|fatal|slow)"
+    r"@(\d+)(?:x(\d+))?(?::([0-9.]+))?$")
 
 
 class FaultPlan:
@@ -264,10 +319,12 @@ class FaultPlan:
                 if m is None:
                     raise ValueError(
                         f"bad fault-plan token {tok!r}; expected "
-                        "CLASS@INDEX[xCOUNT] with CLASS in "
-                        f"{_CLASSES}, e.g. 'transient@3,oom@5'")
+                        "CLASS@INDEX[xCOUNT][:FACTOR] with CLASS in "
+                        f"{_CLASSES}, e.g. 'transient@3,oom@5,"
+                        "slow@7:0.05'")
                 out.append(FaultSpec(int(m.group(2)), m.group(1).lower(),
-                                     int(m.group(3) or 1)))
+                                     int(m.group(3) or 1),
+                                     float(m.group(4) or 0.0)))
             return cls(out)
         out = []
         for entry in spec:
@@ -278,12 +335,14 @@ class FaultPlan:
                     int(entry["index"]),
                     str(entry.get("class",
                                   entry.get("fault_class"))).lower(),
-                    int(entry.get("count", 1))))
+                    int(entry.get("count", 1)),
+                    float(entry.get("factor", 0.0))))
             else:
                 idx, fcls = entry[0], entry[1]
                 count = entry[2] if len(entry) > 2 else 1
+                factor = entry[3] if len(entry) > 3 else 0.0
                 out.append(FaultSpec(int(idx), str(fcls).lower(),
-                                     int(count)))
+                                     int(count), float(factor)))
         return cls(out)
 
     @classmethod
@@ -378,6 +437,20 @@ class LaunchSupervisor:
         # enter/leave independently, and a saved-prev restore would let
         # one recovery clobber the other's flag
         self._sticky_oom = 0
+        # same shape for sticky (fatal_deep) isolations
+        self._sticky_fatal = 0
+        # poison-candidate quarantine (self-protecting service): active
+        # only under partial_results="best_effort".  A launch key whose
+        # single-lane range faults FATAL quarantine_k times is written
+        # to error_score instead of killing the search.
+        self.quarantine_k = (
+            int(getattr(config, "quarantine_fatal_k", 3) or 0)
+            if str(getattr(config, "partial_results", "raise")
+                   or "raise") == "best_effort" else 0)
+        self._fatal_counts: Dict[str, int] = {}
+        # one FATAL bundle per launch key while quarantine is counting
+        # to K — K identical failures must not dump K bundles
+        self._fatal_dumped: set = set()
         self.faults: Dict[str, Any] = faults if faults is not None else {}
         defaults = {
             "retries": 0, "bisections": 0, "host_fallbacks": 0,
@@ -462,6 +535,13 @@ class LaunchSupervisor:
             return
         reason = None
         if cls == FATAL and action == "raise":
+            if self.quarantine_k:
+                # quarantine counts the SAME launch key failing K
+                # times: one bundle per key, not one per attempt
+                with self._lock:
+                    if key in self._fatal_dumped:
+                        return
+                    self._fatal_dumped.add(key)
             reason = "fatal"
         elif cls == HUNG:
             reason = "watchdog-timeout"
@@ -484,12 +564,15 @@ class LaunchSupervisor:
                                if exc is not None else ""),
                      **mem})
 
-    def record_bisection(self, key: str, group: int) -> None:
-        """Called by the item's bisect hook once per split."""
+    def record_bisection(self, key: str, group: int,
+                         fault_class: str = OOM) -> None:
+        """Called by the item's bisect hook once per split — OOM
+        recovery by default; FATAL when the quarantine path isolates a
+        poison range (search/grid.py exec_fused_range)."""
         self._count("bisections")
-        self._record_event(key, group, OOM, "bisect", None, 0)
-        _slog.warning("launch %s: OOM — bisecting the chunk", key,
-                      key=key, group=group)
+        self._record_event(key, group, fault_class, "bisect", None, 0)
+        _slog.warning("launch %s: %s — bisecting the chunk", key,
+                      fault_class, key=key, group=group)
 
     def record_host_fallback(self, key: str, group: int, n_tasks: int) -> None:
         """Called by recovery paths when a range degrades to per-
@@ -500,6 +583,49 @@ class LaunchSupervisor:
             "launch %s: bisection bottomed out — running %d task(s) on "
             "the host with sklearn error_score semantics", key, n_tasks,
             key=key, group=group, n_tasks=n_tasks)
+
+    # -- poison-candidate quarantine -------------------------------------
+    def note_fatal(self, key: str) -> int:
+        """Count one FATAL fault on a single-lane range, returning the
+        total for that launch key — the quarantine counter the fused-
+        range recursion in search/grid.py compares against K."""
+        with self._lock:
+            n = self._fatal_counts.get(key, 0) + 1
+            self._fatal_counts[key] = n
+        return n
+
+    def record_quarantine(self, key: str, group: int,
+                          exc: BaseException, n_faults: int) -> None:
+        """A single-lane range faulted FATAL K times: journal the
+        quarantine verdict, tell telemetry, and dump a protection
+        bundle — the search itself continues with the candidate
+        written to error_score."""
+        self._record_event(key, group, FATAL, "quarantine", exc,
+                           n_faults)
+        _telemetry.note_protection("quarantined")
+        self._protection_dump("quarantine", key, group, exc,
+                              extra={"n_faults": n_faults,
+                                     "quarantine_k": self.quarantine_k})
+        _slog.warning(
+            "launch %s: single-lane range faulted FATAL %d time(s) — "
+            "quarantining the candidate to error_score (the search "
+            "continues)", key, n_faults, key=key, group=group)
+
+    def _protection_dump(self, verdict: str, key: str, group: int,
+                         exc: Optional[BaseException],
+                         extra: Optional[Dict[str, Any]] = None) -> None:
+        """One protection-verdict flight bundle (no-op unless a flight
+        directory is configured)."""
+        if _telemetry.resolve_flight_dir(self._config) is None:
+            return
+        with self._lock:
+            faults_copy = copy.deepcopy(self.faults)
+        _telemetry.flight_recorder().protection_dump(
+            verdict, config=self._config, faults=faults_copy,
+            context={"key": key, "group": group,
+                     "error": (f"{type(exc).__name__}: {exc}"[:300]
+                               if exc is not None else ""),
+                     **(extra or {})})
 
     # -- injection -------------------------------------------------------
     def _maybe_inject(self, st: Dict[str, Any]) -> None:
@@ -513,6 +639,16 @@ class LaunchSupervisor:
             spec.fault_class, st["index"], item.key, st["attempt"],
             key=item.key, fault_class=spec.fault_class,
             attempt=st["attempt"])
+        if spec.fault_class == SLOW:
+            # a brownout stalls the launch instead of failing it: the
+            # chaos harness's degraded-device event — journaled like a
+            # fault so soak runs can assert it happened, but the launch
+            # itself proceeds and stays bit-exact
+            self._record_event(item.key, item.group, SLOW, "brownout",
+                               None, st["attempt"])
+            if spec.factor > 0.0:
+                time.sleep(spec.factor)
+            return
         if spec.fault_class == HUNG:
             raise LaunchTimeoutError(
                 item.key, item.group, float(self.launch_timeout_s or 0.0),
@@ -528,12 +664,19 @@ class LaunchSupervisor:
         """Consulted by bisected sub-launches: under a sticky
         (``oom_deep``) fault every sub-range re-fails — single
         candidates included — so the recursion deterministically
-        bottoms out into the per-candidate host path."""
+        bottoms out into the per-candidate host path.  A sticky
+        (``fatal_deep``) fault does the same with FATAL, driving the
+        single-lane range into the quarantine counter."""
         if self._sticky_oom:
             self._count("injected")
             raise InjectedFault(
                 OOM, "RESOURCE_EXHAUSTED: injected sticky OOM on a "
                      f"bisected sub-range of {n_real} candidate(s)")
+        if self._sticky_fatal:
+            self._count("injected")
+            raise InjectedFault(
+                FATAL_DEEP, "injected sticky FATAL on an isolated "
+                            f"sub-range of {n_real} candidate(s)")
 
     # -- watchdog --------------------------------------------------------
     def wait_ready(self, out, key: str = "", group: int = 0):
@@ -602,6 +745,12 @@ class LaunchSupervisor:
                 not self._take_retry_budget(key):
             self._record_event(key, group, TRANSIENT,
                                "retries_exhausted", exc, attempt)
+            self._protection_dump(
+                "retries-exhausted", key, group, exc,
+                extra={"attempt": attempt,
+                       "retries_used": self._retries_used,
+                       "max_launch_retries": self.max_launch_retries,
+                       "max_search_retries": self.max_search_retries})
             _slog.warning(
                 "launch %s: transient fault but retry budget exhausted "
                 "(%d/%d per launch, %d/%d per search)", key,
@@ -698,6 +847,27 @@ class LaunchSupervisor:
                 raise exc
             cls = classify_error(exc)
             if cls == FATAL:
+                if self.quarantine_k and item.bisect is not None:
+                    # poison-candidate isolation: split the range and
+                    # re-run the halves instead of killing the search
+                    # — the fused-range recursion in search/grid.py
+                    # counts single-lane FATALs into quarantine
+                    self._record_event(item.key, item.group, cls,
+                                       "isolate", exc, st["attempt"])
+                    sticky = bool(getattr(exc, "sst_sticky_fatal",
+                                          False))
+                    with self._tracer.span("launch.isolate",
+                                           key=item.key,
+                                           group=item.group):
+                        if sticky:
+                            with self._lock:
+                                self._sticky_fatal += 1
+                        try:
+                            return _Recovered(item.bisect(self))
+                        finally:
+                            if sticky:
+                                with self._lock:
+                                    self._sticky_fatal -= 1
                 # a real bug: propagate unchanged (the search engine's
                 # compiled->host fallback still applies above us)
                 self._record_event(item.key, item.group, cls, "raise",
@@ -765,3 +935,60 @@ class LaunchSupervisor:
             "launch %s: OOM with no bisect/host_fallback hook — "
             "propagating", item.key, key=item.key)
         raise exc
+
+
+# ---------------------------------------------------------------------------
+# Protection block (search_report["protection"])
+# ---------------------------------------------------------------------------
+
+
+def protection_enabled(config) -> bool:
+    """Whether the self-protecting layer is active for this config.
+    False is the exact-no-op escape hatch: no protection block, reports
+    and cv_results_ byte-identical to the pre-protection engine."""
+    return bool(getattr(config, "search_deadline_s", None)) or \
+        str(getattr(config, "partial_results", "raise")
+            or "raise") != "raise" or \
+        str(getattr(config, "admission_mode", "static")
+            or "static") != "static"
+
+
+def protection_block(config, *, deadline_hit: bool = False,
+                     shed: Sequence[Dict[str, Any]] = (),
+                     quarantined: Sequence[Dict[str, Any]] = (),
+                     elapsed_s: float = 0.0) -> Dict[str, Any]:
+    """Render the pinned ``search_report["protection"]`` block (schema:
+    ``obs.metrics.PROTECTION_BLOCK_SCHEMA``).  ``shed`` entries name
+    candidates written to error_score without running (deadline or
+    persistent-fault degradation); ``quarantined`` entries name poison
+    candidates isolated after K single-lane FATALs."""
+    shed = [dict(e) for e in shed]
+    quarantined = [dict(e) for e in quarantined]
+    causes = []
+    if deadline_hit:
+        causes.append("deadline")
+    if quarantined:
+        causes.append("quarantine")
+    if any(e.get("reason") == "fault" for e in shed):
+        causes.append("fault")
+    partial = bool(shed or quarantined)
+    verdict = "complete" if not causes and not partial else \
+        "partial-" + "+".join(causes or ["declared"])
+    return {
+        "enabled": True,
+        "mode": str(getattr(config, "admission_mode", "static")
+                    or "static"),
+        "partial_results": str(getattr(config, "partial_results",
+                                       "raise") or "raise"),
+        "deadline_s": float(getattr(config, "search_deadline_s", 0.0)
+                            or 0.0),
+        "deadline_hit": bool(deadline_hit),
+        "elapsed_s": float(elapsed_s),
+        "partial": partial,
+        "n_candidates_shed": sum(
+            len(e.get("candidates", ())) for e in shed),
+        "n_quarantined": len(quarantined),
+        "shed": shed,
+        "quarantined": quarantined,
+        "verdict": verdict,
+    }

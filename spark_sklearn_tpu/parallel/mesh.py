@@ -298,6 +298,31 @@ class TpuConfig:
     # (1 + frac) — and by more than an absolute 50 ms floor — before
     # a regression is flagged.
     runlog_noise_frac: float = 0.25
+    # ---- self-protecting service (serve/executor.py + search/grid.py) ----
+    # wall-clock deadline (seconds) a search may spend from submit to
+    # finish.  For executor-submitted searches the clock starts at
+    # submit time (queue wait counts); solo fits start it at fit().
+    # None disables the deadline.  On expiry: partial_results decides.
+    search_deadline_s: Optional[float] = None
+    # what a deadline or a persistent degradable fault does to the
+    # search: "raise" (default — SearchDeadlineError / the fault
+    # propagates, exact pre-protection behavior) or "best_effort"
+    # (return cv_results_ with un-run candidates carrying sklearn-exact
+    # error_score semantics and a search_report["protection"] block
+    # naming every shed/quarantined candidate).
+    partial_results: str = "raise"
+    # admission control mode for executor submits: "static" (default —
+    # only the max_concurrent/max_queued slot check, exact PR-12
+    # behavior) or "predictive" (additionally price the search's
+    # ledger-modeled HBM footprint against hbm_budget_bytes and its
+    # queue-wait forecast against search_deadline_s, rejecting with a
+    # machine-readable AdmissionError before any device work).
+    admission_mode: str = "static"
+    # poison-candidate quarantine: when partial_results="best_effort",
+    # a candidate whose chunk has bottomed out to a single lane and
+    # still faults FATAL this many times is quarantined to error_score
+    # instead of killing the search.  Ignored under "raise".
+    quarantine_fatal_k: int = 3
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

@@ -65,9 +65,11 @@ from spark_sklearn_tpu.search.scorers import (
 )
 from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
 from spark_sklearn_tpu.utils.native import fold_masks
+from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.metrics import search_registry
 from spark_sklearn_tpu.obs.trace import get_tracer, search_tracing
+from spark_sklearn_tpu.parallel import faults as _faults
 
 
 import contextlib as _contextlib
@@ -1497,6 +1499,40 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         self._search_metrics = metrics
         self._search_report = metrics.data
 
+        # self-protection context (deadline shed, quarantine, partial-
+        # results degradation — see parallel/faults.py protection_block).
+        # Search-scoped: one ctx spans every halving rung, so the
+        # deadline covers the WHOLE search; the done mask is per-call
+        # (each rung owns fresh result arrays).  protection off -> ctx
+        # is None and every path below is untouched (byte-identical
+        # reports).
+        if _faults.protection_enabled(config):
+            pctx = getattr(self, "_protection_ctx", None)
+            if pctx is None or rung is None or rung.itr == 0:
+                t_dl = None
+                if getattr(config, "search_deadline_s", None):
+                    # the executor stamps the deadline at SUBMIT (queue
+                    # wait spends the budget); a sessionless fit starts
+                    # the clock here
+                    hd = getattr(getattr(_binding, "handle", None),
+                                 "t_deadline", None)
+                    t_dl = hd if hd is not None else (
+                        time.perf_counter()
+                        + float(config.search_deadline_s))
+                pctx = self._protection_ctx = {
+                    "t_start": time.perf_counter(),
+                    "t_deadline": t_dl,
+                    "deadline_hit": False,
+                    "shed": [],
+                    "quarantined": [],
+                }
+            # candidates with written cells: prevalidation failures
+            # already carry error_score, so degradation never
+            # overwrites them
+            pctx["done"] = preval_failed.copy()
+        else:
+            self._protection_ctx = None
+
         # bound peak HBM: chunk each compile group so one launch holds at
         # most max_tasks_per_batch (candidate x fold) program instances;
         # every chunk of a group is padded to one uniform width so the
@@ -1635,6 +1671,20 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 metrics.put("memory", _memledger.report_block(
                     ledger, mem_before,
                     getattr(self, "_memory_ctx", {}) or {}))
+            # the search's protection verdict (deadline/shed/quarantine
+            # state) — schema in obs.metrics.PROTECTION_BLOCK_SCHEMA.
+            # Rendered ONLY when protection is on: off, the report is
+            # byte-identical to the unprotected engine.  A halving
+            # search re-puts each rung; the shared ctx accumulates, so
+            # the last put covers the whole search.
+            pctx_fin = getattr(self, "_protection_ctx", None)
+            if pctx_fin is not None:
+                metrics.put("protection", _faults.protection_block(
+                    config, deadline_hit=pctx_fin["deadline_hit"],
+                    shed=pctx_fin["shed"],
+                    quarantined=pctx_fin["quarantined"],
+                    elapsed_s=time.perf_counter()
+                    - pctx_fin["t_start"]))
         if preval_failed.any():
             # failed fits never ran: sklearn records 0.0 for their times
             fit_times[preval_failed, :] = 0.0
@@ -1776,6 +1826,17 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         from spark_sklearn_tpu import serve as _serve
         binding = _serve.current_binding()
         sched_tenant = binding.tenant if binding is not None else None
+        # self-protection (deadline shed / quarantine / degradation):
+        # None when protection is off — every guarded path below then
+        # collapses to the unprotected engine
+        pctx = getattr(self, "_protection_ctx", None)
+        # the score a protected search writes for work it never ran:
+        # sklearn's numeric error_score, or NaN under error_score=
+        # 'raise' (shed cells are DECLARED in the protection block,
+        # never routed through fit_failed — a deadline is not a failed
+        # fit, and must not trip the all-fits-failed raise)
+        errval = (np.nan if isinstance(self.error_score, str)
+                  else self.error_score)
         # multi-controller runs force depth 0 below; resolved here so
         # the staging ring can size itself to the in-flight window
         depth = config.pipeline_depth if jax.process_count() == 1 else 0
@@ -2508,17 +2569,55 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 return sup.call(attempt, key=key, group=plan["gi"],
                                 n_real=n)
             except Exception as exc:
-                from spark_sklearn_tpu.parallel import faults as _faults
-                if not _faults.is_oom(exc):
+                if _faults.is_oom(exc):
+                    if n <= 1:
+                        return host_fused_range(plan, lo, hi, sup,
+                                                chunk_id)
+                    sup.record_bisection(key, plan["gi"])
+                    from spark_sklearn_tpu.parallel.taskgrid import (
+                        split_range)
+                    lo_, mid, hi_ = split_range(lo, hi)
+                    return merge_fused(
+                        exec_fused_range(plan, lo_, mid, sup, chunk_id),
+                        exec_fused_range(plan, mid, hi_, sup, chunk_id))
+                # poison-candidate quarantine (best_effort only — the
+                # supervisor arms quarantine_k solely under
+                # partial_results='best_effort'): FATAL ranges split
+                # like OOM; a single-lane range that still faults K
+                # times is quarantined to error_score instead of
+                # killing the search
+                if not getattr(sup, "quarantine_k", 0) \
+                        or getattr(exc, "_sst_cancelled", False) \
+                        or _faults.classify_error(exc) != _faults.FATAL:
                     raise
-                if n <= 1:
-                    return host_fused_range(plan, lo, hi, sup, chunk_id)
-                sup.record_bisection(key, plan["gi"])
-                from spark_sklearn_tpu.parallel.taskgrid import split_range
-                lo_, mid, hi_ = split_range(lo, hi)
-                return merge_fused(
-                    exec_fused_range(plan, lo_, mid, sup, chunk_id),
-                    exec_fused_range(plan, mid, hi_, sup, chunk_id))
+                if n > 1:
+                    sup.record_bisection(key, plan["gi"],
+                                         fault_class=_faults.FATAL)
+                    from spark_sklearn_tpu.parallel.taskgrid import (
+                        split_range)
+                    lo_, mid, hi_ = split_range(lo, hi)
+                    return merge_fused(
+                        exec_fused_range(plan, lo_, mid, sup, chunk_id),
+                        exec_fused_range(plan, mid, hi_, sup, chunk_id))
+                n_faults = sup.note_fatal(key)
+                if n_faults < sup.quarantine_k:
+                    return exec_fused_range(plan, lo, hi, sup, chunk_id)
+                sup.record_quarantine(key, plan["gi"], exc, n_faults)
+                if pctx is not None:
+                    pctx["quarantined"].append({
+                        "key": key,
+                        "group": int(plan["gi"]),
+                        "candidates": [
+                            int(i) for i in
+                            plan["group"].candidate_indices[lo:hi]],
+                        "error": f"{type(exc).__name__}: {exc}"[:300],
+                        "n_faults": int(n_faults)})
+                te = {s: np.full((n, n_folds), errval)
+                      for s in scorer_names}
+                tr = ({s: np.full((n, n_folds), errval)
+                       for s in scorer_names} if return_train else {})
+                bad = np.zeros((n, n_folds), bool)
+                return te, tr, bad, -1, -1
 
         def make_bisect_fused(plan, lo, hi, chunk_id):
             def bisect(sup):
@@ -2530,6 +2629,38 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 return merge_fused(
                     exec_fused_range(plan, lo_, mid, sup, chunk_id),
                     exec_fused_range(plan, mid, hi_, sup, chunk_id))
+            return bisect
+
+        # quarantine armed: the first-chunk fit/score items also carry
+        # an isolate hook (below), so a poison candidate in ANY chunk
+        # routes through the fused-range recursion instead of the
+        # whole-search degradation path.  Off (the default), those
+        # items keep exactly their pre-protection shape.
+        quarantine_armed = (
+            pctx is not None
+            and str(getattr(config, "partial_results", "raise")
+                    or "raise") == "best_effort"
+            and int(getattr(config, "quarantine_fatal_k", 3) or 0) > 0)
+
+        def make_bisect_fit(plan, lo, hi, chunk_id, cstate, lanes):
+            inner = make_bisect_fused(plan, lo, hi, chunk_id)
+
+            def bisect(sup):
+                te, tr, bad, im, isum = inner(sup)
+                # the score item consumes the recovered cells instead
+                # of launching (same contract as the OOM host fallback)
+                cstate["host"] = (te, tr)
+                if im >= 0:
+                    record_iters(im, isum, lanes)
+                return np.asarray(bad, bool), None
+            return bisect
+
+        def make_bisect_score(plan, lo, hi, chunk_id):
+            inner = make_bisect_fused(plan, lo, hi, chunk_id)
+
+            def bisect(sup):
+                te, tr, bad, im, isum = inner(sup)
+                return te, tr
             return bisect
 
         def write_cells(plan, idx, lo, hi, chunk_id, te, tr, t_fit,
@@ -2578,6 +2709,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     "fit_t": t_fit / n_real,
                     "score_t": t_score / n_real,
                     "failed": fit_failed[idx, :].tolist()})
+            if pctx is not None:
+                # degradation never overwrites a candidate with real
+                # (or host-recovered) cells
+                pctx["done"][idx] = True
 
         def per_group_rec(plan):
             pg = metrics.struct("per_group")
@@ -2633,6 +2768,47 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             fit_failed[idx, :] |= np.asarray(
                                 rec["failed"], bool)
                         metrics.counter("n_chunks_resumed").inc()
+                        if pctx is not None:
+                            pctx["done"][idx] = True
+                        continue
+                    if pctx is not None and pctx["t_deadline"] is not None \
+                            and time.perf_counter() >= pctx["t_deadline"]:
+                        # deadline expired before this chunk launched
+                        elapsed = (time.perf_counter()
+                                   - pctx["t_start"])
+                        if str(getattr(config, "partial_results",
+                                       "raise") or "raise") \
+                                != "best_effort":
+                            raise _faults.SearchDeadlineError(
+                                float(config.search_deadline_s),
+                                elapsed,
+                                n_remaining=int(
+                                    (~pctx["done"]).sum()))
+                        if not pctx["deadline_hit"]:
+                            pctx["deadline_hit"] = True
+                            _telemetry.note_protection("deadline_hit")
+                            logger.warning(
+                                "search deadline %.3gs expired after "
+                                "%.3fs: shedding the remaining chunks "
+                                "to error_score (partial_results="
+                                "'best_effort')",
+                                float(config.search_deadline_s),
+                                elapsed, chunk=chunk_id)
+                        # un-run candidates carry sklearn's error_score
+                        # with ZERO times (like a fit that never ran) —
+                        # declared in the protection block, NOT routed
+                        # through fit_failed
+                        for s_ in scorer_names:
+                            test_scores[s_][idx, :] = errval
+                            if return_train:
+                                train_scores[s_][idx, :] = errval
+                        fit_times[idx, :] = 0.0
+                        score_times[idx, :] = 0.0
+                        pctx["done"][idx] = True
+                        pctx["shed"].append({
+                            "reason": "deadline", "chunk": chunk_id,
+                            "candidates": [int(i) for i in idx]})
+                        _telemetry.note_protection("shed", len(idx))
                         continue
                     live_seen += 1
                     n_real = (hi - lo) * n_folds
@@ -2801,7 +2977,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         key=chunk_id + ":fit", kind="fit", group=gi,
                         n_tasks=n_real, stage=stage, launch=launch_fit,
                         gather=gather_fit, finalize=fin_fit,
-                        host_fallback=host_fb_fit)
+                        host_fallback=host_fb_fit,
+                        bisect=(make_bisect_fit(plan, lo, hi, chunk_id,
+                                                cstate, lanes)
+                                if quarantine_armed else None))
 
                     def launch_score(payload, plan=plan, cstate=cstate):
                         if "host" in cstate:
@@ -2838,7 +3017,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         key=chunk_id + ":score", kind="score", group=gi,
                         n_tasks=n_real, launch=launch_score,
                         gather=gather_score, finalize=fin_score,
-                        host_fallback=host_fb_score)
+                        host_fallback=host_fb_score,
+                        bisect=(make_bisect_score(plan, lo, hi,
+                                                  chunk_id)
+                                if quarantine_armed else None))
 
                     if calibrate:
                         # calibration: a SECOND, warm score launch (the
@@ -2951,6 +3133,40 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         resumed0 = int(metrics.data.get("n_chunks_resumed", 0))
         try:
             pipe.run(supervisor.wrap(items))
+        except Exception as exc:
+            # graceful degradation: under partial_results='best_effort'
+            # a persistent non-memory fault (retries exhausted, a
+            # watchdog timeout) stops the search WITHOUT killing it —
+            # every candidate still missing cells is declared shed and
+            # written to error_score.  Cancellation, OOM (the bisection
+            # hooks own it) and raise-mode searches propagate
+            # unchanged.
+            degradable = (
+                pctx is not None
+                and str(getattr(config, "partial_results", "raise")
+                        or "raise") == "best_effort"
+                and not getattr(exc, "_sst_cancelled", False)
+                and not _faults.is_oom(exc))
+            if not degradable:
+                raise
+            left = np.flatnonzero(~pctx["done"])
+            for s_ in scorer_names:
+                test_scores[s_][left, :] = errval
+                if return_train:
+                    train_scores[s_][left, :] = errval
+            fit_times[left, :] = 0.0
+            score_times[left, :] = 0.0
+            pctx["done"][left] = True
+            pctx["shed"].append({
+                "reason": "fault",
+                "chunk": None,
+                "candidates": [int(i) for i in left],
+                "error": f"{type(exc).__name__}: {exc}"[:300]})
+            _telemetry.note_protection("shed", len(left))
+            logger.warning(
+                "persistent fault under partial_results='best_effort' "
+                "(%r): %d candidate(s) shed to error_score, the search "
+                "returns declared-partial results", exc, len(left))
         finally:
             # the scheduler's per-search view (queue waits, interleave,
             # measured tenant shares) — zeroed enabled=False shape for

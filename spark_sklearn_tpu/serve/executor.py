@@ -102,8 +102,30 @@ _MAX_DISPATCH_LOG = 4096
 class AdmissionError(RuntimeError):
     """A submission was rejected by admission control: the executor's
     running slots (``max_concurrent_searches``) AND its bounded waiting
-    line (``max_queued_searches``) are full, or the executor is
-    shutting down.  Resubmit later, or raise the limits."""
+    line (``max_queued_searches``) are full, the executor is shutting
+    down, or predictive admission (``TpuConfig.admission_mode=
+    "predictive"``) priced the search out before any device work.
+    Resubmit later, or raise the limits.
+
+    Machine-readable fields: ``reason`` ("queue-full" | "shutdown" |
+    "footprint" | "deadline-unmeetable"), ``retry_after_s`` (a hint,
+    None when resubmitting will not help by itself), ``tenant``, and
+    the queue/slot state at rejection (``n_active`` / ``n_pending`` /
+    ``max_concurrent`` / ``max_queued``)."""
+
+    def __init__(self, message: str, *, reason: str = "",
+                 retry_after_s: Optional[float] = None,
+                 tenant: Optional[str] = None, n_active: int = 0,
+                 n_pending: int = 0, max_concurrent: int = 0,
+                 max_queued: int = 0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+        self.n_active = int(n_active)
+        self.n_pending = int(n_pending)
+        self.max_concurrent = int(max_concurrent)
+        self.max_queued = int(max_queued)
 
 
 class SearchCancelledError(RuntimeError):
@@ -205,6 +227,11 @@ class SearchHandle:
         self.queue_wait_max_s = 0.0
         self.t_start: Optional[float] = None
         self.t_end: Optional[float] = None
+        #: perf_counter instant the search's deadline expires, stamped
+        #: at SUBMIT when TpuConfig.search_deadline_s is set — queue
+        #: wait counts against the budget, and grid's protection
+        #: context reads this instead of starting its own clock
+        self.t_deadline: Optional[float] = None
         #: per-tenant dispatched-cost snapshot at search start — the
         #: window the report's tenant shares are measured over
         self.cost_window_before: Dict[str, int] = {}
@@ -362,6 +389,10 @@ class SearchExecutor:
         self._last_handle: Optional[SearchHandle] = None
         self._cost_by_tenant: Dict[str, int] = {}
         self._dispatch_log: deque = deque(maxlen=_MAX_DISPATCH_LOG)
+        #: recent completed-search walls (seconds) — predictive
+        #: admission's queue-wait forecast divides the waiting line by
+        #: running-slot count and multiplies by the p50 of these
+        self._recent_walls: deque = deque(maxlen=64)
         self._quantum = max(1, int(getattr(config, "scheduler_quantum",
                                            64) or 64))
         self._max_concurrent = max(1, int(getattr(
@@ -385,49 +416,192 @@ class SearchExecutor:
         tenant = tenant or resolve_tenant(cfg)
         weight = weight if weight is not None else resolve_weight(cfg)
         exclusive = self._needs_exclusive(search)
-        with get_tracer().span("serve.submit", tenant=tenant):
-            with self._lock:
-                if self._stop or self._closing:
-                    raise AdmissionError(
-                        "executor is shut down; no new searches")
-                queue_now = bool(self._pending) or not self._can_start_new(
-                    exclusive)
-                if queue_now and len(self._pending) >= self._max_queued:
-                    # reject BEFORE any state mutation: a refused
-                    # submission must not bump the sequence or rewrite
-                    # its tenant's live fair-share weight
-                    raise AdmissionError(
-                        f"admission rejected for tenant {tenant!r}: "
-                        f"{len(self._active)} running (max "
-                        f"{self._max_concurrent}) and "
-                        f"{len(self._pending)} queued (max "
-                        f"{self._max_queued})")
-                self._seq += 1
-                hid = f"{tenant}/s{self._seq}"
-                handle = SearchHandle(hid, tenant, weight,
-                                      exclusive=exclusive)
-                future = SearchFuture(self, handle, search)
-                handle.future = future
-                t = self._tenants.get(tenant)
-                if t is None:
-                    t = self._tenants[tenant] = _Tenant(tenant, weight)
-                else:
-                    t.weight = weight     # latest ADMITTED search wins
-                thunk = self._make_worker(handle, future, search, X, y,
-                                          dict(fit_params or {}))
-                # FIFO honesty: while anything is already waiting, new
-                # arrivals wait behind it — otherwise a pending
-                # exclusive (x64) search could be starved forever by a
-                # stream of immediately-startable submissions
-                if queue_now:
-                    self._pending.append((handle, future, thunk))
-                    logger.info(
-                        "search %s queued (tenant=%s, %d running)",
-                        hid, tenant, len(self._active),
-                        handle=hid, tenant=tenant)
-                    return future
-                self._start_locked(handle, thunk)
-            return future
+        predictive = str(getattr(cfg, "admission_mode", "static")
+                         or "static") == "predictive"
+        deadline_s = getattr(cfg, "search_deadline_s", None)
+        # the footprint check prices the search against the HBM budget
+        # with the memory ledger's model — computed OUTSIDE self._lock
+        # (the ledger has its own lock) and before any state mutation
+        footprint_exc = self._admission_footprint_check(
+            search, X, y, cfg, tenant) if predictive else None
+        try:
+            with get_tracer().span("serve.submit", tenant=tenant):
+                with self._lock:
+                    if self._stop or self._closing:
+                        raise AdmissionError(
+                            "executor is shut down; no new searches",
+                            reason="shutdown", tenant=tenant,
+                            n_active=len(self._active),
+                            n_pending=len(self._pending),
+                            max_concurrent=self._max_concurrent,
+                            max_queued=self._max_queued)
+                    if footprint_exc is not None:
+                        raise footprint_exc
+                    queue_now = bool(self._pending) or \
+                        not self._can_start_new(exclusive)
+                    if queue_now and predictive and deadline_s:
+                        # SLO forecast: a search that would provably
+                        # blow its whole deadline waiting in line is
+                        # refused NOW, not after queueing device-less
+                        # for deadline_s and shedding everything
+                        forecast = self._queue_wait_forecast_locked()
+                        if forecast is not None and \
+                                forecast > float(deadline_s):
+                            raise AdmissionError(
+                                f"admission deferred for tenant "
+                                f"{tenant!r}: forecast queue wait "
+                                f"{forecast:.1f}s exceeds "
+                                f"search_deadline_s={deadline_s:g}s",
+                                reason="deadline-unmeetable",
+                                retry_after_s=round(forecast, 3),
+                                tenant=tenant,
+                                n_active=len(self._active),
+                                n_pending=len(self._pending),
+                                max_concurrent=self._max_concurrent,
+                                max_queued=self._max_queued)
+                    if queue_now and \
+                            len(self._pending) >= self._max_queued:
+                        # reject BEFORE any state mutation: a refused
+                        # submission must not bump the sequence or
+                        # rewrite its tenant's live fair-share weight
+                        raise AdmissionError(
+                            f"admission rejected for tenant {tenant!r}: "
+                            f"{len(self._active)} running (max "
+                            f"{self._max_concurrent}) and "
+                            f"{len(self._pending)} queued (max "
+                            f"{self._max_queued})",
+                            reason="queue-full",
+                            retry_after_s=self._wall_p50_locked(),
+                            tenant=tenant,
+                            n_active=len(self._active),
+                            n_pending=len(self._pending),
+                            max_concurrent=self._max_concurrent,
+                            max_queued=self._max_queued)
+                    self._seq += 1
+                    hid = f"{tenant}/s{self._seq}"
+                    handle = SearchHandle(hid, tenant, weight,
+                                          exclusive=exclusive)
+                    if deadline_s:
+                        # the deadline clock starts at SUBMIT: queue
+                        # wait spends the same budget device time does
+                        handle.t_deadline = time.perf_counter() \
+                            + float(deadline_s)
+                    future = SearchFuture(self, handle, search)
+                    handle.future = future
+                    t = self._tenants.get(tenant)
+                    if t is None:
+                        t = self._tenants[tenant] = _Tenant(tenant,
+                                                            weight)
+                    else:
+                        t.weight = weight  # latest ADMITTED search wins
+                    thunk = self._make_worker(handle, future, search,
+                                              X, y,
+                                              dict(fit_params or {}))
+                    # FIFO honesty: while anything is already waiting,
+                    # new arrivals wait behind it — otherwise a pending
+                    # exclusive (x64) search could be starved forever
+                    # by a stream of immediately-startable submissions
+                    if queue_now:
+                        self._pending.append((handle, future, thunk))
+                        logger.info(
+                            "search %s queued (tenant=%s, %d running)",
+                            hid, tenant, len(self._active),
+                            handle=hid, tenant=tenant)
+                    else:
+                        self._start_locked(handle, thunk)
+        except AdmissionError as exc:
+            # telemetry outside the lock (hook discipline); the
+            # rejection carries its machine-readable reason
+            _telemetry.note_admission("rejected", tenant,
+                                      getattr(exc, "reason", "") or "")
+            raise
+        _telemetry.note_admission("queued" if queue_now else "admitted",
+                                  tenant)
+        return future
+
+    def _admission_footprint_check(self, search, X, y, cfg,
+                                   tenant: str) -> Optional[AdmissionError]:
+        """Predictive admission's HBM pricing: model the search's
+        MINIMUM feasible footprint (broadcast residents + one single-
+        candidate chunk, scaled by the ledger's learned safety margin)
+        and refuse when even that cannot fit ``hbm_budget_bytes`` — no
+        geometry could launch it, so rejecting costs zero device work.
+        Returns the error to raise, or None to admit."""
+        from spark_sklearn_tpu.obs import memory as _obs_memory
+        from spark_sklearn_tpu.parallel import memledger as _memledger
+        ledger = _memledger.ledger_for(cfg)
+        if ledger is None:
+            return None
+        budget = _obs_memory.resolve_hbm_budget(cfg)
+        if not budget:
+            return None
+        grid = getattr(search, "param_grid", None)
+        if not isinstance(grid, dict):
+            grid = getattr(search, "param_distributions", None)
+        if not isinstance(grid, dict) or X is None:
+            return None
+        import numpy as np
+        dyn: Dict[str, Any] = {}
+        for name, vals in grid.items():
+            try:
+                arr = np.asarray(list(vals)
+                                 if not hasattr(vals, "dtype") else vals)
+            # non-materializable values (e.g. scipy distributions)
+            # just mean this param stages nothing predictable — the
+            # admission probe models what it can, never fails a
+            # submit; nothing has launched yet, so the fault taxonomy
+            # does not apply
+            # sstlint: disable=swallowed-exception,launch-except-taxonomy
+            except Exception:
+                continue
+            if arr.dtype.kind in "fiub":
+                dyn[name] = arr[:1]
+        cv = getattr(search, "cv", None)
+        n_folds = cv if isinstance(cv, int) else \
+            int(getattr(cv, "n_splits", 0) or 0) or 5
+        n = int(getattr(X, "shape", (len(X),))[0])
+        fp = _memledger.model_group_footprint(
+            dyn, 1, n_folds, task_batched=True, n_samples=n,
+            return_train=bool(getattr(search, "return_train_score",
+                                      False)))
+        x_bytes = int(getattr(X, "nbytes", 0) or 0)
+        y_bytes = int(getattr(y, "nbytes", 0) or 0)
+        # broadcast residents: X/y replicas + the base fold masks
+        # (train + test, int32) the data plane keeps device-resident
+        resident = x_bytes + y_bytes + 2 * n_folds * n * 4
+        margin = max(1.0, float(getattr(ledger, "safety_margin", 1.0)))
+        modeled = int((resident + fp["chunk_bytes"]) * margin)
+        if modeled <= int(budget):
+            return None
+        with self._lock:
+            state = (len(self._active), len(self._pending))
+        return AdmissionError(
+            f"admission rejected for tenant {tenant!r}: modeled "
+            f"footprint {modeled} byte(s) (residents {resident} + "
+            f"minimum chunk {fp['chunk_bytes']}, margin "
+            f"{margin:.2f}) exceeds hbm_budget_bytes={int(budget)}",
+            reason="footprint", retry_after_s=None, tenant=tenant,
+            n_active=state[0], n_pending=state[1],
+            max_concurrent=self._max_concurrent,
+            max_queued=self._max_queued)
+
+    def _wall_p50_locked(self) -> Optional[float]:
+        # caller holds the lock
+        if not self._recent_walls:
+            return None
+        vals = sorted(self._recent_walls)
+        return round(float(vals[len(vals) // 2]), 3)
+
+    def _queue_wait_forecast_locked(self) -> Optional[float]:
+        """p50-of-recent-walls x the waiting line's depth in running-
+        slot waves — None until at least one search completed (no
+        data beats a wrong forecast)."""
+        p50 = self._wall_p50_locked()
+        if p50 is None:
+            return None
+        waves = -(-(len(self._pending) + 1) // max(
+            1, self._max_concurrent))
+        return p50 * waves
 
     def _needs_exclusive(self, search) -> bool:
         """wants_float64 families flip the process-global jax x64 flag
@@ -538,6 +712,9 @@ class SearchExecutor:
             if handle in self._active:
                 self._active.remove(handle)
             handle.t_end = time.perf_counter()
+            if exc is None and handle.t_start is not None:
+                # completed walls feed the admission SLO forecast
+                self._recent_walls.append(handle.t_end - handle.t_start)
             if exc is None:
                 # includes a cancel that lost the race to a completed
                 # fit: the results are valid, so the future resolves
@@ -630,12 +807,23 @@ class SearchExecutor:
         # bundle the scheduler state + recent events for the postmortem
         # (dir checked FIRST: without one, no state is even copied)
         if _telemetry.resolve_flight_dir(self.config) is not None:
-            _telemetry.flight_recorder().dump(
-                "cancelled", config=self.config,
-                scheduler={**self.stats(),
-                           "dispatch_log": self.dispatch_log()[-256:]},
-                context={"handle": handle.id, "tenant": handle.tenant,
-                         "drained": len(drained)})
+            rec = _telemetry.flight_recorder()
+            sched = {**self.stats(),
+                     "dispatch_log": self.dispatch_log()[-256:]}
+            ctx = {"handle": handle.id, "tenant": handle.tenant,
+                   "drained": len(drained)}
+            if handle.t_deadline is not None and \
+                    time.perf_counter() >= handle.t_deadline:
+                # a cancel AFTER deadline expiry is a protection
+                # verdict, not an operator whim: tag the bundle so the
+                # postmortem tooling groups it with shed/quarantine
+                rec.protection_dump("deadline-expired",
+                                    reason="cancelled",
+                                    config=self.config, scheduler=sched,
+                                    context=ctx)
+            else:
+                rec.dump("cancelled", config=self.config,
+                         scheduler=sched, context=ctx)
         return True
 
     def progress(self, handle: SearchHandle) -> Dict[str, Any]:

@@ -425,6 +425,57 @@ class TestAdmission:
         with pytest.raises(AdmissionError):
             ex.submit(_BlockingSearch(), X, y)
 
+    def test_submit_storm_admits_or_rejects_exactly(self):
+        """N threads racing submit against a 1-running/3-queued
+        executor: every submit either returns a live future or raises
+        a structured AdmissionError — admitted + rejected == N, no
+        lost futures, and the executor still serves work after the
+        storm."""
+        ex = SearchExecutor(sst.TpuConfig(max_concurrent_searches=1,
+                                          max_queued_searches=3))
+        n = 16
+        searches = [_BlockingSearch() for _ in range(n)]
+        admitted, rejected = [], []
+        lock = threading.Lock()
+        barrier = threading.Barrier(n)
+
+        def storm(s):
+            barrier.wait(10)
+            try:
+                fut = ex.submit(s, X, y)
+            except AdmissionError as exc:
+                with lock:
+                    rejected.append(exc)
+            else:
+                with lock:
+                    admitted.append((s, fut))
+
+        threads = [threading.Thread(target=storm, args=(s,))
+                   for s in searches]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not any(t.is_alive() for t in threads)
+        assert len(admitted) + len(rejected) == n
+        # capacity is exact under blocking searches: 1 running + 3
+        # queued admitted, everyone else sheds with machine-readable
+        # queue state
+        assert len(admitted) == 4, (len(admitted), len(rejected))
+        for exc in rejected:
+            assert exc.reason == "queue-full"
+            assert exc.max_concurrent == 1 and exc.max_queued == 3
+        # every admitted search runs to completion once released
+        for s, _ in admitted:
+            s.release.set()
+        for s, fut in admitted:
+            assert fut.result(timeout=60) is s and s.ran
+        # executor survived the storm: a fresh submit completes
+        tail = _BlockingSearch()
+        tail.release.set()
+        assert ex.submit(tail, X, y).result(timeout=30) is tail
+        ex.shutdown()
+
 
 # ---------------------------------------------------------------------------
 # Cancellation

@@ -43,6 +43,10 @@ _WATCHED = (
     ("wall_s_warm", "up"),
     ("halving_speedup", "down"),
     ("store_hit_rate", "down"),
+    # protection actuations in the contended serve leg: a healthy
+    # uncontended-capacity bench admits everything and sheds nothing,
+    # so any increase is a capacity or admission regression
+    ("serve_shed", "up"),
 )
 
 
@@ -64,6 +68,17 @@ def _round_row(path: str) -> Dict[str, Any]:
     hit_rate = None
     if hits is not None and misses is not None and (hits + misses) > 0:
         hit_rate = round(hits / (hits + misses), 4)
+    # deepest contended serve level: shed work (rejected submits +
+    # shed/quarantined candidates) — 0 on a healthy round, None before
+    # the leg recorded admission/protection ledgers
+    serve = det.get("serve_contended") or {}
+    shed = None
+    for key in sorted(k for k in serve if k.startswith("contended_")):
+        adm = serve[key].get("admission")
+        prot = serve[key].get("protection")
+        if adm is not None and prot is not None:
+            shed = (adm.get("rejected", 0) + prot.get("shed", 0)
+                    + prot.get("quarantined", 0))
     return {
         "round": n,
         "rc": payload.get("rc"),
@@ -71,6 +86,7 @@ def _round_row(path: str) -> Dict[str, Any]:
         "wall_s_warm": det.get("wall_s_warm"),
         "halving_speedup": ha.get("wall_ratio_exhaustive_over_halving"),
         "store_hit_rate": hit_rate,
+        "serve_shed": shed,
         "parsed": bool(det),
     }
 
@@ -99,7 +115,16 @@ def compare_last_two(rows: List[Dict[str, Any]],
     deltas: Dict[str, Any] = {}
     for key, direction in _WATCHED:
         a, b = prev.get(key), last.get(key)
-        if a is None or b is None or a == 0:
+        if a is None or b is None:
+            continue
+        if a == 0:
+            # absolute counters (serve_shed): the healthy value IS
+            # zero, so any move off it in the regressing direction is
+            # a step change, not a percentage
+            if direction == "up" and b > 0:
+                flags.append({"metric": key, "prev": a, "last": b,
+                              "change_pct": float("inf"),
+                              "direction": direction})
             continue
         change_pct = round(100.0 * (b - a) / abs(a), 2)
         deltas[key] = change_pct
@@ -133,13 +158,14 @@ def _fmt(v: Any, nd: int = 2) -> str:
 
 def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
-           f"{'halving x':>10} {'hit rate':>9}"]
+           f"{'halving x':>10} {'hit rate':>9} {'shed':>6}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
             f"{_fmt(r['wall_s_cold']):>9} {_fmt(r['wall_s_warm']):>9} "
             f"{_fmt(r['halving_speedup']):>10} "
-            f"{_fmt(r['store_hit_rate']):>9}"
+            f"{_fmt(r['store_hit_rate']):>9} "
+            f"{_fmt(r.get('serve_shed'), 0):>6}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

@@ -120,6 +120,23 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
             "publishes, "
             f"{ps.get('quarantine_total', ps.get('quarantined', 0))} "
             "quarantined")
+    prot = snap.get("protection") or {}
+    if any(prot.get(k) for k in ("admitted_total", "queued_total",
+                                 "rejected_total", "shed_total",
+                                 "quarantined_total",
+                                 "deadline_hits_total")):
+        by_reason = ", ".join(
+            f"{k}={v}" for k, v in sorted(
+                (prot.get("rejected_by_reason") or {}).items()))
+        line = (f"protection: {prot.get('admitted_total', 0)} admitted "
+                f"/ {prot.get('queued_total', 0)} deferred "
+                f"/ {prot.get('rejected_total', 0)} shed at admission"
+                + (f" ({by_reason})" if by_reason else ""))
+        line += (f"; {prot.get('shed_total', 0)} candidate(s) shed, "
+                 f"{prot.get('quarantined_total', 0)} quarantined, "
+                 f"{prot.get('deadline_hits_total', 0)} deadline "
+                 "hit(s)")
+        out.append(line)
     faults = snap.get("faults") or {}
     if faults.get("total"):
         by_cls = ", ".join(f"{k}={v}" for k, v in sorted(

@@ -124,7 +124,9 @@ class Project:
                             "faults.LaunchSupervisor._lock",
                             cls="LaunchSupervisor",
                             attrs=("faults", "_retries_used",
-                                   "_sticky_oom", "_oom_dumped")),
+                                   "_sticky_oom", "_oom_dumped",
+                                   "_sticky_fatal", "_fatal_counts",
+                                   "_fatal_dumped")),
                 # taskgrid: the geometry plan cache + cost model
                 SharedState("parallel/taskgrid.py",
                             "taskgrid._PLAN_CACHE_LOCK",
@@ -150,7 +152,7 @@ class Project:
                             attrs=("_tenants", "_active", "_pending",
                                    "_workers", "_rr", "_seq",
                                    "_last_handle", "_cost_by_tenant",
-                                   "_dispatch_log")),
+                                   "_dispatch_log", "_recent_walls")),
                 # dataplane: per-tenant quota/usage accounting
                 SharedState("parallel/dataplane.py",
                             "dataplane.DataPlane._lock", cls="DataPlane",
@@ -174,6 +176,8 @@ class Project:
                                    "_faults_by_action", "_h2d",
                                    "_h2d_window", "_ps_events",
                                    "_regression",
+                                   "_admission", "_admission_reasons",
+                                   "_protection",
                                    "_providers", "_polls",
                                    "_n_samples")),
                 # obs/telemetry: the always-on flight-recorder ring
@@ -243,6 +247,10 @@ class Project:
                 BlockSpec("telemetry", "TELEMETRY_SNAPSHOT_SCHEMA", (
                     Producer("dict-keys", "obs/telemetry.py",
                              "TelemetryService.snapshot"),
+                )),
+                BlockSpec("protection", "PROTECTION_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/faults.py",
+                             "protection_block"),
                 )),
             ),
             launch_paths=(
