@@ -825,7 +825,17 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
     run first warms every program, so the contended levels measure
     scheduling, not compilation.  Telemetry is on for the session, so
     each level also records its admission ledger (admitted / deferred
-    / rejected deltas) and the protection-actuation counters."""
+    / rejected deltas) and the protection-actuation counters.
+
+    Cross-search launch fusion rides the main session (identical-shape
+    tenants coalesce into wide launches), so each level also records
+    the fusion ledger — fused dispatches, launches saved, the lane
+    exchange, padded-lane waste — and a second ``fusion=False`` session
+    replays every level as the A/B arm.  The searches/min ratio is the
+    headline fusion win on lane-parallel devices; on a CPU host vmap
+    lanes compute serially, so the expected A/B there is parity within
+    noise while the ledger proves the coalescing (n_fused > 0, saved
+    launches, zero padding regression)."""
     import numpy as np
     from sklearn.datasets import load_digits
     from sklearn.linear_model import LogisticRegression
@@ -839,8 +849,14 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
     grid = {"C": np.logspace(-3, 2, n_candidates).tolist()}
 
     def search(tenant=None):
+        # pinned chunk geometry (identical in both A/B arms): the
+        # auto-planner re-tunes width per shape and box, which would
+        # make the fused widths combination-dependent and the
+        # searches/min trend column incomparable across rounds.  With
+        # 16-lane chunks the session-wide width set is exactly
+        # {16 solo, 32 fused} (fusion_max_width below).
         cfg = sst.TpuConfig(compilation_cache_dir=cache_dir,
-                            tenant=tenant)
+                            tenant=tenant, max_tasks_per_batch=16)
         return sst.GridSearchCV(LogisticRegression(max_iter=max_iter),
                                 grid, cv=folds, refit=False,
                                 backend="tpu", config=cfg)
@@ -855,10 +871,24 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
     def prot_counters():
         return tel.get_telemetry().snapshot()["protection"]
 
+    def fuse_counters():
+        return tel.get_telemetry().snapshot()["fusion"]
+
     # ephemeral-port telemetry: the admission/protection counters this
-    # leg records are the ones tools/fleet_top.py renders in production
+    # leg records are the ones tools/fleet_top.py renders in production.
+    # fusion_window_ms=0 measures pure opportunistic coalescing —
+    # already-queued peers fuse in the claim pass regardless of the
+    # window, while a hold would tax peerless tail chunks with dead
+    # time (on a CPU host that tax is unrecoverable: lanes compute
+    # serially).  fusion_max_width pins fused launches to ONE doubling
+    # of the solo width, so the warm pass compiles the single possible
+    # fused program deterministically — unbounded member counts would
+    # make the measured pass eat first-encounter compiles of
+    # combination-dependent widths.
     sess = sst.createLocalTpuSession(
-        "bench-serve", config=sst.TpuConfig(telemetry_port=0))
+        "bench-serve", config=sst.TpuConfig(telemetry_port=0,
+                                            fusion_window_ms=0.0,
+                                            fusion_max_width=32))
     out = {"shape": f"digits[{n_rows}], {n_candidates} C x {folds} "
                     f"folds per search"}
     try:
@@ -867,13 +897,27 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
         out["solo_wall_s"] = round(time.perf_counter() - t0, 2)
         for k in levels:
             searches = [search(tenant=f"tenant{i}") for i in range(k)]
+            # warm the COALESCED widths too: the solo warm-up only
+            # compiled solo-width programs, and the measured pass must
+            # capture scheduling, not the fused widths' first-encounter
+            # compiles (the fusion-off arm's widths are already warm by
+            # construction, so this keeps the A/B symmetric).  Two
+            # passes, because which members coalesce varies run to run
+            # and each distinct fused width is its own program.
+            for _ in range(2):
+                warm = [sess.submit(search(tenant=f"tenant{i}"), X, y)
+                        for i in range(k)]
+                for f in warm:
+                    f.result()
             p0 = prot_counters()
+            fu0 = fuse_counters()
             t0 = time.perf_counter()
             futs = [sess.submit(s, X, y) for s in searches]
             for f in futs:
                 f.result()
             wall = time.perf_counter() - t0
             p1 = prot_counters()
+            fu1 = fuse_counters()
             # per-tenant data-plane residency (DataPlane.tenant_usage_
             # all): the SLO view used to show queue-wait/throughput but
             # silently omit residency, leaving quota-pressure
@@ -925,9 +969,58 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                         if s.search_report.get(
                             "protection", {}).get("partial")),
                 },
+                # the fusion ledger: scheduler-block counters summed
+                # over the level's searches, padded-lane waste from the
+                # telemetry family delta (what the fused launches
+                # actually burned over their real rows)
+                "fusion": {
+                    "n_fused": sum(
+                        s.search_report["scheduler"].get("n_fused", 0)
+                        for s in searches),
+                    "saved_launches": sum(
+                        s.search_report["scheduler"].get(
+                            "fusion_saved_launches", 0)
+                        for s in searches),
+                    "lanes_donated": sum(
+                        s.search_report["scheduler"].get(
+                            "lanes_donated", 0) for s in searches),
+                    "lanes_borrowed": sum(
+                        s.search_report["scheduler"].get(
+                            "lanes_borrowed", 0) for s in searches),
+                    "padded_lane_waste": (
+                        (fu1["lanes_padded_total"]
+                         - fu1["lanes_real_total"])
+                        - (fu0["lanes_padded_total"]
+                           - fu0["lanes_real_total"])),
+                },
             }
     finally:
         sess.stop()
+    # the A/B arm: same shapes, same levels, fusion OFF — padding is
+    # paid per search and every chunk launches alone, so the
+    # searches/min ratio isolates what coalescing bought
+    sess_off = sst.createLocalTpuSession(
+        "bench-serve-nofuse",
+        config=sst.TpuConfig(telemetry_port=0, fusion=False))
+    try:
+        sess_off.submit(search(), X, y).result()
+        for k in levels:
+            searches = [search(tenant=f"tenant{i}") for i in range(k)]
+            t0 = time.perf_counter()
+            futs = [sess_off.submit(s, X, y) for s in searches]
+            for f in futs:
+                f.result()
+            wall = time.perf_counter() - t0
+            blk = out[f"contended_{k}"]
+            blk["fusion_off"] = {
+                "wall_s": round(wall, 2),
+                "searches_per_min": round(60.0 * k / wall, 2),
+            }
+            off = blk["fusion_off"]["searches_per_min"]
+            blk["fusion_searches_per_min_ratio"] = round(
+                blk["searches_per_min"] / off, 4) if off else None
+    finally:
+        sess_off.stop()
     return out
 
 

@@ -32,7 +32,8 @@ echo "== lock-order recorder shard (SST_LOCKCHECK=1) =="
 SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
     tests/test_halving.py tests/test_memory.py tests/test_sstlint.py \
-    tests/test_doctor.py tests/test_protection.py -q
+    tests/test_doctor.py tests/test_protection.py \
+    tests/test_fusion.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -196,6 +197,64 @@ print("serve smoke:",
                             "queue_wait_s")},
       {k: schb[k] for k in ("n_dispatches", "interleave_frac",
                             "queue_wait_s")})
+PY
+
+echo "== fusion smoke (two tenants' same-shape searches, one wide launch) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+grid_a = {"C": np.logspace(-2, 1, 40).tolist()}
+grid_b = {"C": np.logspace(-3, 2, 40).tolist()}
+cfg = sst.TpuConfig(max_tasks_per_batch=16, fusion_window_ms=200.0)
+
+
+def make(grid, tenant):
+    return sst.GridSearchCV(
+        LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+        backend="tpu",
+        config=sst.TpuConfig(max_tasks_per_batch=16, tenant=tenant,
+                             fusion_window_ms=200.0))
+
+
+# solo references first: fused members must stay bit-exact with them
+ref_a = make(grid_a, "ta").fit(X, y)
+ref_b = make(grid_b, "tb").fit(X, y)
+
+sess = sst.createLocalTpuSession("fusion-smoke", config=cfg)
+# pause until both tenants have a same-program chunk queued, so the
+# first dispatch provably coalesces them into ONE device launch
+sess.executor.pause()
+fa = sess.submit(make(grid_a, "ta"), X, y)
+fb = sess.submit(make(grid_b, "tb"), X, y)
+t0 = time.time()
+while sess.executor.queued_count() < 2 and time.time() - t0 < 60:
+    time.sleep(0.01)
+sess.executor.resume()
+a, b = fa.result(timeout=300), fb.result(timeout=300)
+sess.stop()
+np.testing.assert_array_equal(a.cv_results_["mean_test_score"],
+                              ref_a.cv_results_["mean_test_score"])
+np.testing.assert_array_equal(b.cv_results_["mean_test_score"],
+                              ref_b.cv_results_["mean_test_score"])
+scha, schb = a.search_report["scheduler"], b.search_report["scheduler"]
+assert scha["n_fused"] + schb["n_fused"] > 0, (scha, schb)
+assert scha["fusion_saved_launches"] + \
+    schb["fusion_saved_launches"] > 0, (scha, schb)
+# the lane exchange is conserved: donated == borrowed across members
+assert scha["lanes_donated"] + schb["lanes_donated"] == \
+    scha["lanes_borrowed"] + schb["lanes_borrowed"], (scha, schb)
+print("fusion smoke:",
+      {k: scha[k] + schb[k] for k in
+       ("n_fused", "fusion_saved_launches", "lanes_donated",
+        "lanes_borrowed")})
 PY
 
 echo "== fleet telemetry smoke (endpoint + per-tenant SLOs + flight recorder) =="

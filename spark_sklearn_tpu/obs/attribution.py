@@ -341,6 +341,18 @@ def attribution_block(report: Dict[str, Any], wall_s: float,
     dominant = _dominant(lanes)
     verdict = _verdict(lanes, float(wall_s), dominant, n_compiles,
                        compile_source, len(launches))
+    # cross-search fusion note: when the scheduler fused this search's
+    # chunks into shared launches, name the lane exchange and where
+    # the scatter cost lands — fused result slicing is lazy device
+    # slicing materialized at gather, so its overhead rides gather_s
+    sched = report.get("scheduler") or {}
+    n_fused = int(sched.get("n_fused", 0) or 0)
+    if n_fused > 0:
+        verdict += (
+            f" [{n_fused} chunk(s) rode cross-search fused launches "
+            f"(lanes borrowed {int(sched.get('lanes_borrowed', 0) or 0)},"
+            f" donated {int(sched.get('lanes_donated', 0) or 0)}); "
+            "per-member scatter overhead rides the gather lane]")
     rungs = _rung_records(report.get("halving") or {}, launches,
                           spans, epoch_s, waste)
     return {

@@ -282,6 +282,35 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
            prot.get("deadline_hits_total"), mtype="counter",
            help_text="Searches whose search_deadline_s expired "
                      "mid-run.")
+    fus = snap.get("fusion") or {}
+    ln.add("sst_fusion_launches_total", fus.get("fused_total"),
+           mtype="counter",
+           help_text="Fused launches executed (one wide device program "
+                     "serving several searches' same-program chunks).")
+    ln.add("sst_fusion_members_total", fus.get("members_total"),
+           mtype="counter",
+           help_text="Member chunks that rode fused launches.")
+    ln.add("sst_fusion_saved_launches_total",
+           fus.get("saved_launches_total"), mtype="counter",
+           help_text="Device launches avoided by fusion "
+                     "(members - 1 per fused launch).")
+    ln.add("sst_fusion_lanes_real_total", fus.get("lanes_real_total"),
+           mtype="counter",
+           help_text="Real candidate lanes carried by fused launches.")
+    ln.add("sst_fusion_lanes_padded_total",
+           fus.get("lanes_padded_total"), mtype="counter",
+           help_text="Padded widths of fused launches (padded - real = "
+                     "fleet-wide padding waste).")
+    for tenant, n in (fus.get("lanes_borrowed_by_tenant") or {}).items():
+        ln.add("sst_fusion_lanes_borrowed_total", n,
+               labels={"tenant": str(tenant)}, mtype="counter",
+               help_text="Real lanes each tenant ran on fused launches "
+                         "led by another search.")
+    for tenant, n in (fus.get("lanes_donated_by_tenant") or {}).items():
+        ln.add("sst_fusion_lanes_donated_total", n,
+               labels={"tenant": str(tenant)}, mtype="counter",
+               help_text="Real lanes other tenants ran on fused "
+                         "launches this tenant led.")
     flight = snap.get("flight") or {}
     ln.add("sst_flight_records_total", flight.get("n_records"),
            mtype="counter",

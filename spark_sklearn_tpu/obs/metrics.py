@@ -468,6 +468,23 @@ SCHEDULER_BLOCK_SCHEMA = (
               "stamped so merged samples from concurrent searches "
               "still attribute; bench derives PER-TENANT p50/p95 "
               "from it)."),
+    MetricDef("n_fused", "counter",
+              "Chunks of this search that rode a cross-search fused "
+              "launch (one wide device program serving several "
+              "tenants' same-program chunks).  Present only when "
+              "fusion is enabled (TpuConfig.fusion / SST_FUSION)."),
+    MetricDef("lanes_donated", "counter",
+              "Real candidate lanes OTHER searches ran on fused "
+              "launches this search led.  Present only when fusion "
+              "is enabled."),
+    MetricDef("lanes_borrowed", "counter",
+              "Real candidate lanes this search ran on fused launches "
+              "led by ANOTHER search.  Present only when fusion is "
+              "enabled."),
+    MetricDef("fusion_saved_launches", "counter",
+              "Device launches avoided by fused launches this search "
+              "led (members - 1 per fused launch).  Present only when "
+              "fusion is enabled."),
 )
 
 
@@ -632,7 +649,10 @@ ATTRIBUTION_BLOCK_SCHEMA = (
               "The one-line human judgment: dominant cause, its "
               "share, and the remedy the lane implies (e.g. "
               "'compile-bound: 61% of wall in 9 traced builds; a "
-              "prewarmed program store would recover ~5.2s')."),
+              "prewarmed program store would recover ~5.2s').  When "
+              "the search's chunks rode cross-search fused launches "
+              "a bracketed note names the lane exchange and that "
+              "per-member scatter overhead rides the gather lane."),
     MetricDef("rungs", "series",
               "Halving searches only: one record per rung — iter, "
               "wall_s and the same lane decomposition computed over "
@@ -762,6 +782,12 @@ TELEMETRY_SNAPSHOT_SCHEMA = (
               "reason), candidates shed, poison candidates "
               "quarantined and deadline expiries — also rendered as "
               "the sst_protection_* Prometheus family."),
+    MetricDef("fusion", "struct",
+              "Cross-search launch-fusion totals: fused launches, "
+              "member chunks, saved launches, real vs padded lanes, "
+              "and the per-tenant lane exchange (lanes borrowed on "
+              "peers' launches / donated to peers) — also rendered "
+              "as the sst_fusion_* Prometheus family."),
     MetricDef("flight", "struct",
               "Flight-recorder state: records seen, ring occupancy, "
               "black-box bundles dumped."),

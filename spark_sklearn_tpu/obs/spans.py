@@ -143,6 +143,10 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
     SpanDef("sched.dispatch", "span", "serve.executor",
             "One routed chunk launch enqueued on the shared "
             "sst-dispatch loop (carries tenant, handle, cost)."),
+    SpanDef("sched.fuse", "span", "serve.executor",
+            "One fused launch: same-key chunks from several searches "
+            "coalesced into a single wide device program (carries "
+            "n_members, lanes, cost)."),
     # obs/telemetry.py
     SpanDef("telemetry.sample", "span", "obs.telemetry",
             "One fleet-telemetry sampler tick (provider polls)."),

@@ -75,6 +75,7 @@ __all__ = [
     "note_admission",
     "note_dispatch",
     "note_fault",
+    "note_fusion",
     "note_h2d",
     "note_launch",
     "note_programstore",
@@ -370,6 +371,13 @@ def _zero_regression() -> Dict[str, Any]:
             "last_family": "", "last_flags": []}
 
 
+def _zero_fusion() -> Dict[str, int]:
+    """The fusion block's zeroed counters (no fused launches yet)."""
+    return {"fused_total": 0, "members_total": 0,
+            "saved_launches_total": 0, "lanes_real_total": 0,
+            "lanes_padded_total": 0}
+
+
 class TelemetryService:
     """The process-global aggregator behind the fleet endpoint.
 
@@ -418,6 +426,12 @@ class TelemetryService:
         #: protection actuations: candidates shed, poison candidates
         #: quarantined, deadlines expired
         self._protection: Dict[str, int] = {}
+        #: cross-search launch fusion: fused-launch totals plus the
+        #: per-tenant lane exchange (the head tenant "donates" the
+        #: launch it leads; peers "borrow" lanes on it)
+        self._fusion: Dict[str, int] = _zero_fusion()
+        self._fusion_borrowed: Dict[str, int] = {}
+        self._fusion_donated: Dict[str, int] = {}
         #: provider name -> STACK of zero-arg callables returning a
         #: JSON-able dict; the newest registration is polled, and
         #: unregistering it restores the previous one — so two
@@ -531,6 +545,9 @@ class TelemetryService:
             self._admission.clear()
             self._admission_reasons.clear()
             self._protection.clear()
+            self._fusion = _zero_fusion()
+            self._fusion_borrowed.clear()
+            self._fusion_donated.clear()
             self._polls.clear()
             self._n_samples = 0
 
@@ -693,6 +710,29 @@ class TelemetryService:
             self._protection[kind] = self._protection.get(kind, 0) \
                 + int(n)
 
+    def note_fusion(self, tenant: str, n_members: int, lanes_total: int,
+                    lanes_real: int, saved_launches: int,
+                    borrowed: Optional[Dict[str, int]] = None) -> None:
+        """Cross-search fusion feed (serve/executor.py): one fused
+        launch — ``tenant`` led it (donating its launch slot), the
+        ``borrowed`` map records how many real lanes each peer tenant
+        rode along with."""
+        if not self.enabled:
+            return
+        borrowed = dict(borrowed or {})
+        with self._lock:
+            self._fusion["fused_total"] += 1
+            self._fusion["members_total"] += int(n_members)
+            self._fusion["saved_launches_total"] += int(saved_launches)
+            self._fusion["lanes_real_total"] += int(lanes_real)
+            self._fusion["lanes_padded_total"] += int(lanes_total)
+            donated = sum(int(v) for v in borrowed.values())
+            self._fusion_donated[tenant] = \
+                self._fusion_donated.get(tenant, 0) + donated
+            for name, n in borrowed.items():
+                self._fusion_borrowed[name] = \
+                    self._fusion_borrowed.get(name, 0) + int(n)
+
     def note_regression(self, status: str, family: str,
                         flags: Optional[List[Dict[str, Any]]] = None,
                         ) -> None:
@@ -847,6 +887,15 @@ class TelemetryService:
                     "deadline_hit", 0),
             }
 
+    def _fusion_block(self) -> Dict[str, Any]:
+        with self._lock:
+            block: Dict[str, Any] = dict(self._fusion)
+            block["lanes_borrowed_by_tenant"] = dict(
+                sorted(self._fusion_borrowed.items()))
+            block["lanes_donated_by_tenant"] = dict(
+                sorted(self._fusion_donated.items()))
+            return block
+
     def snapshot(self) -> Dict[str, Any]:
         """The whole telemetry state as one JSON-able dict.  Top-level
         keys are pinned in ``obs.metrics.TELEMETRY_SNAPSHOT_SCHEMA``;
@@ -869,6 +918,7 @@ class TelemetryService:
                 "faults": self._faults_block(),
                 "regression": self._regression_block(),
                 "protection": self._protection_block(),
+                "fusion": self._fusion_block(),
                 "flight": _FLIGHT.stats(),
             }
 
@@ -912,6 +962,14 @@ def note_h2d(nbytes: int) -> None:
 def note_programstore(event: str) -> None:
     if _GLOBAL.enabled:
         _GLOBAL.note_programstore(event)
+
+
+def note_fusion(tenant: str, n_members: int, lanes_total: int,
+                lanes_real: int, saved_launches: int,
+                borrowed: Optional[Dict[str, int]] = None) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_fusion(tenant, n_members, lanes_total, lanes_real,
+                            saved_launches, borrowed)
 
 
 def note_regression(status: str, family: str,

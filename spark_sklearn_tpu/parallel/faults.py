@@ -380,6 +380,19 @@ _block_until_ready = jax.block_until_ready
 #: cap on per-search recovery-event records kept in the report
 _MAX_EVENTS = 64
 
+#: process-wide mutex serializing recovery relaunches across
+#: concurrently-recovering searches.  A fused-launch fault scatters to
+#: every member, so member supervisors routinely bisect at the same
+#: moment on their own threads; steady-state launches serialize on the
+#: executor's dispatch loop, which makes these recovery attempts the
+#: only same-instant device entry from multiple host threads — a
+#: combination observed to wedge the CPU backend (both threads parked
+#: inside execute, zero progress).  The device is serial anyway, so
+#: holding this across an attempt costs recovery nothing, and the loop
+#: never takes it: one tenant's recovery still cannot stall another's
+#: steady-state dispatch.
+_RECOVERY_EXEC_LOCK = named_lock("faults._RECOVERY_EXEC_LOCK")
+
 
 class LaunchSupervisor:
     """Wrap the search's ``LaunchItem`` stream with retry / bisection /
@@ -777,10 +790,12 @@ class LaunchSupervisor:
                 if n_real is not None:
                     self.inject_subrange(n_real)
                 if attempt == 0:
-                    return fn()
+                    with _RECOVERY_EXEC_LOCK:
+                        return fn()
                 with self._tracer.span("launch.retry", key=key,
                                        group=group, attempt=attempt):
-                    return fn()
+                    with _RECOVERY_EXEC_LOCK:
+                        return fn()
             except Exception as exc:
                 if getattr(exc, "_sst_cancelled", False):
                     # a cancelled search (serve.SearchCancelledError) is

@@ -323,6 +323,23 @@ class TpuConfig:
     # still faults FATAL this many times is quarantined to error_score
     # instead of killing the search.  Ignored under "raise".
     quarantine_fatal_k: int = 3
+    # ---- cross-search launch fusion (serve/executor.py + parallel/pipeline.py) ----
+    # coalesce same-program chunks from different concurrent searches
+    # into one wide device launch (results scattered back per tenant,
+    # bit-identical to each member's solo launch).  None defers to
+    # SST_FUSION, then True.  False is the exact escape hatch: the
+    # scheduler dispatches every chunk solo, byte-identical reports.
+    fusion: Optional[bool] = None
+    # how long (milliseconds) the dispatch loop holds a fusable chunk
+    # at the head of the queue waiting for a same-program peer from
+    # another search before launching it solo.  None defers to
+    # SST_FUSION_WINDOW_MS, then 5.0.
+    fusion_window_ms: Optional[float] = None
+    # cap on a fused launch's total candidate width (real lanes across
+    # all members, before padding).  None defers to
+    # SST_FUSION_MAX_WIDTH, then 0 = bounded only by the member plans'
+    # own width caps.
+    fusion_max_width: Optional[int] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

@@ -1,0 +1,140 @@
+"""Launch-ownership protocol — who owns a launch's scoped resources.
+
+ROADMAP open item 3 ("one launch, many journals/supervisors") needs a
+name for the thing that owns launch-scoped state ACROSS the pipeline
+seam: the chunk-id namespace journal lines resume under, the shared
+pipeline and counter baselines a multi-rung search accumulates into,
+and — since cross-search launch fusion — the member set of one device
+program serving several searches' chunks at once.
+
+Before this module that contract was duck typing: ``search/halving.py``
+stuffed a ``_RungContext`` onto the search object and ``search/grid.py``
+probed ``getattr(self, "_rung_ctx", None)`` for whatever attributes it
+hoped were there.  Now the contract is explicit:
+
+  - :class:`LaunchOwner` is the base type.  It declares the attributes
+    the engine (grid) reads from an attached owner, with inert
+    defaults, so a new owner kind cannot silently miss part of the
+    contract — and ``isinstance`` replaces attribute-probing.
+  - :func:`attach_owner` / :func:`detach_owner` / :func:`current_owner`
+    are the ONLY way owners travel on a search object.  halving
+    attaches its rung context around the rung loop; grid consults
+    ``current_owner`` instead of a private attribute it does not own.
+  - ``parallel/pipeline.py``'s :class:`~spark_sklearn_tpu.parallel.
+    pipeline.FusedLaunch` is the other owner kind: ONE launch whose
+    members keep their own journals and fault supervisors — the
+    scatter side of cross-search fusion (``serve/executor.py``).
+
+Deliberately import-light (stdlib only): halving, grid, pipeline and
+the executor all import this module, so it must never pull jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "LaunchOwner",
+    "attach_owner",
+    "current_owner",
+    "detach_owner",
+]
+
+#: the single, documented attribute owners travel on (set/cleared only
+#: through attach_owner/detach_owner below)
+_ATTR = "_launch_owner"
+
+
+class LaunchOwner:
+    """Base of the launch-ownership protocol.
+
+    An owner is the object holding launch-scoped resources that outlive
+    (or span) individual ``LaunchItem``s:
+
+      - a halving **rung context** owns the whole multi-rung search's
+        shared pipeline, report registry and counter baselines, plus
+        the per-rung chunk-id namespace (``ns``) journal lines resume
+        under;
+      - a **fused launch** owns one device program executing several
+        searches' chunks — each member keeps its own journal lines and
+        fault supervisor (one launch, many journals/supervisors).
+
+    The class attributes below are the contract ``grid._run_groups``
+    reads from an attached owner; subclasses override what they mean.
+    ``kind`` names the owner flavor for logs and tests.
+    """
+
+    kind: str = "owner"
+    #: chunk-id namespace prefix ("" = the search's root namespace)
+    ns: str = ""
+    #: rung/iteration index (0 for single-shot owners)
+    itr: int = 0
+    #: budgeted resource name (halving), "" when not resource-scoped
+    resource: str = ""
+    #: mid-search geometry re-planning enabled for this owner
+    replan: bool = False
+    min_rung_width: int = 0
+    n_resources: int = 0
+    #: shared cross-rung resources (None = per-call, grid's default)
+    pipeline: Any = None
+    registry: Any = None
+    #: counter baselines shared across the owner's scope
+    cache0: Any = None
+    builds0: Any = None
+    dp_before: Any = None
+    ps_before: Any = None
+    mem_before: Any = None
+    #: per-scope bookkeeping grid accumulates into
+    planned_total: int = 0
+    launches_seen: int = 0
+    prev_pipe_wall: float = 0.0
+    lanes_reclaimed_total: int = 0
+
+    def members(self) -> List["LaunchOwner"]:
+        """The owners sharing this launch scope (a fused launch returns
+        its member specs; scalar owners return themselves)."""
+        return [self]
+
+
+def attach_owner(search: Any, owner: LaunchOwner) -> LaunchOwner:
+    """Attach ``owner`` to ``search`` for the duration of its scope.
+    Rejects non-:class:`LaunchOwner` objects — the protocol is explicit
+    now, never duck-typed — and nested attachment (an owner must be
+    detached before the next one attaches)."""
+    if not isinstance(owner, LaunchOwner):
+        raise TypeError(
+            f"launch owner must be a LaunchOwner, got "
+            f"{type(owner).__name__} (the duck-typed _rung_ctx seam "
+            "was replaced by parallel/ownership.py)")
+    if getattr(search, _ATTR, None) is not None:
+        raise RuntimeError(
+            f"search already has an attached {current_owner(search).kind}"
+            " owner; detach_owner() it before attaching another")
+    setattr(search, _ATTR, owner)
+    return owner
+
+
+def detach_owner(search: Any) -> Optional[LaunchOwner]:
+    """Clear and return the search's attached owner (None if none)."""
+    owner = getattr(search, _ATTR, None)
+    if owner is not None:
+        setattr(search, _ATTR, None)
+    return owner
+
+
+def current_owner(search: Any,
+                  kind: Optional[str] = None) -> Optional[LaunchOwner]:
+    """The owner attached to ``search`` (optionally filtered by
+    ``kind``), or None.  This is the engine's read side: grid consults
+    it where it used to probe the private ``_rung_ctx`` attribute."""
+    owner = getattr(search, _ATTR, None)
+    if owner is None:
+        return None
+    if not isinstance(owner, LaunchOwner):
+        raise TypeError(
+            f"search carries a non-protocol launch owner "
+            f"({type(owner).__name__}); attach it through "
+            "parallel/ownership.attach_owner")
+    if kind is not None and owner.kind != kind:
+        return None
+    return owner

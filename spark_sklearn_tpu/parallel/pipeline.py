@@ -56,12 +56,15 @@ from spark_sklearn_tpu.obs.trace import (
 )
 from spark_sklearn_tpu.parallel import dataplane as _dataplane
 from spark_sklearn_tpu.parallel import memledger as _memledger
+from spark_sklearn_tpu.parallel import ownership
 from spark_sklearn_tpu.utils.locks import named_lock
 
 _slog = get_logger(__name__)
 
 __all__ = [
     "ChunkPipeline",
+    "FuseSpec",
+    "FusedLaunch",
     "LaunchItem",
     "LaunchTimings",
     "enable_persistent_cache",
@@ -230,6 +233,110 @@ class LaunchItem:
     wait: Optional[Callable[[Any], Any]] = None
     bisect: Optional[Callable[[Any], Any]] = None
     host_fallback: Optional[Callable[[], Any]] = None
+    #: cross-search fusion handle (a FuseSpec) — present only on items
+    #: whose launch may be coalesced with same-key peers from OTHER
+    #: searches by the multi-tenant executor (serve/executor.py); the
+    #: pipeline itself never reads it
+    fuse: Optional["FuseSpec"] = None
+
+
+@dataclasses.dataclass
+class FuseSpec:
+    """One search's offer to share a device launch with same-program
+    peers from other searches.
+
+    The multi-tenant executor groups queued specs by ``key`` — two specs
+    with equal keys run the SAME compiled program on concatenable inputs
+    (family + compile-group structure + geometry + broadcast-plane
+    identity) — and hands each group to a :class:`FusedLaunch`.
+
+    ``run``/``slice_out`` keep the device details inside the member's
+    own closure (search/grid.py builds them next to the solo launch
+    path), so this layer stays jax-shape-agnostic:
+
+    run(specs)            stage + execute ONE wide launch covering every
+                          member's real rows, in list order, padded once
+                          at the coalesced width; returns raw device
+                          outputs.
+    slice_out(out, off, n) a member's view of those outputs — the rows
+                          [off, off+n) — in exactly the shape its solo
+                          ``gather`` expects.  vmap lanes are
+                          independent, so each member's lanes are
+                          bit-identical to its solo launch.
+    rows()                the member's real (unpadded) host rows per
+                          dynamic param — what ``run`` concatenates.
+    """
+
+    key: Any                       # hashable program-identity tuple
+    n: int                         # real candidate rows this member adds
+    shard: int                     # task-shard multiple widths pad to
+    max_width: int                 # member's HBM width ceiling (0 = none)
+    rows: Callable[[], Dict[str, Any]]
+    run: Callable[[List["FuseSpec"]], Any]
+    slice_out: Callable[[Any, int, int], Any]
+
+
+class FusedLaunch(ownership.LaunchOwner):
+    """ONE device launch serving many searches' chunks.
+
+    This is the launch-ownership refactor's second owner kind (the first
+    is halving's rung context): the fused launch owns the shared device
+    program invocation, while every member search keeps its own journal
+    lines, fault supervisor and result buffers — one launch, many
+    journals/supervisors.  The executor builds one per coalesced group,
+    calls :meth:`run` once on its dispatch loop, and scatters the
+    per-member outputs back through each member's reply.
+
+    Fault scatter needs no machinery here: an exception from the wide
+    launch is delivered to EVERY member, and each member's supervisor
+    recovers by re-running only its OWN [lo, hi) range through its solo
+    bisect hook — so an OOM/FATAL bisects to member boundaries first,
+    then within the faulting member, and one tenant's poison candidate
+    never retries another tenant's rows.
+    """
+
+    kind = "fused"
+
+    def __init__(self, specs: List[FuseSpec]):
+        if not specs:
+            raise ValueError("FusedLaunch needs at least one member")
+        self.specs = list(specs)
+        self.offsets: List[int] = []
+        off = 0
+        for s in self.specs:
+            self.offsets.append(off)
+            off += int(s.n)
+        #: total real rows across members (pre-padding)
+        self.n_total = off
+        self._out: Any = None
+
+    def members(self) -> List[FuseSpec]:
+        return list(self.specs)
+
+    def padded_width(self) -> int:
+        """The coalesced launch width: total real rows padded up to the
+        members' (shared) task-shard multiple."""
+        shard = max(1, int(self.specs[0].shard))
+        return max(shard, -(-self.n_total // shard) * shard)
+
+    def lanes_padding(self) -> int:
+        """Padded-lane waste of the fused launch (the A/B quantity vs
+        each member padding separately)."""
+        return self.padded_width() - self.n_total
+
+    def run(self) -> Any:
+        """Execute the one wide launch (lead member's closure does the
+        concatenate/pad/upload/dispatch) and memoize the raw output."""
+        self._out = self.specs[0].run(self.specs)
+        return self._out
+
+    def member_result(self, i: int) -> Any:
+        """Member ``i``'s slice of the fused output, in the exact shape
+        its solo launch would have produced."""
+        if self._out is None:
+            raise RuntimeError("FusedLaunch.run() has not been called")
+        s = self.specs[i]
+        return s.slice_out(self._out, self.offsets[i], int(s.n))
 
 
 class ChunkPipeline:

@@ -435,6 +435,7 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                   min_width: int = 0,
                   preferred: Optional[Sequence[Optional[int]]] = None,
                   width_caps: Optional[Sequence[Optional[int]]] = None,
+                  fusion_lane_discount: float = 0.0,
                   ) -> GeometryPlan:
     """Choose every compile group's chunk width.
 
@@ -457,6 +458,17 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     cannot fit is never planned, so OOM bisection becomes the fallback
     instead of the discovery mechanism.  Caps bound the floor and the
     preferred-width affinity too, and join the plan-cache key.
+
+    ``fusion_lane_discount`` prices fleet-wide padding: under
+    cross-search launch fusion (``serve/executor.py``) a chunk's padded
+    lanes are not pure waste — a same-program peer search can fill them
+    in a fused launch — so ``auto`` mode scales ``lane_cost`` by
+    ``(1 - discount)``, tilting unsorted groups toward the
+    fewer-launches/wider-chunks end that fusion amortizes across the
+    coalesced width.  0.0 (fusion off, or solo sessions) is exact
+    pre-fusion pricing, byte-identical plans.  The discount joins the
+    plan-cache key, so fusion-on and fusion-off searches in one
+    process never share plans.
 
     ``min_width`` floors every auto-chosen unsorted width (rounded up
     to the shard multiple, capped by ``max_width``) — the halving
@@ -489,10 +501,11 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
             # one shard stripe, never beyond the task cap
             c -= c % max(1, n_task_shards)
             caps[gi] = max(n_task_shards, min(int(max_width), c))
+    fusion_lane_discount = min(1.0, max(0.0, float(fusion_lane_discount)))
     cache_key = (tuple(sizes), tuple(sorted_caps), int(n_folds),
                  int(n_task_shards), int(max_width), mode,
                  overhead_override, lane_cost_override, int(min_width),
-                 tuple(caps))
+                 tuple(caps), fusion_lane_discount)
     if reuse:
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)
@@ -509,6 +522,9 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                 else model.launch_overhead_s)
     lane_cost = (lane_cost_override if lane_cost_override is not None
                  else model.lane_cost_s)
+    # fleet-wide padding: fused peers can fill padded lanes, so they
+    # price below solo waste (0.0 = exact pre-fusion costing)
+    lane_cost *= (1.0 - fusion_lane_discount)
     snap = model.snapshot()
     if overhead_override is not None or lane_cost_override is not None:
         snap = {**snap, "launch_overhead_s": overhead,
@@ -628,7 +644,10 @@ def _plan_key_from_json(j: Sequence[Any]) -> Tuple:
             # HBM width caps (memledger) rode in later still: older
             # records carry no caps (= uncapped per group)
             tuple(None if c is None else int(c) for c in j[9])
-            if len(j) > 9 else tuple([None] * len(j[0])))
+            if len(j) > 9 else tuple([None] * len(j[0])),
+            # the fusion lane discount rode in with cross-search launch
+            # fusion: older records price lanes at full (solo) cost
+            float(j[10]) if len(j) > 10 else 0.0)
 
 
 def export_plan_state() -> Dict[str, Any]:

@@ -55,6 +55,7 @@ from sklearn.utils.validation import _check_method_params, check_is_fitted
 
 from spark_sklearn_tpu.models.base import resolve_family
 from spark_sklearn_tpu.parallel import mesh as mesh_lib
+from spark_sklearn_tpu.parallel import ownership as _ownership
 from spark_sklearn_tpu.parallel.mesh import TpuConfig, build_mesh
 from spark_sklearn_tpu.parallel.taskgrid import build_compile_groups
 from spark_sklearn_tpu.search.scorers import (
@@ -1118,12 +1119,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         from spark_sklearn_tpu.parallel import programstore as _programstore
         pstore = _programstore.activate_store(config)
         ps_before = _programstore.snapshot_counters(pstore)
-        # successive-halving rung context (search/halving.py, duck-
-        # typed): when set, this evaluate_candidates call is ONE RUNG
-        # of a multi-rung search — the report registry, pipeline and
-        # counter baselines are shared across rungs so the final
-        # search_report covers the whole search, not the last rung
-        rung = getattr(self, "_rung_ctx", None)
+        # successive-halving rung owner (search/halving.py, attached
+        # through the launch-ownership protocol): when set, this
+        # evaluate_candidates call is ONE RUNG of a multi-rung search —
+        # the report registry, pipeline and counter baselines are
+        # shared across rungs so the final search_report covers the
+        # whole search, not the last rung
+        rung = _ownership.current_owner(self, kind="rung")
         if rung is not None:
             if rung.ps_before is None:
                 rung.ps_before = ps_before
@@ -1776,15 +1778,15 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         sequence synchronously (the bit-for-bit escape hatch).  Scores
         are independent of the depth — only host work is reordered."""
         from spark_sklearn_tpu.parallel.pipeline import (
-            ChunkPipeline, LaunchItem, persistent_cache_counts)
+            ChunkPipeline, FuseSpec, LaunchItem, persistent_cache_counts)
         from spark_sklearn_tpu.parallel.taskgrid import pad_chunk
 
-        #: successive-halving rung context (search/halving.py): this
-        #: call is one rung of a multi-rung search.  Chunk ids carry
-        #: the rung namespace, geometry re-plans (or pins) the
-        #: survivors' widths, and the pipeline/registry/baselines are
-        #: shared across rungs.
-        rung = getattr(self, "_rung_ctx", None)
+        #: successive-halving rung owner (search/halving.py, via the
+        #: launch-ownership protocol): this call is one rung of a
+        #: multi-rung search.  Chunk ids carry the rung namespace,
+        #: geometry re-plans (or pins) the survivors' widths, and the
+        #: pipeline/registry/baselines are shared across rungs.
+        rung = _ownership.current_owner(self, kind="rung")
         cid_ns = f"{rung.ns}:" if rung is not None else ""
         # tiled-mask labels share the broadcast masks' rung namespace
         # (see _fit_compiled_impl): the rung barrier's demote targets
@@ -1877,6 +1879,15 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # launch that measures the steady-state score cost later fused
         # chunks attribute out of their single-launch wall.
         fused_mode = all_cores and config.fuse_fit_score
+        # cross-search launch fusion (serve/executor.py): steady-state
+        # fused chunks of an executor-submitted search offer a FuseSpec
+        # so same-program chunks from OTHER searches coalesce into one
+        # wide launch.  Donated buffers are excluded (a fused re-stage
+        # would read host rows a donated solo launch may have consumed),
+        # and first-chunk fit/score/calibration items never fuse (they
+        # share cross-item group state).
+        fusion_on = (fused_mode and binding is not None and not donate
+                     and _serve.resolve_fusion(config))
         score_key = tuple(sorted(scorers.items()))
         # deterministic identity parts for the persistent program store
         # (parallel/programstore.py): everything in a store key must
@@ -2008,7 +2019,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             overhead_override=getattr(config, "geometry_overhead_s", None),
             lane_cost_override=getattr(config, "geometry_lane_cost_s",
                                        None),
-            width_caps=mem_caps)
+            width_caps=mem_caps,
+            # fleet-wide padding: under cross-search fusion a padded
+            # lane is fillable by a same-program peer, so it prices at
+            # half the solo waste; 0.0 keeps pre-fusion plans
+            # byte-identical
+            fusion_lane_discount=0.5 if fusion_on else 0.0)
         #: per-group structure identity ACROSS rungs: the static params
         #: minus the budgeted resource (survivor groups at rung k+1
         #: carry the same key as the rung-0 group they came from, even
@@ -2631,6 +2647,96 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     exec_fused_range(plan, mid, hi_, sup, chunk_id))
             return bisect
 
+        # ------------------------------------------------------------------
+        # cross-search launch fusion (the executor's FusedLaunch seam):
+        # a FuseSpec is this chunk's offer to share one wide device
+        # launch with same-program chunks from OTHER searches.  Equal
+        # keys guarantee the members run the SAME compiled fused
+        # program on the SAME resident broadcast buffers (the data
+        # plane dedups identical uploads, so shared X/y means shared
+        # device objects), so concatenating their real rows and
+        # re-padding once is exactly the bisection-recovery relaunch
+        # shape — per-lane results are bit-identical to each member's
+        # solo launch (vmap lanes are independent).
+        # ------------------------------------------------------------------
+        def make_fuse_spec(plan, lo, hi, chunk_id):
+            group = plan["group"]
+            fkey = (
+                "sst-fuse-v1", family.name, freeze(plan["static"]),
+                freeze(meta), int(n_folds),
+                bool(config.bf16_matmul), mesh_desc,
+                store_score_names, store_sw_key, bool(return_train),
+                bool(sw_blind), str(np.dtype(dtype)),
+                int(n_task_shards), bool(task_batched),
+                tuple(sorted(group.dynamic_params)), fit_masks_fp(),
+                # device-buffer identities: live refs are held by the
+                # member closures, so ids are stable for the launch's
+                # lifetime, and the plane's dedup makes equal content
+                # mean equal objects across searches
+                tuple(id(leaf) for leaf in
+                      jax.tree_util.tree_leaves(data_dev)),
+                id(fit_dev), id(test_dev), id(train_sc_dev),
+                id(test_unw_dev), id(train_unw_dev))
+
+            def rows(group=group, lo=lo, hi=hi):
+                return {k: np.asarray(arr[lo:hi])
+                        for k, arr in group.dynamic_params.items()}
+
+            def run(specs, plan=plan):
+                total = sum(int(s.n) for s in specs)
+                width = max(n_task_shards,
+                            mesh_lib.pad_to_multiple(total,
+                                                     n_task_shards))
+                repeat = n_folds if task_batched else 1
+                progs = build_programs(plan, width=width)
+                member_rows = [s.rows() for s in specs]
+                dyn = {}
+                for k in sorted(member_rows[0]):
+                    cat = np.concatenate(
+                        [np.asarray(r[k]) for r in member_rows])
+                    dyn[k] = _dataplane.upload(
+                        pad_chunk(cat, 0, total, width, repeat),
+                        task_shard, label="dyn.fuse")
+                if not dyn and not task_batched:
+                    dyn["_pad"] = (
+                        plane.zeros(width, dtype, task_shard,
+                                    tenant=sched_tenant)
+                        if plane is not None else
+                        _dataplane.upload(
+                            np.zeros(width, dtype=dtype),
+                            task_shard, label="dyn.pad"))
+                if task_batched:
+                    w = (plane.tiled(fit_masks, fit_dev, width,
+                                     tb_mask_shard, label=tiled_label,
+                                     fp=fit_masks_fp(),
+                                     tenant=sched_tenant)
+                         if plane is not None else
+                         _dataplane.upload(
+                             np.tile(fit_masks, (width, 1)),
+                             tb_mask_shard, label=tiled_label))
+                else:
+                    w = fit_dev
+                return progs["fused"](dyn, data_dev, w, test_dev,
+                                      train_sc_dev, test_unw_dev,
+                                      train_unw_dev)
+
+            def slice_out(out, off, n):
+                te, tr, bad, im, isum = out
+                return ({s: v[off:off + n] for s, v in te.items()},
+                        {s: v[off:off + n] for s, v in tr.items()},
+                        bad[off:off + n], im, isum)
+
+            # the fused width may legitimately exceed one chunk's solo
+            # batch bound (that is the point of fusion); the honest
+            # ceiling is the HBM width cap when the ledger modeled one
+            # (0 = unbounded — an over-wide fused OOM still recovers,
+            # each member bisecting its own range)
+            cap = mem_caps[plan["gi"]] if mem_caps is not None else None
+            return FuseSpec(key=fkey, n=hi - lo,
+                            shard=int(n_task_shards),
+                            max_width=int(cap) if cap else 0,
+                            rows=rows, run=run, slice_out=slice_out)
+
         # quarantine armed: the first-chunk fit/score items also carry
         # an isolate hook (below), so a poison candidate in ANY chunk
         # routes through the fused-range recursion instead of the
@@ -2916,7 +3022,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             n_tasks=n_real, stage=stage, launch=launch,
                             gather=gather, finalize=finalize,
                             bisect=make_bisect_fused(plan, lo, hi,
-                                                     chunk_id))
+                                                     chunk_id),
+                            fuse=(make_fuse_spec(plan, lo, hi, chunk_id)
+                                  if fusion_on else None))
                         continue
 
                     # first live chunk of the group (or the never-fused

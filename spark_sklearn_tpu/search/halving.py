@@ -70,6 +70,7 @@ from sklearn.utils.validation import _num_samples
 
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.parallel import ownership as _ownership
 from spark_sklearn_tpu.parallel.mesh import TpuConfig
 from spark_sklearn_tpu.search.grid import BaseSearchTPU
 
@@ -78,15 +79,21 @@ __all__ = ["HalvingGridSearchCV", "HalvingRandomSearchCV"]
 logger = get_logger("spark_sklearn_tpu.search.halving")
 
 
-class _RungContext:
+class _RungContext(_ownership.LaunchOwner):
     """Mutable per-search state threaded from the halving scheduler
-    into the engine (``grid._run_groups`` reads it via the duck-typed
-    ``search._rung_ctx`` attribute, so grid never imports halving).
+    into the engine through the launch-ownership protocol
+    (``parallel/ownership.py``): the scheduler attaches it with
+    ``attach_owner`` around the rung loop and ``grid._run_groups``
+    reads it back via ``current_owner`` — grid never imports halving,
+    and the contract is the explicit :class:`LaunchOwner` attribute
+    set instead of the old duck-typed ``search._rung_ctx`` probe.
 
     Single-threaded by construction: every field is written on the
     search's own fit thread (geometry planning and the rung-boundary
     accounting both run there), never on the pipeline workers.
     """
+
+    kind = "rung"
 
     def __init__(self, resource: str, replan: bool, min_rung_width: int,
                  n_candidates0: int):
@@ -327,7 +334,7 @@ class BaseSuccessiveHalvingTPU(BaseSearchTPU):
             replan=bool(getattr(cfg, "halving_replan", True)),
             min_rung_width=int(getattr(cfg, "min_rung_width", 0) or 0),
             n_candidates0=len(candidate_params))
-        self._rung_ctx = rc
+        _ownership.attach_owner(self, rc)
         from spark_sklearn_tpu import serve as _serve
         from spark_sklearn_tpu.parallel import dataplane as _dataplane
         binding = _serve.current_binding()
@@ -421,7 +428,7 @@ class BaseSuccessiveHalvingTPU(BaseSearchTPU):
         finally:
             pipe = rc.pipeline
             rc.pipeline = None
-            self._rung_ctx = None
+            _ownership.detach_owner(self)
             if pipe is not None:
                 # the rungs only drained it; the search owns the close
                 pipe.close()

@@ -23,6 +23,9 @@ from spark_sklearn_tpu.serve.executor import (
     SearchHandle,
     current_binding,
     report_block,
+    resolve_fusion,
+    resolve_fusion_max_width,
+    resolve_fusion_window_ms,
     resolve_tenant,
     resolve_weight,
 )
@@ -36,6 +39,9 @@ __all__ = [
     "SearchHandle",
     "current_binding",
     "report_block",
+    "resolve_fusion",
+    "resolve_fusion_max_width",
+    "resolve_fusion_window_ms",
     "resolve_tenant",
     "resolve_weight",
 ]

@@ -73,7 +73,7 @@ from typing import Any, Callable, Dict, List, Optional
 from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer, set_correlation
-from spark_sklearn_tpu.parallel.pipeline import LaunchItem
+from spark_sklearn_tpu.parallel.pipeline import FusedLaunch, LaunchItem
 from spark_sklearn_tpu.utils.locks import named_rlock
 
 logger = get_logger(__name__)
@@ -86,6 +86,9 @@ __all__ = [
     "SearchHandle",
     "current_binding",
     "report_block",
+    "resolve_fusion",
+    "resolve_fusion_max_width",
+    "resolve_fusion_window_ms",
 ]
 
 DEFAULT_TENANT = "default"
@@ -191,6 +194,50 @@ def resolve_weight(config) -> float:
     return max(float(w), 1e-6) if w is not None else 1.0
 
 
+def resolve_fusion(config) -> bool:
+    """Cross-search launch fusion under ``config``:
+    ``TpuConfig.fusion``, else the ``SST_FUSION`` env var, else True.
+    False is the exact escape hatch — every chunk dispatches solo."""
+    f = getattr(config, "fusion", None)
+    if f is not None:
+        return bool(f)
+    env = os.environ.get("SST_FUSION", "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return False
+    return True
+
+
+def resolve_fusion_window_ms(config) -> float:
+    """Fusion peer-wait window (milliseconds):
+    ``TpuConfig.fusion_window_ms``, else ``SST_FUSION_WINDOW_MS``,
+    else 5.0.  0 disables the hold (fusion still coalesces peers that
+    are ALREADY queued when a fusable head dispatches)."""
+    v = getattr(config, "fusion_window_ms", None)
+    if v is None:
+        env = os.environ.get("SST_FUSION_WINDOW_MS")
+        if env:
+            try:
+                v = float(env)
+            except ValueError:
+                v = None
+    return max(0.0, float(v)) if v is not None else 5.0
+
+
+def resolve_fusion_max_width(config) -> int:
+    """Fused-launch real-lane cap: ``TpuConfig.fusion_max_width``,
+    else ``SST_FUSION_MAX_WIDTH``, else 0 = bounded only by the member
+    plans' own width caps."""
+    v = getattr(config, "fusion_max_width", None)
+    if v is None:
+        env = os.environ.get("SST_FUSION_MAX_WIDTH")
+        if env:
+            try:
+                v = int(env)
+            except ValueError:
+                v = None
+    return max(0, int(v)) if v is not None else 0
+
+
 class SearchHandle:
     """Executor-side state of one submitted search.  Mutable counters
     are owned by the executor's lock; readers snapshot through
@@ -237,6 +284,14 @@ class SearchHandle:
         self.cost_window_before: Dict[str, int] = {}
         self.tenant_shares: Dict[str, float] = {}
         self.share_frac = 0.0
+        #: cross-search launch fusion counters (owned by the executor
+        #: lock like every counter above; reported only when fusion is
+        #: resolved ON, so fusion=False blocks stay byte-identical)
+        self.n_fused = 0             # dispatches served by a fused launch
+        self.lanes_donated = 0       # real peer lanes this search's fused
+        #                              heads carried for other searches
+        self.lanes_borrowed = 0      # own real lanes run in peers' launches
+        self.fusion_saved_launches = 0  # solo launches fusing avoided
 
 
 class _Tenant:
@@ -401,6 +456,14 @@ class SearchExecutor:
             config, "max_queued_searches", 16) or 0))
         self._tenant_cap = max(0, int(getattr(
             config, "tenant_max_inflight", 0) or 0))
+        #: cross-search launch fusion (ISSUE 14): same-program chunks
+        #: from different searches coalesce into one wide launch
+        self._fusion = resolve_fusion(config)
+        self._fusion_window_s = resolve_fusion_window_ms(config) / 1000.0
+        self._fusion_max_width = resolve_fusion_max_width(config)
+        #: hint from _pop_next to _loop: a fusable head is being held
+        #: inside its fusion window — sleep a sliver, don't hot-spin
+        self._fuse_defer = False
 
     # -- submission ------------------------------------------------------
     def submit(self, search, X, y=None, fit_params: Optional[dict] = None,
@@ -960,7 +1023,7 @@ class SearchExecutor:
             gather=item.gather, finalize=routed_finalize,
             group=item.group, kind=item.kind, n_tasks=item.n_tasks,
             wait=item.wait, bisect=item.bisect,
-            host_fallback=item.host_fallback)
+            host_fallback=item.host_fallback, fuse=item.fuse)
 
     def _try_fastpath(self, handle: SearchHandle, cost: int,
                       state: Dict[str, Any]) -> bool:
@@ -1052,6 +1115,16 @@ class SearchExecutor:
                 req = self._pop_next()
                 if req is not None:
                     self._run_request(req)
+                else:
+                    with self._lock:
+                        defer = self._fuse_defer
+                        self._fuse_defer = False
+                    if defer:
+                        # a fusable head is holding for a same-program
+                        # peer inside its fusion window: sleep a sliver
+                        # instead of hot-spinning on the still-set work
+                        # event
+                        time.sleep(0.0005)
             # defensive: a scheduler bug must degrade to a logged error
             # + the next poll, never a silently-dead dispatch loop with
             # every search hung on its reply (launch failures never
@@ -1070,6 +1143,7 @@ class SearchExecutor:
             names = sorted(self._tenants)
             n = len(names)
             runnable = 0
+            now = time.perf_counter()
             for off in range(n):
                 idx = (self._rr + off) % n
                 t = self._tenants[names[idx]]
@@ -1084,6 +1158,18 @@ class SearchExecutor:
                     continue
                 runnable += 1
                 head = t.queue[0]
+                if self._fusion and self._fusion_window_s > 0.0 \
+                        and head.item.fuse is not None \
+                        and not head.handle.cancelled \
+                        and now - head.t_enqueued < self._fusion_window_s \
+                        and not self._has_fuse_peer_locked(head):
+                    # fusion window: hold a fusable head briefly — a
+                    # same-program peer from another search may arrive
+                    # and fill its padded lanes.  The head stays at its
+                    # queue front (FIFO intact) and dispatches solo
+                    # once the window expires peer-less.
+                    self._fuse_defer = True
+                    continue
                 if t.deficit < head.cost:
                     t.deficit += self._quantum * t.weight
                 if t.deficit < head.cost:
@@ -1136,6 +1222,12 @@ class SearchExecutor:
             fastpath=fastpath)
 
     def _run_request(self, req: _Request) -> None:
+        if self._fusion and req.item.fuse is not None \
+                and not req.handle.cancelled:
+            peers = self._claim_fusion_peers(req)
+            if peers:
+                self._run_fused([req] + peers)
+                return
         self._note_dispatch_out(
             req.handle, req.cost,
             max(0.0, req.t_dequeued - req.t_enqueued),
@@ -1145,6 +1237,9 @@ class SearchExecutor:
             req.reply.set_exception(SearchCancelledError(
                 f"search {req.handle.id!r} was cancelled"))
             return
+        self._dispatch_solo(req)
+
+    def _dispatch_solo(self, req: _Request) -> None:
         tr = get_tracer()
         t_busy0 = time.perf_counter()
         try:
@@ -1163,6 +1258,166 @@ class SearchExecutor:
             return
         _telemetry.note_sched_busy(time.perf_counter() - t_busy0)
         req.reply.set_result(out)
+
+    # -- cross-search launch fusion --------------------------------------
+    def _has_fuse_peer_locked(self, head: _Request) -> bool:
+        """Is a same-program (equal FuseSpec key) request from another
+        live search queued anywhere?  Caller holds the lock."""
+        key = head.item.fuse.key
+        for t in self._tenants.values():
+            for r in t.queue:
+                if r is head or r.handle.cancelled:
+                    continue
+                f = r.item.fuse
+                if f is not None and f.key == key:
+                    return True
+        return False
+
+    def _claim_fusion_peers(self, head: _Request) -> List[_Request]:
+        """Pop every queued same-program peer that fits the fused
+        width, within DRR credit — each claimed peer gets the exact
+        head-equivalent dequeue accounting (dispatch/cost/in-flight
+        counters, deficit charge, wait sample), so fair-share ratios
+        and the scheduler block stay truthful under fusion."""
+        spec = head.item.fuse
+        claimed: List[_Request] = []
+        now = time.perf_counter()
+        with self._lock:
+            if self._stop:
+                return []
+            shard = max(1, int(spec.shard))
+            total = int(spec.n)
+            bound = int(spec.max_width)   # HBM width ceiling; 0 = none
+            for name in sorted(self._tenants):
+                t = self._tenants[name]
+                if not t.queue:
+                    continue
+                cap = self._effective_cap(name)
+                # the head's tenant already earned its quantum in
+                # _pop_next this round — a second top-up here would
+                # double its round credit and skew fair share
+                topped = name == head.handle.tenant
+                for r in list(t.queue):
+                    if r is head or r.handle.cancelled:
+                        continue
+                    f = r.item.fuse
+                    if f is None or f.key != spec.key:
+                        continue
+                    if cap and t.inflight >= cap:
+                        break
+                    new_total = total + int(f.n)
+                    padded = -(-new_total // shard) * shard
+                    f_bound = int(f.max_width)
+                    limit = min((b for b in (bound, f_bound) if b > 0),
+                                default=0)
+                    if limit and padded > limit:
+                        continue
+                    if self._fusion_max_width and \
+                            new_total > self._fusion_max_width:
+                        continue
+                    if t.deficit < r.cost:
+                        # same credit law as _pop_next: at most one
+                        # quantum top-up per tenant per claim pass
+                        if topped:
+                            continue
+                        topped = True
+                        t.deficit += self._quantum * t.weight
+                        if t.deficit < r.cost:
+                            continue
+                    t.queue.remove(r)
+                    t.deficit -= r.cost
+                    if not t.queue:
+                        t.deficit = 0.0   # classic DRR: idle queues reset
+                    r.t_dequeued = now
+                    self._account_dispatch(r.handle, r.cost)
+                    self._count_inflight(r.handle, r.state)
+                    wait = r.t_dequeued - r.t_enqueued
+                    h = r.handle
+                    h.queue_wait_s += wait
+                    h.queue_wait_max_s = max(h.queue_wait_max_s, wait)
+                    if len(h.queue_waits) < _MAX_WAIT_SAMPLES:
+                        h.queue_waits.append(
+                            {"tenant": h.tenant,
+                             "wait_s": round(wait, 6)})
+                    claimed.append(r)
+                    total = new_total
+                    if f_bound:
+                        bound = min(bound, f_bound) if bound else f_bound
+        return claimed
+
+    def _run_fused(self, members: List[_Request]) -> None:
+        """ONE device launch serving every member's chunk, results
+        scattered back per member reply.  A launch failure is delivered
+        to every live member: each search's own fault supervisor then
+        recovers over only ITS [lo, hi) range (member-boundary-first
+        bisection), so one tenant's poison candidate never retries
+        another tenant's rows."""
+        live: List[_Request] = []
+        for r in members:
+            self._note_dispatch_out(
+                r.handle, r.cost,
+                max(0.0, r.t_dequeued - r.t_enqueued),
+                fastpath=False, key=r.item.key)
+            if r.handle.cancelled:
+                # a member cancelled between claim and launch drops out
+                # without touching its peers' launch
+                self._note_done(r.handle, r.state)
+                r.reply.set_exception(SearchCancelledError(
+                    f"search {r.handle.id!r} was cancelled"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        if len(live) == 1:
+            # every peer dropped out: the survivor dispatches solo on
+            # its own already-staged payload — no fusion accounting
+            self._dispatch_solo(live[0])
+            return
+        fl = FusedLaunch([r.item.fuse for r in live])
+        tr = get_tracer()
+        t_busy0 = time.perf_counter()
+        try:
+            with tr.span("sched.fuse", key=live[0].item.key,
+                         tenant=live[0].handle.tenant,
+                         n_members=len(live), lanes=fl.padded_width(),
+                         cost=sum(r.cost for r in live)):
+                fl.run()
+        # same thread boundary as _dispatch_solo: the failure marshals
+        # to EVERY member search's supervisor, each of which recovers
+        # over its own candidate range only
+        # sstlint: disable=broad-except-swallow,launch-except-taxonomy
+        except BaseException as exc:
+            _telemetry.note_sched_busy(time.perf_counter() - t_busy0)
+            for r in live:
+                r.reply.set_exception(exc)
+            return
+        _telemetry.note_sched_busy(time.perf_counter() - t_busy0)
+        head = live[0]
+        n_head = int(head.item.fuse.n)
+        donated = fl.n_total - n_head
+        borrowed: Dict[str, int] = {}
+        with self._lock:
+            for i, r in enumerate(live):
+                r.handle.n_fused += 1
+                if i == 0:
+                    r.handle.lanes_donated += donated
+                    r.handle.fusion_saved_launches += len(live) - 1
+                else:
+                    n_r = int(r.item.fuse.n)
+                    r.handle.lanes_borrowed += n_r
+                    borrowed[r.handle.tenant] = \
+                        borrowed.get(r.handle.tenant, 0) + n_r
+        # telemetry + flight notes outside the lock (hook discipline)
+        _telemetry.note_fusion(
+            head.handle.tenant, n_members=len(live),
+            lanes_total=fl.padded_width(), lanes_real=fl.n_total,
+            saved_launches=len(live) - 1, borrowed=borrowed)
+        _telemetry.flight_recorder().note(
+            "fuse", key=head.item.key, n_members=len(live),
+            lanes=fl.padded_width(),
+            tenants=[r.handle.tenant for r in live])
+        for i, r in enumerate(live):
+            r.reply.set_result(fl.member_result(i))
 
     # -- drain/test aids -------------------------------------------------
     def pause(self) -> None:
@@ -1216,7 +1471,7 @@ class SearchExecutor:
             self._update_shares(handle)
             n = handle.n_dispatched
             routed = max(0, n - handle.n_fastpath)
-            return {
+            block = {
                 "enabled": True,
                 "tenant": handle.tenant,
                 "handle": handle.id,
@@ -1234,6 +1489,18 @@ class SearchExecutor:
                 "tenant_shares": dict(handle.tenant_shares),
                 "waits": [dict(w) for w in handle.queue_waits],
             }
+            if self._fusion:
+                # fusion keys ride only when fusion is resolved ON —
+                # fusion=False (and standalone report_block) blocks
+                # stay byte-identical to the pre-fusion engine
+                block.update({
+                    "n_fused": handle.n_fused,
+                    "lanes_donated": handle.lanes_donated,
+                    "lanes_borrowed": handle.lanes_borrowed,
+                    "fusion_saved_launches":
+                        handle.fusion_saved_launches,
+                })
+            return block
 
     # -- lifecycle -------------------------------------------------------
     def shutdown(self, wait: bool = True,

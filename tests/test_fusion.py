@@ -1,0 +1,464 @@
+"""Cross-search launch fusion tests (spark_sklearn_tpu/serve/ +
+parallel/pipeline.py FusedLaunch).
+
+Covers the fusion contract end to end: two- and three-tenant fused
+launches bit-exact vs their solo runs, fault recovery at member
+boundaries (an injected OOM bisects only the faulting member's range;
+a failing fused launch scatters to EVERY member, each of which
+recovers over only its own rows) with both journals independently
+resumable, cancellation of one member leaving its peers' launch
+intact, x64-exclusive families never fusing with f32 peers, DRR fair
+share holding within tolerance with fusion on, and the ``fusion=False``
+escape hatch reproducing the pre-fusion scheduler block exactly.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu import serve
+from spark_sklearn_tpu.obs.metrics import SCHEDULER_BLOCK_SCHEMA
+from spark_sklearn_tpu.parallel.pipeline import FusedLaunch, FuseSpec, LaunchItem
+from spark_sklearn_tpu.serve import executor as executor_mod
+from spark_sklearn_tpu.serve.executor import (
+    SearchCancelledError,
+    SearchExecutor,
+    SearchHandle,
+    _Reply,
+    _Request,
+)
+
+from sklearn.linear_model import LogisticRegression
+
+rng = np.random.RandomState(0)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] + 0.25 * rng.randn(96) > 0).astype(np.int64)
+
+GRID_A = np.logspace(-2, 1, 40).tolist()
+GRID_B = np.logspace(-3, 2, 40).tolist()
+GRID_C = np.logspace(-1, 3, 40).tolist()
+
+#: conditional scheduler-block keys — present only with fusion ON
+FUSION_KEYS = {"n_fused", "lanes_donated", "lanes_borrowed",
+               "fusion_saved_launches"}
+
+
+def logreg_search(grid, config=None):
+    return sst.GridSearchCV(LogisticRegression(max_iter=10),
+                            {"C": grid}, cv=2, refit=False,
+                            backend="tpu", config=config)
+
+
+def scores(search):
+    return search.cv_results_["mean_test_score"]
+
+
+def fuse_cfg(**kw):
+    """A config whose fusion window is wide enough that the two
+    searches' chunk cadences always find each other in the queue."""
+    kw.setdefault("max_tasks_per_batch", 16)
+    kw.setdefault("fusion_window_ms", 200.0)
+    return sst.TpuConfig(**kw)
+
+
+def sched(search):
+    return search.search_report["scheduler"]
+
+
+def wait_for(cond, timeout=60.0, interval=0.005):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def run_concurrent(sess, searches, timeout=300):
+    """Submit every search with the dispatch loop paused so their first
+    chunks co-queue, then resume — the deterministic contended start."""
+    ex = sess.executor
+    ex.pause()
+    futs = [sess.submit(s, X, y) for s in searches]
+    assert wait_for(lambda: ex.queued_count() >= len(searches)), \
+        ex.stats()
+    ex.resume()
+    return [f.result(timeout=timeout) for f in futs]
+
+
+# ---------------------------------------------------------------------------
+# Parity: fused members bit-exact vs solo
+# ---------------------------------------------------------------------------
+
+
+class TestFusedParity:
+    def test_two_tenants_fused_bit_exact(self):
+        ref_a = logreg_search(GRID_A, fuse_cfg()).fit(X, y)
+        ref_b = logreg_search(GRID_B, fuse_cfg()).fit(X, y)
+        sess = sst.createLocalTpuSession("fuse-pair", config=fuse_cfg())
+        try:
+            a, b = run_concurrent(sess, [
+                logreg_search(GRID_A, fuse_cfg(tenant="ta")),
+                logreg_search(GRID_B, fuse_cfg(tenant="tb"))])
+            np.testing.assert_array_equal(scores(a), scores(ref_a))
+            np.testing.assert_array_equal(scores(b), scores(ref_b))
+            sa, sb = sched(a), sched(b)
+            # fused dispatches happened, and the lane exchange is
+            # conserved: what heads donated is what peers borrowed
+            assert sa["n_fused"] + sb["n_fused"] > 0, (sa, sb)
+            assert sa["fusion_saved_launches"] + \
+                sb["fusion_saved_launches"] > 0
+            assert sa["lanes_donated"] + sb["lanes_donated"] == \
+                sa["lanes_borrowed"] + sb["lanes_borrowed"]
+        finally:
+            sess.stop()
+
+    def test_three_tenants_fused_bit_exact(self):
+        from spark_sklearn_tpu.obs import telemetry as tel
+        refs = [logreg_search(g, fuse_cfg()).fit(X, y)
+                for g in (GRID_A, GRID_B, GRID_C)]
+        sess = sst.createLocalTpuSession(
+            "fuse-trio", config=fuse_cfg(telemetry_port=0))
+        try:
+            f0 = tel.get_telemetry().snapshot()["fusion"]
+            got = run_concurrent(sess, [
+                logreg_search(g, fuse_cfg(tenant=f"t{i}"))
+                for i, g in enumerate((GRID_A, GRID_B, GRID_C))])
+            for g, r in zip(got, refs):
+                np.testing.assert_array_equal(scores(g), scores(r))
+            blocks = [sched(g) for g in got]
+            assert sum(s["n_fused"] for s in blocks) >= 2, blocks
+            assert sum(s["fusion_saved_launches"]
+                       for s in blocks) >= 1, blocks
+            # the telemetry fusion family saw the same launches
+            f1 = tel.get_telemetry().snapshot()["fusion"]
+            assert f1["fused_total"] > f0["fused_total"]
+            assert f1["members_total"] - f0["members_total"] >= \
+                2 * (f1["fused_total"] - f0["fused_total"])
+            assert f1["lanes_real_total"] <= f1["lanes_padded_total"]
+        finally:
+            sess.stop()
+
+
+# ---------------------------------------------------------------------------
+# Faults: member-boundary recovery + journals
+# ---------------------------------------------------------------------------
+
+
+class TestFusedFaults:
+    def test_injected_oom_bisects_faulting_member_only(self, tmp_path):
+        """``oom@3`` on tenant A under fusion: A recovers through its
+        own bisection with exact scores, B records zero faults, and
+        BOTH journals independently resume a fresh identical search."""
+        cfg_a = fuse_cfg(tenant="faulty", fault_plan="oom@3",
+                         retry_backoff_s=0.01,
+                         checkpoint_dir=str(tmp_path / "a"))
+        cfg_b = fuse_cfg(tenant="healthy",
+                         checkpoint_dir=str(tmp_path / "b"))
+        ref_a = logreg_search(GRID_A, fuse_cfg()).fit(X, y)
+        ref_b = logreg_search(GRID_B, fuse_cfg()).fit(X, y)
+        sess = sst.createLocalTpuSession("fuse-oom", config=fuse_cfg())
+        try:
+            a, b = run_concurrent(sess, [
+                logreg_search(GRID_A, cfg_a),
+                logreg_search(GRID_B, cfg_b)])
+            np.testing.assert_array_equal(scores(a), scores(ref_a))
+            np.testing.assert_array_equal(scores(b), scores(ref_b))
+            assert a.search_report["faults"]["bisections"] >= 1, \
+                a.search_report["faults"]
+            fb = b.search_report["faults"]
+            assert fb["bisections"] == 0 and fb["retries"] == 0, fb
+        finally:
+            sess.stop()
+        # per-member journal lines: each checkpoint independently
+        # resumes its own search — fused execution left both journals
+        # exactly as their solo runs would have
+        for grid, ref, sub in ((GRID_A, ref_a, "a"), (GRID_B, ref_b,
+                                                      "b")):
+            cfg = sst.TpuConfig(max_tasks_per_batch=16,
+                                checkpoint_dir=str(tmp_path / sub))
+            resumed = logreg_search(grid, cfg).fit(X, y)
+            np.testing.assert_array_equal(scores(resumed), scores(ref))
+            assert resumed.search_report["n_chunks_resumed"] > 0
+
+    def test_fused_launch_failure_scatters_to_all_members(
+            self, monkeypatch):
+        """A fused launch that OOMs mid-flight is delivered to EVERY
+        member; each member's supervisor bisects only its OWN candidate
+        range, and both searches still land bit-exact."""
+        state = {"failed": False, "fused": 0}
+        real = FusedLaunch
+
+        class FailOnce(real):
+            def run(self):
+                state["fused"] += 1
+                if not state["failed"]:
+                    state["failed"] = True
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: injected fused-launch OOM")
+                return super().run()
+
+        monkeypatch.setattr(executor_mod, "FusedLaunch", FailOnce)
+        ref_a = logreg_search(GRID_A, fuse_cfg()).fit(X, y)
+        ref_b = logreg_search(GRID_B, fuse_cfg()).fit(X, y)
+        sess = sst.createLocalTpuSession(
+            "fuse-scatter",
+            config=fuse_cfg(retry_backoff_s=0.01))
+        try:
+            a, b = run_concurrent(sess, [
+                logreg_search(GRID_A,
+                              fuse_cfg(tenant="ta",
+                                       retry_backoff_s=0.01)),
+                logreg_search(GRID_B,
+                              fuse_cfg(tenant="tb",
+                                       retry_backoff_s=0.01))])
+            assert state["failed"] and state["fused"] >= 1
+            np.testing.assert_array_equal(scores(a), scores(ref_a))
+            np.testing.assert_array_equal(scores(b), scores(ref_b))
+            # the shared failure bisected at the member boundary: each
+            # search recovered through its OWN hook
+            assert a.search_report["faults"]["bisections"] >= 1
+            assert b.search_report["faults"]["bisections"] >= 1
+        finally:
+            sess.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cancellation: a member dropping out never touches its peers
+# ---------------------------------------------------------------------------
+
+
+def _synth_request(handle, key, n, cost=4, out_tag=""):
+    """A queueable request whose item carries a synthetic FuseSpec —
+    the executor-level unit-test stand-in (no device work)."""
+    spec = FuseSpec(
+        key=key, n=n, shard=1, max_width=0,
+        rows=lambda: {},
+        run=lambda specs: [f"{out_tag}{i}" for i in
+                           range(sum(int(s.n) for s in specs))],
+        slice_out=lambda out, off, m: out[off:off + m])
+    item = LaunchItem(key=f"{handle.id}:{key}", kind="fused",
+                      n_tasks=cost, launch=lambda p: f"solo:{out_tag}",
+                      fuse=spec)
+    now = time.perf_counter()
+    return _Request(handle=handle, item=item,
+                    launch=lambda p: f"solo:{out_tag}", payload=None,
+                    cost=cost, state={"counted": False},
+                    t_enqueued=now, reply=_Reply())
+
+
+class TestFusedCancellation:
+    def test_cancelled_member_drops_out_peer_runs_solo(self):
+        """_run_fused with one member cancelled between claim and
+        launch: the cancelled reply fails, the survivor dispatches solo
+        on its own staged payload with NO fusion accounting."""
+        ex = SearchExecutor()
+        try:
+            h_live = SearchHandle("t1/s1", "t1", 1.0)
+            h_dead = SearchHandle("t2/s1", "t2", 1.0)
+            r_live = _synth_request(h_live, ("k",), 4, out_tag="live")
+            r_dead = _synth_request(h_dead, ("k",), 4, out_tag="dead")
+            for r in (r_live, r_dead):
+                r.t_dequeued = time.perf_counter()
+            h_dead.cancelled = True
+            ex._run_fused([r_live, r_dead])
+            assert r_live.reply.result() == "solo:live"
+            with pytest.raises(SearchCancelledError):
+                r_dead.reply.result()
+            assert h_live.n_fused == 0 and h_live.lanes_donated == 0
+            assert h_dead.n_fused == 0
+        finally:
+            ex.shutdown()
+
+    def test_two_live_members_fuse_and_scatter_exactly(self):
+        """The synthetic happy path pins the scatter math: each member
+        reply gets exactly its [off, off+n) slice and the counters
+        split head-donates / peer-borrows."""
+        ex = SearchExecutor()
+        try:
+            h1 = SearchHandle("t1/s1", "t1", 1.0)
+            h2 = SearchHandle("t2/s1", "t2", 1.0)
+            r1 = _synth_request(h1, ("k",), 3, out_tag="w")
+            r2 = _synth_request(h2, ("k",), 2, out_tag="w")
+            for r in (r1, r2):
+                r.t_dequeued = time.perf_counter()
+            ex._run_fused([r1, r2])
+            assert r1.reply.result() == ["w0", "w1", "w2"]
+            assert r2.reply.result() == ["w3", "w4"]
+            assert h1.n_fused == 1 and h1.lanes_donated == 2 \
+                and h1.fusion_saved_launches == 1
+            assert h2.n_fused == 1 and h2.lanes_borrowed == 2 \
+                and h2.fusion_saved_launches == 0
+        finally:
+            ex.shutdown()
+
+    def test_cancel_one_search_leaves_peer_bit_exact(self):
+        ref_a = logreg_search(GRID_A, fuse_cfg()).fit(X, y)
+        sess = sst.createLocalTpuSession("fuse-cancel",
+                                         config=fuse_cfg())
+        try:
+            ex = sess.executor
+            ex.pause()
+            fa = sess.submit(logreg_search(GRID_A,
+                                           fuse_cfg(tenant="keep")),
+                             X, y)
+            fb = sess.submit(logreg_search(GRID_B,
+                                           fuse_cfg(tenant="drop")),
+                             X, y)
+            assert wait_for(lambda: ex.queued_count() >= 2), ex.stats()
+            won = fb.cancel()
+            ex.resume()
+            a = fa.result(timeout=300)
+            np.testing.assert_array_equal(scores(a), scores(ref_a))
+            if won:
+                with pytest.raises(SearchCancelledError):
+                    fb.result(timeout=60)
+            else:
+                fb.result(timeout=300)
+        finally:
+            sess.stop()
+
+
+# ---------------------------------------------------------------------------
+# Exclusion: x64 families never fuse with f32 peers
+# ---------------------------------------------------------------------------
+
+
+class TestFusionExclusion:
+    def test_x64_exclusive_family_never_fuses(self):
+        from sklearn.linear_model import Ridge
+        yr = (X @ np.arange(6, dtype=np.float32)
+              + 0.1 * rng.randn(96)).astype(np.float32)
+
+        def ridge_search(config=None):
+            return sst.GridSearchCV(
+                Ridge(), {"alpha": np.logspace(-3, 2, 12).tolist()},
+                cv=2, refit=False, backend="tpu", config=config)
+
+        ref_r = ridge_search(fuse_cfg()).fit(X, yr)
+        ref_l = logreg_search(GRID_A, fuse_cfg()).fit(X, y)
+        sess = sst.createLocalTpuSession("fuse-x64", config=fuse_cfg())
+        try:
+            fr = sess.submit(ridge_search(fuse_cfg(tenant="tr")), X, yr)
+            fl = sess.submit(
+                logreg_search(GRID_A, fuse_cfg(tenant="tl")), X, y)
+            assert fr._handle.exclusive and not fl._handle.exclusive
+            r = fr.result(timeout=300)
+            lo = fl.result(timeout=300)
+            np.testing.assert_array_equal(scores(r), scores(ref_r))
+            np.testing.assert_array_equal(scores(lo), scores(ref_l))
+            # exclusive scheduling means the x64 search ran alone: it
+            # can never have shared a launch with the f32 peer
+            sr = sched(r)
+            assert sr["n_fused"] == 0 and sr["lanes_borrowed"] == 0 \
+                and sr["lanes_donated"] == 0, sr
+        finally:
+            sess.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fair share: DRR ratios hold with fusion on
+# ---------------------------------------------------------------------------
+
+
+class TestFairShareFused:
+    @staticmethod
+    def _drive(ex, handle, n, cost, work_s=0.005):
+        replies = []
+        for i in range(n):
+            spec = FuseSpec(
+                key=("synth-fair",), n=cost, shard=1, max_width=0,
+                rows=lambda: {},
+                run=lambda specs, w=work_s: (
+                    time.sleep(w),
+                    list(range(sum(int(s.n) for s in specs))))[1],
+                slice_out=lambda out, off, m: out[off:off + m])
+            item = LaunchItem(key=f"{handle.id}:{i}", kind="fused",
+                              n_tasks=cost, fuse=spec,
+                              launch=lambda p: time.sleep(0.0))
+            req = _Request(
+                handle=handle, item=item,
+                launch=lambda p, w=work_s: time.sleep(w),
+                payload=None, cost=cost, state={"counted": False},
+                t_enqueued=time.perf_counter(), reply=_Reply())
+            ex._enqueue(req)
+            replies.append(req.reply)
+        return replies
+
+    def test_drr_shares_track_weights_with_fusion_on(self):
+        """Deep fusable queues for two tenants with weights 1:3 — the
+        claim pass charges every claimed peer to its own tenant's
+        deficit under the same credit law as _pop_next, so the
+        dispatch-stream shares still land within 10% of 0.25/0.75."""
+        ex = SearchExecutor(sst.TpuConfig(scheduler_quantum=8))
+        h_light = SearchHandle("light/s1", "light", 1.0)
+        h_heavy = SearchHandle("heavy/s1", "heavy", 3.0)
+        ex.pause()
+        n = 40
+        self._drive(ex, h_light, n, cost=8)
+        heavy_replies = self._drive(ex, h_heavy, n, cost=8)
+        ex.resume()
+        for r in heavy_replies:
+            r.result()
+        ex.pause()    # freeze the light backlog's drain at this instant
+        block = ex.search_block(h_heavy)
+        shares = block["tenant_shares"]
+        assert abs(shares["heavy"] - 0.75) <= 0.10, block
+        assert abs(shares["light"] - 0.25) <= 0.10, block
+        # fusion genuinely engaged while fairness held
+        assert h_heavy.n_fused + h_light.n_fused > 0, block
+        ex.resume()
+        ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fusion=False: the exact escape hatch
+# ---------------------------------------------------------------------------
+
+
+class TestFusionOff:
+    def test_fusion_off_block_shape_and_parity(self):
+        """``fusion=False`` reproduces the pre-fusion engine: no fused
+        dispatches, no fusion keys in the scheduler block, and
+        bit-exact scores under the same contended start."""
+        cfg = sst.TpuConfig(max_tasks_per_batch=16, fusion=False)
+        ref_a = logreg_search(GRID_A, cfg).fit(X, y)
+        ref_b = logreg_search(GRID_B, cfg).fit(X, y)
+        sess = sst.createLocalTpuSession("fuse-off", config=cfg)
+        try:
+            a, b = run_concurrent(sess, [
+                logreg_search(GRID_A, cfg), logreg_search(GRID_B, cfg)])
+            np.testing.assert_array_equal(scores(a), scores(ref_a))
+            np.testing.assert_array_equal(scores(b), scores(ref_b))
+            for s in (sched(a), sched(b)):
+                assert set(s) == \
+                    {d.name for d in SCHEDULER_BLOCK_SCHEMA} \
+                    - FUSION_KEYS
+                assert s["enabled"] is True
+        finally:
+            sess.stop()
+
+    def test_fusion_on_default_block_matches_full_schema(self):
+        sess = sst.createLocalTpuSession(
+            "fuse-on", config=sst.TpuConfig(max_tasks_per_batch=16))
+        try:
+            fut = sess.submit(logreg_search(GRID_A), X, y)
+            got = fut.result(timeout=180)
+            s = sched(got)
+            assert set(s) == {d.name for d in SCHEDULER_BLOCK_SCHEMA}
+            # a solo search has no peers: the counters exist but zero
+            assert s["n_fused"] == 0 and s["lanes_donated"] == 0
+        finally:
+            sess.stop()
+
+    def test_env_escape_hatch_disables_fusion(self, monkeypatch):
+        monkeypatch.setenv("SST_FUSION", "0")
+        assert serve.resolve_fusion(None) is False
+        monkeypatch.setenv("SST_FUSION", "1")
+        assert serve.resolve_fusion(None) is True
+        monkeypatch.delenv("SST_FUSION")
+        assert serve.resolve_fusion(None) is True
+        assert serve.resolve_fusion(
+            sst.TpuConfig(fusion=False)) is False

@@ -96,10 +96,18 @@ class _BlockingSearch:
 # ---------------------------------------------------------------------------
 
 
+#: schema keys that ride only when fusion is resolved ON (the schema
+#: marks them conditional) — the standalone/disabled block stays
+#: byte-identical to the pre-fusion engine
+FUSION_KEYS = {"n_fused", "lanes_donated", "lanes_borrowed",
+               "fusion_saved_launches"}
+
+
 class TestSchedulerBlock:
     def test_disabled_shape_matches_schema(self):
         block = serve.report_block(None)
-        assert set(block) == {d.name for d in SCHEDULER_BLOCK_SCHEMA}
+        assert set(block) == \
+            {d.name for d in SCHEDULER_BLOCK_SCHEMA} - FUSION_KEYS
         assert block["enabled"] is False
         assert block["n_dispatches"] == 0
 

@@ -47,6 +47,9 @@ _WATCHED = (
     # uncontended-capacity bench admits everything and sheds nothing,
     # so any increase is a capacity or admission regression
     ("serve_shed", "up"),
+    # aggregate searches/min at the deepest contended serve level —
+    # the throughput cross-search launch fusion is accountable for
+    ("serve_spm", "down"),
 )
 
 
@@ -73,12 +76,15 @@ def _round_row(path: str) -> Dict[str, Any]:
     # the leg recorded admission/protection ledgers
     serve = det.get("serve_contended") or {}
     shed = None
+    spm = None
     for key in sorted(k for k in serve if k.startswith("contended_")):
         adm = serve[key].get("admission")
         prot = serve[key].get("protection")
         if adm is not None and prot is not None:
             shed = (adm.get("rejected", 0) + prot.get("shed", 0)
                     + prot.get("quarantined", 0))
+        if serve[key].get("searches_per_min") is not None:
+            spm = serve[key]["searches_per_min"]
     return {
         "round": n,
         "rc": payload.get("rc"),
@@ -87,6 +93,7 @@ def _round_row(path: str) -> Dict[str, Any]:
         "halving_speedup": ha.get("wall_ratio_exhaustive_over_halving"),
         "store_hit_rate": hit_rate,
         "serve_shed": shed,
+        "serve_spm": spm,
         "parsed": bool(det),
     }
 
@@ -158,14 +165,16 @@ def _fmt(v: Any, nd: int = 2) -> str:
 
 def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
-           f"{'halving x':>10} {'hit rate':>9} {'shed':>6}"]
+           f"{'halving x':>10} {'hit rate':>9} {'shed':>6} "
+           f"{'srch/min':>9}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
             f"{_fmt(r['wall_s_cold']):>9} {_fmt(r['wall_s_warm']):>9} "
             f"{_fmt(r['halving_speedup']):>10} "
             f"{_fmt(r['store_hit_rate']):>9} "
-            f"{_fmt(r.get('serve_shed'), 0):>6}"
+            f"{_fmt(r.get('serve_shed'), 0):>6} "
+            f"{_fmt(r.get('serve_spm')):>9}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

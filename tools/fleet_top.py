@@ -7,7 +7,9 @@ prints the per-tenant SLO table (queue-wait p50/p95, throughput,
 share, data-plane resident bytes), device occupancy, scheduler queue
 depth, data-plane and program-store traffic, the device-memory
 ledger's pressure line (per-device HBM %, modeled peak, watermark),
-fault totals and flight-recorder state:
+the cross-search fusion line (fused dispatch counts, launches saved,
+per-tenant lanes borrowed/donated), fault totals and flight-recorder
+state:
 
     python tools/fleet_top.py --port 9090            # one shot
     python tools/fleet_top.py --port 9090 --watch 2  # refresh every 2s
@@ -136,6 +138,24 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
                  f"{prot.get('quarantined_total', 0)} quarantined, "
                  f"{prot.get('deadline_hits_total', 0)} deadline "
                  "hit(s)")
+        out.append(line)
+    fus = snap.get("fusion") or {}
+    if fus.get("fused_total"):
+        lanes_real = fus.get("lanes_real_total", 0)
+        lanes_pad = fus.get("lanes_padded_total", 0)
+        line = (f"fusion: {fus.get('fused_total', 0)} fused launch(es) "
+                f"carrying {fus.get('members_total', 0)} chunk(s), "
+                f"{fus.get('saved_launches_total', 0)} launch(es) "
+                f"saved, {lanes_real}/{lanes_pad} real/padded lanes")
+        exchange = ", ".join(
+            f"{name} +{n}" for name, n in sorted(
+                (fus.get("lanes_borrowed_by_tenant") or {}).items()))
+        donated = ", ".join(
+            f"{name} -{n}" for name, n in sorted(
+                (fus.get("lanes_donated_by_tenant") or {}).items()))
+        if exchange or donated:
+            line += ("; lanes borrowed/donated: "
+                     + "; ".join(x for x in (exchange, donated) if x))
         out.append(line)
     faults = snap.get("faults") or {}
     if faults.get("total"):

@@ -152,7 +152,8 @@ class Project:
                             attrs=("_tenants", "_active", "_pending",
                                    "_workers", "_rr", "_seq",
                                    "_last_handle", "_cost_by_tenant",
-                                   "_dispatch_log", "_recent_walls")),
+                                   "_dispatch_log", "_recent_walls",
+                                   "_fuse_defer")),
                 # dataplane: per-tenant quota/usage accounting
                 SharedState("parallel/dataplane.py",
                             "dataplane.DataPlane._lock", cls="DataPlane",
@@ -178,6 +179,8 @@ class Project:
                                    "_regression",
                                    "_admission", "_admission_reasons",
                                    "_protection",
+                                   "_fusion", "_fusion_borrowed",
+                                   "_fusion_donated",
                                    "_providers", "_polls",
                                    "_n_samples")),
                 # obs/telemetry: the always-on flight-recorder ring
