@@ -1100,6 +1100,81 @@ def leg_halving(cache_dir=None, n_rows=484, n_candidates=96, folds=2,
     }
 
 
+def leg_stream_sparse(cache_dir=None, n=4_000, d=512, density=0.01,
+                      n_alphas=6, folds=3, budget_mib=4):
+    """Out-of-core data tiers (ISSUE PR 15): the SAME NB grid run three
+    ways — dense in-core, `data_mode="sparse"` (BCOO Tier-A), and a
+    budget-constrained `data_mode="stream"` — recording the dense-vs-
+    BCOO h2d bytes/wall/launches and the streamed plan (shard count,
+    streamed h2d volume, zero-bisection completion under a budget the
+    dense upload could never fit)."""
+    import numpy as np
+    import scipy.sparse as sp
+    from sklearn.naive_bayes import MultinomialNB
+
+    import spark_sklearn_tpu as sst
+    from spark_sklearn_tpu.parallel import dataplane as _dataplane
+
+    rng = np.random.default_rng(0)
+    Xs = sp.random(n, d, density=density, format="csr", random_state=rng)
+    Xs.data = np.ceil(Xs.data * 5).astype(np.float64)
+    y = rng.integers(0, 3, size=n)
+    grid = {"alpha": np.logspace(-2, 2, n_alphas).tolist()}
+
+    def run(X, **cfg_kw):
+        gs = sst.GridSearchCV(
+            MultinomialNB(), grid, cv=folds, refit=False,
+            backend="tpu",
+            config=sst.TpuConfig(compilation_cache_dir=cache_dir,
+                                 **cfg_kw))
+        before = _dataplane.bytes_uploaded()
+        t0 = time.perf_counter()
+        gs.fit(X, y)
+        return gs, round(time.perf_counter() - t0, 3), \
+            int(_dataplane.bytes_uploaded() - before)
+
+    dense_gs, dense_wall, dense_h2d = run(Xs.toarray())
+    sparse_gs, sparse_wall, sparse_h2d = run(Xs, data_mode="sparse")
+    stream_gs, stream_wall, stream_h2d = run(
+        Xs.toarray(), data_mode="stream",
+        hbm_budget_bytes=int(budget_mib * (1 << 20)),
+        memory_ledger=True)
+    blk = stream_gs.search_report["streaming"]
+    agree = np.allclose(dense_gs.cv_results_["mean_test_score"],
+                        sparse_gs.cv_results_["mean_test_score"],
+                        atol=1e-6)
+    return {
+        "shape": f"{n}x{d} CSR @ {density:.0%} nnz, "
+                 f"{n_alphas} alphas x {folds} folds",
+        "dense_x_bytes": int(n * d * 4),
+        "nnz_component_bytes": int(Xs.data.nbytes + Xs.indices.nbytes
+                                   + Xs.indptr.nbytes),
+        "dense_wall_s": dense_wall,
+        "sparse_wall_s": sparse_wall,
+        "stream_wall_s": stream_wall,
+        "dense_h2d_bytes": dense_h2d,
+        "sparse_h2d_bytes": sparse_h2d,
+        "stream_h2d_bytes": stream_h2d,
+        "sparse_over_dense_h2d": round(sparse_h2d / dense_h2d, 4)
+        if dense_h2d else 0.0,
+        "n_launches_dense": int(
+            dense_gs.search_report.get("n_launches", 0)),
+        "n_launches_sparse": int(
+            sparse_gs.search_report.get("n_launches", 0)),
+        "n_launches_stream": int(
+            stream_gs.search_report.get("n_launches", 0)),
+        "sparse_scores_match_dense": bool(agree),
+        "stream_budget_mib": budget_mib,
+        "stream_n_shards": blk["n_shards"],
+        "stream_shard_rows": blk["shard_rows"],
+        "stream_capped": blk["capped"],
+        "stream_block_h2d_bytes": blk["h2d_bytes"],
+        "stream_bisections": int(stream_gs.search_report.get(
+            "faults", {}).get("bisections", 0)),
+        "memory": _memory_summary(stream_gs.search_report),
+    }
+
+
 #: (detail key, leg fn, kwargs builder) for the breadth legs the TPU
 #: child runs after the headline; each failure is contained per-leg.
 _BREADTH_LEGS = [
@@ -1111,6 +1186,7 @@ _BREADTH_LEGS = [
     ("keyed_1000models", leg_keyed, {}),
     ("serve_contended", leg_serve_contended, {}),
     ("halving_adaptive", leg_halving, {}),
+    ("stream_sparse", leg_stream_sparse, {}),
 ]
 
 #: scaled-down per-leg kwargs for the BENCH_FORCE_BREADTH=1 rehearsal
@@ -1135,6 +1211,8 @@ _BREADTH_TOY_KWARGS = {
                             max_iter=5, levels=(2,)),
     "halving_adaptive": dict(n_rows=242, n_candidates=48, folds=2,
                              max_iter=10),
+    "stream_sparse": dict(n=400, d=64, n_alphas=3, folds=2,
+                          budget_mib=0.25),
 }
 
 

@@ -486,6 +486,82 @@ JAX_PLATFORMS=cpu python tools/trace_summary.py \
     "$MEM_FLIGHT_DIR"/flight-oom-*.json | grep -q "flight-bundle ledger"
 rm -rf "$MEM_FLIGHT_DIR"
 
+echo "== streaming data-plane smoke (budgeted shards + kill-resume, bit-exact) =="
+STREAM_CKPT_DIR=$(mktemp -d /tmp/sst_stream_smoke_XXXX)
+JAX_PLATFORMS=cpu SST_STREAM_CKPT_DIR="$STREAM_CKPT_DIR" python - <<'PY'
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.naive_bayes import MultinomialNB
+import spark_sklearn_tpu as sst
+
+rng = np.random.default_rng(7)
+X = rng.integers(0, 6, size=(600, 40)).astype(np.float64)
+y = rng.integers(0, 3, size=600)
+grid = {"alpha": [0.1, 1.0, 10.0]}
+
+
+def run(**kw):
+    return sst.GridSearchCV(MultinomialNB(), grid, cv=3, refit=False,
+                            backend="tpu",
+                            config=sst.TpuConfig(**kw)).fit(X, y)
+
+
+base = run()
+# a budget ~1% of the dataset: the planner (not OOM trial-and-error)
+# sizes the shards, the streamed search completes with ZERO bisections
+# and stays BIT-exact with the in-core device path
+gs = run(data_mode="stream", hbm_budget_bytes=64 << 10,
+         memory_ledger=True)
+blk = gs.search_report["streaming"]
+assert blk["capped"] and blk["n_shards"] >= 3, blk
+assert gs.search_report.get("faults", {}).get("bisections", 0) == 0
+for i in range(3):
+    np.testing.assert_array_equal(
+        base.cv_results_[f"split{i}_test_score"],
+        gs.cv_results_[f"split{i}_test_score"])
+
+# kill-resume: die right after the 2nd per-shard fit record is
+# durable, then resume from the journal — still bit-exact
+from spark_sklearn_tpu.utils.checkpoint import SearchCheckpoint
+ckpt_dir = os.environ["SST_STREAM_CKPT_DIR"]
+real_put, seen = SearchCheckpoint.put, {"n": 0}
+
+
+def dying_put(self, chunk_id, record):
+    real_put(self, chunk_id, record)
+    if chunk_id.startswith("st:fit:"):
+        seen["n"] += 1
+        if seen["n"] >= 2:
+            raise RuntimeError("injected mid-stream kill")
+
+
+SearchCheckpoint.put = dying_put
+try:
+    run(data_mode="stream", stream_shard_bytes=150 * 360,
+        checkpoint_dir=ckpt_dir)
+    raise SystemExit("injected kill did not fire")
+except RuntimeError:
+    pass
+finally:
+    SearchCheckpoint.put = real_put
+resumed = run(data_mode="stream", stream_shard_bytes=150 * 360,
+              checkpoint_dir=ckpt_dir)
+rblk = resumed.search_report["streaming"]
+assert rblk["fit_shards_resumed"] >= 1, rblk
+for i in range(3):
+    np.testing.assert_array_equal(
+        base.cv_results_[f"split{i}_test_score"],
+        resumed.cv_results_[f"split{i}_test_score"])
+print("stream smoke:",
+      {k: blk[k] for k in ("n_shards", "shard_rows", "capped",
+                           "h2d_bytes")},
+      "resumed:", {k: rblk[k] for k in ("fit_shards_resumed",
+                                        "fit_shards_streamed")})
+PY
+rm -rf "$STREAM_CKPT_DIR"
+
 echo "== fault-injection smoke (TRANSIENT + OOM plan, CPU grid) =="
 JAX_PLATFORMS=cpu python - <<'PY'
 import numpy as np

@@ -81,6 +81,19 @@ class Family:
     #: of dispatchers that synthesise that dict (the keyed fleet)
     keyed_compatible: bool = True
 
+    #: True when fit/predict tolerate data["X"] as a BCOO device operand
+    #: (matmuls in operator form, no dense-only ops on X) AND the family
+    #: implements `prepare_data_sparse` — consumed by the engine's
+    #: `data_mode="sparse"` tier
+    supports_sparse: bool = False
+
+    #: True when the family implements the streaming-fold protocol
+    #: (`stream_fit_partial` / `stream_fit_finalize`): per-fold fit
+    #: statistics that are candidate-independent, additive over sample
+    #: shards, and exactly reconstruct the in-core fit — consumed by the
+    #: engine's `data_mode="stream"` tier
+    supports_stream: bool = False
+
     @classmethod
     def has_per_task_fit(cls) -> bool:
         """True when the family implements the per-task `fit` (some, like
@@ -99,6 +112,40 @@ class Family:
     def prepare_data(cls, X, y, dtype=np.float32):
         """-> (data: dict of arrays ready for device, meta: dict of host
         facts).  Called once per search, not per candidate."""
+        raise NotImplementedError
+
+    @classmethod
+    def prepare_data_sparse(cls, X, y, dtype=np.float32):
+        """Sparse twin of `prepare_data`: `X` is a scipy CSR matrix and
+        the returned data dict carries it as a
+        `sparse.csr.SparseOperand` under "X" (the engine uploads its
+        components and reassembles a device BCOO).  Host-side input
+        validation (finiteness, sign checks) runs on `X.data` — never on
+        a densified form.  Only meaningful with `supports_sparse`."""
+        raise NotImplementedError
+
+    # --- streaming-fold protocol (data_mode="stream") ---------------------
+    # Per-fold fit statistics must be candidate-independent within one
+    # compile group (static params may enter; dynamic ones may not) and
+    # additive over row shards: the engine folds
+    #   acc <- stream_fit_accumulate(acc, stream_fit_partial(shard))
+    # on device in shard order, then vmaps stream_fit_finalize over the
+    # chunk's candidates.  Scoring streams through the ordinary
+    # `predict` on each shard.
+    @classmethod
+    def stream_fit_partial(cls, static, data, fit_w, meta):
+        """One shard's per-fold fit statistics.  `data` holds the
+        shard's row slices (same keys as `prepare_data`'s dict);
+        `fit_w` is the (n_folds, shard_rows) fit-mask slice.  Returns a
+        pytree whose leaves carry a leading fold axis and sum exactly
+        across shards."""
+        raise NotImplementedError
+
+    @classmethod
+    def stream_fit_finalize(cls, dynamic, static, stats, meta):
+        """Folded statistics (one fold's slice, no fold axis) + one
+        candidate's dynamic params -> the same model pytree `fit`
+        returns.  The engine vmaps candidates x folds around this."""
         raise NotImplementedError
 
     # --- device side (pure, jit/vmap-safe) -------------------------------

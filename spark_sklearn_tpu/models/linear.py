@@ -28,6 +28,17 @@ from spark_sklearn_tpu.models.base import Family, encode_labels, register_family
 from spark_sklearn_tpu.ops.solvers import lbfgs
 
 
+def _is_bcoo(X) -> bool:
+    """True when X is a device BCOO operand (the sparse Tier-A path).
+    jnp.matmul/einsum reject BCOO, so the matmul sites below switch to
+    the equivalent `@`-operator forms when this holds."""
+    try:
+        from jax.experimental import sparse as jsparse
+    except ImportError:       # pragma: no cover - jax always ships it
+        return False
+    return isinstance(X, jsparse.BCOO)
+
+
 # ----------------------------------------------------------------------------
 # Logistic regression
 # ----------------------------------------------------------------------------
@@ -36,6 +47,9 @@ class LogisticRegressionFamily(Family):
     name = "logistic_regression"
     is_classifier = True
     dynamic_params = {"C": np.float32, "tol": np.float32}
+    #: the GLM solvers only touch X through Ax/AT, both expressible as
+    #: BCOO-legal operator-form matmuls
+    supports_sparse = True
 
     #: sorted chunking needs enough candidates to amortise the extra
     #: dispatches on the GLM solvers (policy applied by the engine)
@@ -59,6 +73,20 @@ class LogisticRegressionFamily(Family):
         }
         meta = {"n_classes": int(len(classes)), "classes": classes,
                 "n_features": int(X.shape[1])}
+        return data, meta
+
+    @classmethod
+    def prepare_data_sparse(cls, X, y, dtype=np.float32):
+        from spark_sklearn_tpu.sparse.csr import SparseOperand
+        classes, y_enc = encode_labels(y)
+        op = SparseOperand.from_csr(X, dtype=dtype)
+        data = {"X": op,
+                "y": y_enc,
+                "y1h": np.eye(len(classes), dtype=dtype)[y_enc]}
+        # signature tuple (truthy, hashable) -> program-store/fusion
+        # keys via freeze(meta); see naive_bayes._prep_classifier_sparse
+        meta = {"n_classes": int(len(classes)), "classes": classes,
+                "n_features": int(X.shape[1]), "sparse": op.signature()}
         return data, meta
 
     @classmethod
@@ -171,17 +199,23 @@ class LogisticRegressionFamily(Family):
         inv_C = inv_C_raw if penalty == "l2" else jnp.zeros_like(C)
         wT = train_w.T                                        # (n, B)
         # MXU-native precision: cast matmul OPERANDS to bf16, accumulate
-        # fp32; everything else (losses, solver state) stays fp32
-        bf16 = bool(static.get("__bf16__", False))
+        # fp32; everything else (losses, solver state) stays fp32.  A
+        # BCOO X stays in its own dtype (f32) — the sparse matmuls run
+        # as gather/scatter, where a bf16 downcast buys nothing
+        sparse_X = _is_bcoo(X)
+        bf16 = bool(static.get("__bf16__", False)) and not sparse_X
         mm_dtype = jnp.bfloat16 if bf16 else X.dtype
-        Xm = X.astype(mm_dtype)
+        Xm = X if sparse_X else X.astype(mm_dtype)
 
         if k == 2:
             yb = data["y"].astype(X.dtype)                    # (n,)
 
             def Ax(x):                                        # -> Z (n, B)
-                Z = jnp.matmul(Xm, x[:, :d].astype(mm_dtype).T,
-                               preferred_element_type=X.dtype)
+                if sparse_X:
+                    Z = Xm @ x[:, :d].T
+                else:
+                    Z = jnp.matmul(Xm, x[:, :d].astype(mm_dtype).T,
+                                   preferred_element_type=X.dtype)
                 return Z + x[None, :, d] if fit_intercept else Z
 
             def data_loss(Z):
@@ -192,8 +226,11 @@ class LogisticRegressionFamily(Family):
                 return wT * (jax.nn.sigmoid(Z) - yb[:, None])
 
             def AT(G):                                        # -> (B, d+1)
-                gW = jnp.matmul(G.astype(mm_dtype).T, Xm,
-                                preferred_element_type=X.dtype)
+                if sparse_X:
+                    gW = G.T @ Xm
+                else:
+                    gW = jnp.matmul(G.astype(mm_dtype).T, Xm,
+                                    preferred_element_type=X.dtype)
                 gb = jnp.sum(G, axis=0) if fit_intercept else \
                     jnp.zeros((B,), X.dtype)
                 return jnp.concatenate([gW, gb[:, None]], axis=1)
@@ -228,9 +265,15 @@ class LogisticRegressionFamily(Family):
         kd = k * d
 
         def Ax(x):                                            # -> Z (n,B,k)
-            W = x[:, :kd].reshape(B, k, d).astype(mm_dtype)
-            Z = jnp.einsum("nd,bkd->nbk", Xm, W,              # ONE matmul
-                           preferred_element_type=X.dtype)
+            W = x[:, :kd].reshape(B, k, d)
+            if sparse_X:
+                # einsum rejects BCOO; the reshape-matmul form is the
+                # identical contraction
+                Z = (Xm @ W.reshape(B * k, d).T).reshape(n, B, k)
+            else:
+                Z = jnp.einsum("nd,bkd->nbk", Xm,             # ONE matmul
+                               W.astype(mm_dtype),
+                               preferred_element_type=X.dtype)
             return Z + x[None, :, kd:] if fit_intercept else Z
 
         def data_loss(Z):
@@ -243,8 +286,11 @@ class LogisticRegressionFamily(Family):
             return wT[:, :, None] * (P - y1h[:, None, :])
 
         def AT(G):                                            # -> (B, D)
-            gW = jnp.einsum("nbk,nd->bkd", G.astype(mm_dtype), Xm,
-                            preferred_element_type=X.dtype)   # ONE matmul
+            if sparse_X:
+                gW = (G.reshape(n, B * k).T @ Xm).reshape(B, k, d)
+            else:
+                gW = jnp.einsum("nbk,nd->bkd", G.astype(mm_dtype), Xm,
+                                preferred_element_type=X.dtype)
             gW = gW.reshape(B, kd)
             gb = jnp.sum(G, axis=0) if fit_intercept else \
                 jnp.zeros((B, k), X.dtype)
@@ -296,8 +342,11 @@ class LogisticRegressionFamily(Family):
         W = models["coef"]                                 # (T, k, d)
         b = models["intercept"]                            # (T, k)
         T, k, d = W.shape
-        Z = jnp.matmul(X, W.reshape(T * k, d).T,           # ONE matmul
-                       preferred_element_type=X.dtype)
+        if _is_bcoo(X):
+            Z = X @ W.reshape(T * k, d).T                  # ONE matmul
+        else:
+            Z = jnp.matmul(X, W.reshape(T * k, d).T,       # ONE matmul
+                           preferred_element_type=X.dtype)
         Z = Z.reshape(n, T, k) + b[None]
         Z = jnp.moveaxis(Z, 0, 1)                          # (T, n, k)
         views = {}
@@ -408,6 +457,10 @@ class RidgeFamily(Family):
     # rounding ~1e-4 past sklearn's f64 answers, so the search engine runs
     # this family under x64 (tiny d x d solves — negligible cost)
     wants_float64 = True
+    #: the fit is a function of raw second moments {sum w, w@X, sum wy,
+    #: X'WX, X'Wy} — additive over row shards; finalize re-centres them
+    #: (x64, so the moment expansion stays at solver tolerance)
+    supports_stream = True
 
     @classmethod
     def prepare_data(cls, X, y, dtype=np.float32):
@@ -429,6 +482,52 @@ class RidgeFamily(Family):
         w = jax.scipy.linalg.solve(A, b, assume_a="pos")
         intercept = ym - jnp.dot(xm, w)
         return {"coef": w, "intercept": intercept}
+
+    # --- streaming-fold protocol -----------------------------------------
+    @classmethod
+    def stream_fit_partial(cls, static, data, fit_w, meta):
+        if static.get("positive", False):
+            raise ValueError(
+                "positive=True is not compiled; use the host backend")
+        X, y = data["X"], data["y"]
+
+        def one_fold(w):
+            Xw = X * w[:, None]
+            return {"wsum": jnp.sum(w), "s": w @ X,
+                    "ys": jnp.sum(w * y),
+                    "G": Xw.T @ X, "c": Xw.T @ y}
+
+        return jax.vmap(one_fold)(fit_w)
+
+    @classmethod
+    def stream_fit_finalize(cls, dynamic, static, stats, meta):
+        if static.get("positive", False):
+            raise ValueError(
+                "positive=True is not compiled; use the host backend")
+        G, s, c = stats["G"], stats["s"], stats["c"]
+        dt = G.dtype
+        d = s.shape[0]
+        alpha = jnp.asarray(dynamic.get("alpha", static.get("alpha", 1.0)),
+                            dt)
+        if bool(static.get("fit_intercept", True)):
+            # centred normal equations from raw moments:
+            #   A = X'WX - s xm' - xm s' + (sum w) xm xm'
+            #   b = X'Wy - ym s - ys xm + (sum w) xm ym
+            # (xm, ym use the same eps-guarded weight sum as
+            # _weighted_center)
+            wsum = stats["wsum"] + jnp.finfo(dt).eps
+            xm = s / wsum
+            ym = stats["ys"] / wsum
+            A = G - jnp.outer(s, xm) - jnp.outer(xm, s) \
+                + stats["wsum"] * jnp.outer(xm, xm)
+            b = c - ym * s - stats["ys"] * xm + stats["wsum"] * xm * ym
+        else:
+            A, b = G, c
+            xm = jnp.zeros((d,), dt)
+            ym = jnp.asarray(0.0, dt)
+        A = A + alpha * jnp.eye(d, dtype=dt)
+        w = jax.scipy.linalg.solve(A, b, assume_a="pos")
+        return {"coef": w, "intercept": ym - jnp.dot(xm, w)}
 
     @classmethod
     def predict(cls, model, static, X, meta):
@@ -453,6 +552,9 @@ class RidgeFamily(Family):
 
 class LinearRegressionFamily(RidgeFamily):
     name = "linear_regression"
+    # lstsq's minimum-norm answer on rank-deficient X is NOT a function
+    # of the normal-equation moments — undo the inherited capability
+    supports_stream = False
 
     @classmethod
     def fit(cls, dynamic, static, data, train_w, meta):

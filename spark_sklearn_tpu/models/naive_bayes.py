@@ -54,6 +54,25 @@ def _prep_classifier_data(X, y, dtype, x_override=None):
     return data, meta
 
 
+def _prep_classifier_sparse(X, y, dtype):
+    """Sparse twin of `_prep_classifier_data`: X is scipy CSR and stays
+    a `SparseOperand` — labels/one-hot build exactly as on the dense
+    path, X itself is never densified."""
+    from spark_sklearn_tpu.sparse.csr import SparseOperand
+    classes, y_enc = encode_labels(y)
+    k = len(classes)
+    op = SparseOperand.from_csr(X, dtype=dtype)
+    data = {"X": op,
+            "y": y_enc,
+            "y1h": np.eye(k, dtype=dtype)[y_enc]}
+    # the operand's signature tuple (truthy, hashable): flows through
+    # freeze(meta) into ProgramStore keys and fusion keys, so a sparse
+    # program can never alias a dense one with the same dense shape
+    meta = {"n_classes": int(k), "classes": classes,
+            "n_features": int(X.shape[1]), "sparse": op.signature()}
+    return data, meta
+
+
 def _class_sums(y1h, w, X=None):
     """Weighted per-class row sums: counts (k,), the (n, k) weighted
     one-hot used to build them, and, with X, per-class weighted feature
@@ -195,6 +214,11 @@ class MultinomialNBFamily(Family):
     name = "multinomial_nb"
     is_classifier = True
     dynamic_params = {"alpha": np.float32}
+    # the fit is {counts, feature counts} -> closed form: the count sums
+    # are one `wy.T @ X` (operator form, BCOO-legal) and additive over
+    # row shards, so both out-of-core tiers apply
+    supports_sparse = True
+    supports_stream = True
 
     @classmethod
     def observe_candidates(cls, candidates, base_params, meta):
@@ -239,6 +263,18 @@ class MultinomialNBFamily(Family):
         return _prep_classifier_data(X, y, dtype)
 
     @classmethod
+    def prepare_data_sparse(cls, X, y, dtype=np.float32):
+        # the sign/finiteness contract runs on the stored values only —
+        # implicit zeros are non-negative and finite by construction
+        Xd = np.asarray(X.data)
+        cls._check_finite(Xd)
+        if Xd.size and np.min(Xd) < 0:
+            raise ValueError(
+                f"Negative values in data passed to "
+                f"{cls._sklearn_display} (input X)")
+        return _prep_classifier_sparse(X, y, dtype)
+
+    @classmethod
     def _alpha(cls, dynamic, static, dtype):
         a = jnp.asarray(dynamic.get("alpha", static.get("alpha", 1.0)),
                         dtype)
@@ -247,17 +283,49 @@ class MultinomialNBFamily(Family):
         return a
 
     @classmethod
-    def fit(cls, dynamic, static, data, train_w, meta):
-        X, y1h = data["X"], data["y1h"]
+    def _fit_X(cls, static, X):
+        """The matrix the count sums run over (Bernoulli binarizes)."""
+        return X
+
+    @classmethod
+    def _model_from_sums(cls, dynamic, static, counts, fc, meta, dtype):
+        """Closed-form model from the sufficient statistics
+        (class counts (k,), per-class feature sums (k, d)) — the shared
+        tail of `fit` and `stream_fit_finalize`, so the streamed fit is
+        the in-core fit by construction."""
         k = meta["n_classes"]
-        a = cls._alpha(dynamic, static, X.dtype)
-        counts, _wy, fc = _class_sums(y1h, train_w, X)  # (k,), (k, d)
+        a = cls._alpha(dynamic, static, dtype)
         smoothed = fc + a
         flp = jnp.log(smoothed) \
             - jnp.log(jnp.sum(smoothed, axis=1))[:, None]
         return {"feature_log_prob": flp,
-                "class_log_prior": _log_prior(counts, static, k, X.dtype),
+                "class_log_prior": _log_prior(counts, static, k, dtype),
                 "class_count": counts}
+
+    @classmethod
+    def fit(cls, dynamic, static, data, train_w, meta):
+        X = cls._fit_X(static, data["X"])
+        counts, _wy, fc = _class_sums(data["y1h"], train_w, X)
+        return cls._model_from_sums(dynamic, static, counts, fc, meta,
+                                    X.dtype)
+
+    # --- streaming-fold protocol -----------------------------------------
+    @classmethod
+    def stream_fit_partial(cls, static, data, fit_w, meta):
+        X = cls._fit_X(static, data["X"])
+        y1h = data["y1h"]
+
+        def one_fold(w):
+            counts, _wy, fc = _class_sums(y1h, w, X)
+            return {"count": counts, "fc": fc}
+
+        return jax.vmap(one_fold)(fit_w)        # leaves: (F, ...) sums
+
+    @classmethod
+    def stream_fit_finalize(cls, dynamic, static, stats, meta):
+        return cls._model_from_sums(dynamic, static, stats["count"],
+                                    stats["fc"], meta,
+                                    stats["fc"].dtype)
 
     @classmethod
     def _jll(cls, model, X):
@@ -289,11 +357,9 @@ class ComplementNBFamily(MultinomialNBFamily):
     _sklearn_display = "ComplementNB"
 
     @classmethod
-    def fit(cls, dynamic, static, data, train_w, meta):
-        X, y1h = data["X"], data["y1h"]
+    def _model_from_sums(cls, dynamic, static, counts, fc, meta, dtype):
         k = meta["n_classes"]
-        a = cls._alpha(dynamic, static, X.dtype)
-        counts, _wy, fc = _class_sums(y1h, train_w, X)
+        a = cls._alpha(dynamic, static, dtype)
         comp = jnp.sum(fc, axis=0)[None, :] + a - fc          # (k, d)
         logged = jnp.log(comp / jnp.sum(comp, axis=1, keepdims=True))
         if static.get("norm", False):
@@ -301,7 +367,7 @@ class ComplementNBFamily(MultinomialNBFamily):
         else:
             flp = -logged
         return {"feature_log_prob": flp,
-                "class_log_prior": _log_prior(counts, static, k, X.dtype),
+                "class_log_prior": _log_prior(counts, static, k, dtype),
                 "class_count": counts}
 
     @classmethod
@@ -326,23 +392,55 @@ class BernoulliNBFamily(MultinomialNBFamily):
         return _prep_classifier_data(X, y, dtype)
 
     @classmethod
-    def _binarized(cls, static, X):
-        b = static.get("binarize", 0.0)
-        return X if b is None else (X > b).astype(X.dtype)
+    def prepare_data_sparse(cls, X, y, dtype=np.float32):
+        cls._check_finite(np.asarray(X.data))
+        return _prep_classifier_sparse(X, y, dtype)
 
     @classmethod
-    def fit(cls, dynamic, static, data, train_w, meta):
-        X = cls._binarized(static, data["X"])
-        y1h = data["y1h"]
+    def observe_candidates(cls, candidates, base_params, meta):
+        super().observe_candidates(candidates, base_params, meta)
+        if not meta.get("sparse"):
+            return
+        # binarize < 0 turns every implicit zero into a 1 — a DENSE
+        # matrix in BCOO clothing; refuse host-side rather than emit a
+        # silently-wrong sparse program
+        b0 = base_params.get("binarize", 0.0)
+        for params in [base_params] + list(candidates):
+            b = params.get("binarize", b0)
+            if b is not None and float(b) < 0:
+                raise ValueError(
+                    "binarize < 0 densifies a sparse X (implicit zeros "
+                    "binarize to 1); use data_mode='device'")
+
+    @classmethod
+    def _binarized(cls, static, X):
+        b = static.get("binarize", 0.0)
+        if b is None:
+            return X
+        from jax.experimental import sparse as jsparse
+        if isinstance(X, jsparse.BCOO):
+            # threshold the stored values in place; implicit zeros stay
+            # zero (b >= 0 is enforced host-side on the sparse path)
+            return jsparse.BCOO(
+                ((X.data > b).astype(X.data.dtype), X.indices),
+                shape=X.shape, indices_sorted=X.indices_sorted,
+                unique_indices=X.unique_indices)
+        return (X > b).astype(X.dtype)
+
+    @classmethod
+    def _fit_X(cls, static, X):
+        return cls._binarized(static, X)
+
+    @classmethod
+    def _model_from_sums(cls, dynamic, static, counts, fc, meta, dtype):
         k = meta["n_classes"]
-        a = cls._alpha(dynamic, static, X.dtype)
-        counts, _wy, fc = _class_sums(y1h, train_w, X)
+        a = cls._alpha(dynamic, static, dtype)
         # two-sided smoothing: p_cf = (N_cf + a) / (N_c + 2a)
         log_p = jnp.log(fc + a) - jnp.log(counts + 2.0 * a)[:, None]
         log_1mp = jnp.log(counts[:, None] - fc + a) \
             - jnp.log(counts + 2.0 * a)[:, None]
         return {"feature_log_prob": log_p, "log_neg_prob": log_1mp,
-                "class_log_prior": _log_prior(counts, static, k, X.dtype),
+                "class_log_prior": _log_prior(counts, static, k, dtype),
                 "class_count": counts}
 
     @classmethod
@@ -392,6 +490,11 @@ class CategoricalNBFamily(MultinomialNBFamily):
 
     name = "categorical_nb"
     _sklearn_display = "CategoricalNB"
+    # int codes + one-hot einsums: neither the BCOO operator forms nor
+    # the additive-sums streaming protocol apply — undo the inherited
+    # Multinomial capabilities
+    supports_sparse = False
+    supports_stream = False
     #: consumes int codes + search-resolved n_categories meta, which the
     #: keyed fleet's generic build_fit_data cannot synthesise (same
     #: opt-out as the binned tree families) — keyed CategoricalNB runs

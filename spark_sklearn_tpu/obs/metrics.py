@@ -35,6 +35,7 @@ __all__ = [
     "SCHEDULER_BLOCK_SCHEMA",
     "HALVING_BLOCK_SCHEMA",
     "MEMORY_BLOCK_SCHEMA",
+    "STREAMING_BLOCK_SCHEMA",
     "ATTRIBUTION_BLOCK_SCHEMA",
     "PROTECTION_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
@@ -170,6 +171,14 @@ SEARCH_REPORT_SCHEMA = (
         "(parallel/memledger.py).  Absent when "
         "TpuConfig(memory_ledger=False) — the byte-identical "
         "pre-ledger report shape."),
+    MetricDef(
+        "streaming", "struct",
+        "The streaming-fold data plane's per-search view (see the "
+        "streaming-block schema below): the analytic shard plan "
+        "(rows/shards/bytes, whether the HBM budget capped it), "
+        "shards streamed vs resumed per pass, and the measured "
+        "host->device bytes (search/stream.py).  Present only when "
+        "the search ran with data_mode='stream'."),
     MetricDef(
         "attribution", "struct",
         "The search doctor's critical-path decomposition (see the "
@@ -536,6 +545,60 @@ HALVING_BLOCK_SCHEMA = (
 #: ``parallel.memledger.report_block``) — the device-memory ledger's
 #: per-search view: what the search modeled, what the budget allowed,
 #: and what the allocator measured.
+#: sub-keys of ``search_report["streaming"]`` (written by
+#: ``search.stream.run_stream``) — the streamed tier's analytic shard
+#: plan plus what actually crossed host->device.  The plan numbers are
+#: journaled with the checkpoint (``StreamPlan``), so a resumed run
+#: reports the geometry it replayed, not a recomputed one.
+STREAMING_BLOCK_SCHEMA = (
+    MetricDef("n_samples", "gauge",
+              "Host dataset rows the streamed passes covered."),
+    MetricDef("shard_rows", "gauge",
+              "Planned rows per sample shard (every shard pads to "
+              "this with zero-weight rows, so each pass compiles "
+              "exactly one program shape per group)."),
+    MetricDef("n_shards", "gauge",
+              "ceil(n_samples / shard_rows) — device launches per "
+              "pass."),
+    MetricDef("row_bytes", "gauge",
+              "Modeled host bytes one sample row contributes (data "
+              "arrays + fold-mask columns)."),
+    MetricDef("target_shard_bytes", "gauge",
+              "The requested per-shard slab "
+              "(TpuConfig.stream_shard_bytes / "
+              "SST_STREAM_SHARD_BYTES)."),
+    MetricDef("budget_bytes", "gauge",
+              "The HBM planning budget the shard width was sized "
+              "against (0 = unbudgeted: the target alone decides)."),
+    MetricDef("reserved_bytes", "gauge",
+              "Modeled resident program footprint (chunk operands + "
+              "fold accumulators + finalized models) subtracted from "
+              "the budget before sizing shards."),
+    MetricDef("capped", "label",
+              "True when the budget shrank the shard below the "
+              "requested target — the analytic stand-in for an OOM "
+              "bisection, decided before the first upload."),
+    MetricDef("fit_shards_streamed", "counter",
+              "Shards uploaded and folded during the fit pass (a "
+              "resumed run streams only the journal's suffix)."),
+    MetricDef("score_shards_streamed", "counter",
+              "Shards uploaded and scored during the score pass."),
+    MetricDef("fit_shards_resumed", "counter",
+              "Fit-pass shards restored from the per-shard journal "
+              "instead of streamed."),
+    MetricDef("score_shards_resumed", "counter",
+              "Score-pass shards restored from the per-shard journal "
+              "instead of streamed."),
+    MetricDef("h2d_bytes", "gauge",
+              "Measured host->device bytes the streamed passes "
+              "transferred (data-plane counter delta; fingerprint "
+              "dedup makes a re-streamed shard free)."),
+    MetricDef("n_live_chunks", "gauge",
+              "Candidate chunks actually computed (checkpoint-"
+              "resumed chunks skip both passes)."),
+)
+
+
 MEMORY_BLOCK_SCHEMA = (
     MetricDef("enabled", "label",
               "Always True when present: the block only renders when "
@@ -1016,6 +1079,14 @@ def schema_markdown() -> str:
         "`parallel/memledger.py`).\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in MEMORY_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"streaming\"]` block\n")
+    out.append(
+        "\nPresent only when the search ran the streaming-fold data "
+        "plane (`TpuConfig.data_mode=\"stream\"` / `SST_DATA_MODE`; "
+        "`search/stream.py`).\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in STREAMING_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `search_report[\"attribution\"]` block\n")
     out.append(

@@ -71,6 +71,19 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
     SpanDef("doctor.sentinel", "span", "search.grid",
             "Cross-run regression check of the attribution block "
             "against the persistent run-log baseline."),
+    # search/stream.py
+    SpanDef("stream.plan", "span", "search.stream",
+            "Analytic shard-plan sizing for a streamed search "
+            "(carries n_shards, shard_rows, row_bytes, capped)."),
+    SpanDef("stream.fit_pass", "span", "search.stream",
+            "The streamed FIT pass: every live shard uploaded and "
+            "folded into the per-group fit-statistic accumulators."),
+    SpanDef("stream.finalize", "span", "search.stream",
+            "Per-chunk candidate finalize: vmapped solves over the "
+            "folded statistics (one cheap launch per live chunk)."),
+    SpanDef("stream.score_pass", "span", "search.stream",
+            "The streamed SCORE pass: shards re-streamed through "
+            "predict into the default scorer's sufficient statistics."),
     # search/halving.py
     SpanDef("halving.rung", "span", "search.halving",
             "One successive-halving rung: fit + score of the "

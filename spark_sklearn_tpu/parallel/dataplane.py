@@ -110,13 +110,41 @@ def upload(arr: np.ndarray, sharding=None, label: str = "staging"):
     return out
 
 
+def _csr_parts(arr):
+    """``(data, indices, indptr, shape)`` of a CSR-like host matrix
+    (scipy csr/csc or :class:`~spark_sklearn_tpu.sparse.csr.CSRMatrix`),
+    or None for anything else.  Duck-typed so the data plane never
+    imports scipy just to recognise its matrices."""
+    if isinstance(arr, np.ndarray) or not hasattr(arr, "indptr"):
+        return None
+    data = getattr(arr, "data", None)
+    indices = getattr(arr, "indices", None)
+    if data is None or indices is None:
+        return None
+    return (np.asarray(data), np.asarray(indices),
+            np.asarray(arr.indptr), tuple(int(s) for s in arr.shape))
+
+
 def fingerprint(arr: np.ndarray) -> str:
     """Content digest of a host array: blake2b over the raw bytes plus
     shape/dtype.  Full-content (not sampled) — a wrong cache hit would
     silently corrupt scores, and hashing runs at ~1 GB/s, far cheaper
-    than the transfer it saves."""
-    a = np.ascontiguousarray(arr)
+    than the transfer it saves.
+
+    CSR-like inputs digest their ``(data, indices, indptr, shape)``
+    components directly — fingerprinting a wide sparse X must never
+    allocate its dense form (pinned by test_dataplane.py)."""
+    parts = _csr_parts(arr)
     h = hashlib.blake2b(digest_size=16)
+    if parts is not None:
+        data, indices, indptr, shape = parts
+        h.update(repr(("csr", shape, data.dtype.str,
+                       indices.dtype.str)).encode())
+        for a in (data, indices, indptr):
+            a = np.ascontiguousarray(a)
+            h.update(a.data if a.flags["C_CONTIGUOUS"] else a.tobytes())
+        return h.hexdigest()
+    a = np.ascontiguousarray(arr)
     h.update(repr((a.shape, a.dtype.str)).encode())
     h.update(a.data if a.flags["C_CONTIGUOUS"] else a.tobytes())
     return h.hexdigest()

@@ -58,6 +58,7 @@ from spark_sklearn_tpu.utils.locks import named_lock
 
 __all__ = [
     "MemoryLedger",
+    "dataset_nbytes",
     "get_ledger",
     "ledger_for",
     "model_group_footprint",
@@ -80,6 +81,37 @@ _MAX_MARGIN = 8.0
 #: one f32 test cell (+ one train cell when requested) — the health
 #: flags and iteration scalars are noise next to it
 _SCORE_CELL_BYTES = 4
+
+
+def dataset_nbytes(X) -> int:
+    """True host bytes of a dataset for footprint pricing.
+
+    Dense arrays report ``nbytes``; CSR-like matrices (scipy sparse,
+    ``sparse.csr.CSRMatrix``) report the sum of their component arrays
+    — nnz-proportional, NOT ``n x d``.  scipy sparse matrices have no
+    ``nbytes`` attribute at all, so the old ``getattr(X, "nbytes", 0)``
+    spelling priced them at ZERO, and any dense-equivalent pricing
+    would over-reject by orders of magnitude; both are wrong for
+    predictive admission (pinned by test_sparse_path.py)."""
+    if X is None:
+        return 0
+    nb = getattr(X, "nbytes", None)
+    if nb is not None and isinstance(X, np.ndarray):
+        return int(nb)
+    if hasattr(X, "indptr") and hasattr(X, "data"):
+        total = 0
+        for part in (getattr(X, "data", None),
+                     getattr(X, "indices", None),
+                     getattr(X, "indptr", None)):
+            if part is not None:
+                total += int(np.asarray(part).nbytes)
+        return total
+    if nb is not None:
+        return int(nb)
+    try:
+        return int(np.asarray(X).nbytes)
+    except (TypeError, ValueError):
+        return 0
 
 
 def model_group_footprint(dynamic_params: Dict[str, np.ndarray],

@@ -340,6 +340,23 @@ class TpuConfig:
     # SST_FUSION_MAX_WIDTH, then 0 = bounded only by the member plans'
     # own width caps.
     fusion_max_width: Optional[int] = None
+    # ---- out-of-core data plane (search/stream.py + sparse/csr.py) ----
+    # how the dataset reaches the device: "device" (default — X is
+    # densified and device-resident for the whole search, exact
+    # pre-streaming behavior), "stream" (X stays on the host; sample
+    # shards stream through the stage/compute overlap and per-shard
+    # partial statistics fold on device — families advertising
+    # supports_stream only), or "sparse" (scipy CSR X rides the BCOO
+    # bridge end to end, no densify — families advertising
+    # supports_sparse only).  None defers to SST_DATA_MODE, then
+    # "device".
+    data_mode: Optional[str] = None
+    # target host->device bytes per streamed sample shard.  The stream
+    # planner clamps this against hbm_budget_bytes (residency = budget
+    # minus the program footprint, double-buffered) so shard width is a
+    # planning decision, never OOM trial-and-error.  None defers to
+    # SST_STREAM_SHARD_BYTES, then 64 MiB.
+    stream_shard_bytes: Optional[int] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

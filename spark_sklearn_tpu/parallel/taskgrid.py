@@ -703,3 +703,117 @@ def build_fold_masks(
         train[i, tr] = 1.0
         test[i, te] = 1.0
     return train, test
+
+
+class StreamPlanError(RuntimeError):
+    """The streaming-fold planner cannot produce a shard geometry that
+    fits the HBM budget (the reserved program footprint alone exceeds
+    it, or a single double-buffered row does).  Raise the budget, lower
+    the chunk width (``max_tasks_per_batch``), or run ``data_mode=
+    "device"`` on hardware that holds the dataset."""
+
+
+@dataclasses.dataclass
+class StreamPlan:
+    """The planned sample-shard geometry of one streamed search.
+
+    Like :class:`GeometryPlan` this is a *planning* artifact: the shard
+    width is an analytic decision made before the first upload (budget
+    minus the modeled resident program footprint, double-buffered), not
+    something discovered by OOM trial-and-error.  Serialized verbatim
+    into the checkpoint journal (``{"meta": "stream_plan", ...}``) so a
+    resumed search replays the EXACT same shard boundaries — per-shard
+    partial-statistics journal entries are only addressable under the
+    geometry that wrote them."""
+
+    n_samples: int
+    shard_rows: int            # uniform rows per shard (last one padded)
+    n_shards: int
+    row_bytes: int             # modeled host bytes per row, all operands
+    target_shard_bytes: int    # the knob that sized it (pre-cap)
+    budget_bytes: int          # resolved HBM budget (0 = unbounded)
+    reserved_bytes: int        # modeled non-shard resident footprint
+    capped: bool = False       # True when the budget shrank the shard
+
+    def signature(self) -> Tuple:
+        """Resume identity: shard boundaries may not move between the
+        journalling run and the resuming run."""
+        return (int(self.n_samples), int(self.shard_rows),
+                int(self.n_shards))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "StreamPlan":
+        return cls(**{k: d[k] for k in (
+            "n_samples", "shard_rows", "n_shards", "row_bytes",
+            "target_shard_bytes", "budget_bytes", "reserved_bytes",
+            "capped") if k in d})
+
+    def report_block(self) -> Dict[str, Any]:
+        """The ``search_report["streaming"]`` planning facts (schema
+        pinned in ``obs.metrics.STREAMING_BLOCK_SCHEMA``; an explicit
+        literal so sstlint's schema-drift producer reads the keys)."""
+        return {
+            "n_samples": int(self.n_samples),
+            "shard_rows": int(self.shard_rows),
+            "n_shards": int(self.n_shards),
+            "row_bytes": int(self.row_bytes),
+            "target_shard_bytes": int(self.target_shard_bytes),
+            "budget_bytes": int(self.budget_bytes),
+            "reserved_bytes": int(self.reserved_bytes),
+            "capped": bool(self.capped),
+        }
+
+
+#: headroom factor on the modeled shard residency: two staged shard
+#: slabs (the pipeline's upload-ahead slot plus the one in compute)
+#: never plan past budget/_STREAM_SLAB_MARGIN of the free bytes
+_STREAM_SLOTS = 2
+_STREAM_SLAB_MARGIN = 1.25
+
+
+def plan_stream_shards(n_samples: int, row_bytes: int,
+                       target_shard_bytes: int, *,
+                       budget_bytes: int = 0,
+                       reserved_bytes: int = 0,
+                       margin: float = _STREAM_SLAB_MARGIN) -> StreamPlan:
+    """Analytically size the sample shards of a streamed search.
+
+    ``row_bytes`` is the summed host bytes of ONE row across every
+    per-sample operand the engine will slice (X, y, one-hot labels,
+    per-shard mask slices) — the ledger's pricing, so sparse X enters
+    nnz-proportionally.  The shard width is ``target_shard_bytes``
+    worth of rows, shrunk (``capped=True``) when the HBM budget minus
+    the ``reserved_bytes`` program footprint cannot double-buffer two
+    slabs that big.  Raises :class:`StreamPlanError` instead of
+    planning a geometry the model already knows cannot fit."""
+    n_samples = int(n_samples)
+    row_bytes = max(1, int(row_bytes))
+    target = max(1, int(target_shard_bytes))
+    rows = max(1, min(n_samples, target // row_bytes))
+    capped = False
+    budget_bytes = int(budget_bytes or 0)
+    if budget_bytes:
+        free = budget_bytes - int(reserved_bytes)
+        rows_budget = int(free // (_STREAM_SLOTS * row_bytes
+                                   * max(1.0, float(margin))))
+        if rows_budget < 1:
+            raise StreamPlanError(
+                "streaming-fold plan cannot fit the HBM budget: "
+                f"budget={budget_bytes}B, reserved program footprint="
+                f"{reserved_bytes}B leaves no room for "
+                f"{_STREAM_SLOTS} x {row_bytes}B-row shard slabs; "
+                "raise hbm_budget_bytes, shrink max_tasks_per_batch, "
+                "or use data_mode='device'")
+        if rows_budget < rows:
+            rows = rows_budget
+            capped = True
+    rows = min(rows, n_samples)
+    n_shards = -(-n_samples // rows)
+    return StreamPlan(
+        n_samples=n_samples, shard_rows=int(rows),
+        n_shards=int(n_shards), row_bytes=int(row_bytes),
+        target_shard_bytes=int(target), budget_bytes=budget_bytes,
+        reserved_bytes=int(reserved_bytes), capped=bool(capped))

@@ -1174,8 +1174,36 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # supervisor's per-candidate host fallback fits on THIS, so a
         # bisection that bottoms out reproduces sklearn exactly
         X_host = X
-        X = self._densify(X, dtype)
-        data, meta = family.prepare_data(X, y, dtype=dtype)
+        # data tier (search/stream.py): "device" is the legacy resident
+        # path, "stream" folds sample shards through the pipeline,
+        # "sparse" keeps a scipy CSR as a device BCOO end to end
+        import scipy.sparse as _scipy_sparse
+
+        from spark_sklearn_tpu.search import stream as _stream
+        data_mode = _stream.resolve_data_mode(config)
+        sparse_op = None
+        if data_mode == "sparse" and _scipy_sparse.issparse(X):
+            if not getattr(family, "supports_sparse", False):
+                raise ValueError(
+                    f"data_mode='sparse' requires a family with BCOO "
+                    f"fit/predict programs; {family.name} has none.  "
+                    "Use data_mode='device' (densified upload) or "
+                    "backend='host'.")
+            if config.n_data_shards > 1:
+                raise ValueError(
+                    "data_mode='sparse' does not compose with "
+                    "n_data_shards>1 (BCOO operands replicate only)")
+            from spark_sklearn_tpu.sparse.csr import register_bcoo_export
+            register_bcoo_export()
+            X = X.tocsr()
+            data, meta = family.prepare_data_sparse(X, y, dtype=dtype)
+            sparse_op = data["X"]
+        else:
+            if data_mode == "stream":
+                _stream.check_stream_supported(family, self.scoring,
+                                               config)
+            X = self._densify(X, dtype)
+            data, meta = family.prepare_data(X, y, dtype=dtype)
         meta["logloss_clip_eps"] = float(np.finfo(oracle_proba_dt).eps)
         if self.scoring is not None:
             if "y" not in data:
@@ -1350,13 +1378,31 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         _tenant = _binding.tenant if _binding is not None else None
 
         def _bput(v, sharding, label):
+            from spark_sklearn_tpu.sparse.csr import SparseOperand
+            if isinstance(v, SparseOperand):
+                # a sparse operand uploads as its two nnz-proportional
+                # components (each content-fingerprinted and accounted
+                # separately) and reassembles the device BCOO — upload
+                # bytes and plane keys price nnz, never n x d
+                return v.to_bcoo(
+                    values=_bput(v.values, sharding, label + ".values"),
+                    indices=_bput(v.indices, sharding,
+                                  label + ".indices"))
             if plane is not None:
                 return plane.put(v, sharding, label=label,
                                  tenant=_tenant)
             return _dataplane.upload(v, sharding, label=label)
 
         _t_upload0 = time.perf_counter()
-        if config.n_data_shards > 1:
+        if data_mode == "stream":
+            # streaming tier: X/y and the masks stay host-side — each
+            # sample shard crosses host->device on the pipeline's stage
+            # thread inside run_stream, overlapped with the previous
+            # shard's compute
+            data_dev = {}
+            fit_dev = test_dev = train_sc_dev = None
+            test_unw_dev = train_unw_dev = None
+        elif config.n_data_shards > 1:
             # large-X mode: shard samples over the "data" mesh axis instead
             # of replicating (the TPU-native answer to X not fitting one
             # chip's HBM) — sample-axis reductions inside the families
@@ -1402,18 +1448,19 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # search's live masks under the same tenant
         mask_ns = (f"mask.{rung.ns}." if rung is not None
                    and rung.resource == "n_samples" else "mask.")
-        fit_dev = _bput(fit_masks, put_masks, mask_ns + "fit")
-        test_dev = _bput(test_sc_masks, put_masks, mask_ns + "test")
-        train_sc_dev = (fit_dev if train_sc_masks is fit_masks
-                        else _bput(train_sc_masks, put_masks,
-                                   mask_ns + "train"))
-        if need_unweighted:
-            test_unw_dev = _bput(test_masks, put_masks,
-                                 mask_ns + "test_unw")
-            train_unw_dev = _bput(train_masks, put_masks,
-                                  mask_ns + "train_unw")
-        else:
-            test_unw_dev, train_unw_dev = test_dev, train_sc_dev
+        if data_mode != "stream":
+            fit_dev = _bput(fit_masks, put_masks, mask_ns + "fit")
+            test_dev = _bput(test_sc_masks, put_masks, mask_ns + "test")
+            train_sc_dev = (fit_dev if train_sc_masks is fit_masks
+                            else _bput(train_sc_masks, put_masks,
+                                       mask_ns + "train"))
+            if need_unweighted:
+                test_unw_dev = _bput(test_masks, put_masks,
+                                     mask_ns + "test_unw")
+                train_unw_dev = _bput(train_masks, put_masks,
+                                      mask_ns + "train_unw")
+            else:
+                test_unw_dev, train_unw_dev = test_dev, train_sc_dev
         get_tracer().record_span(
             "device_put.broadcast", _t_upload0, time.perf_counter(),
             n_samples=n_samples, n_data_shards=config.n_data_shards)
@@ -1434,17 +1481,32 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         if config.checkpoint_dir:
             from spark_sklearn_tpu.utils.checkpoint import (
                 SearchCheckpoint, fingerprint)
+            if sparse_op is not None:
+                # CSR content enters by its canonical components — a
+                # sparse head-slice repr() carries no values, and any
+                # dense staging here would defeat the whole tier
+                _x_head = sparse_op.values[:4096]
+                _x_moments = (
+                    *sparse_op.signature(),
+                    float(np.sum(sparse_op.values, dtype=np.float64)),
+                    float(np.sum(np.square(sparse_op.values,
+                                           dtype=np.float64))),
+                    float(np.sum(sparse_op.indices, dtype=np.float64)))
+            else:
+                _x_head = X[: min(64, n_samples)]
+                # whole-dataset moments so ANY changed X row or label
+                # set breaks the fingerprint (head rows can collide)
+                _x_moments = (
+                    X.shape, float(np.sum(X, dtype=np.float64)),
+                    float(np.sum(np.square(X, dtype=np.float64))))
             key = fingerprint(
                 type(self.estimator).__name__, base_params, candidates,
                 scorer_names, n_folds, return_train,
                 # result-affecting config: resuming under a different matmul
                 # precision or dtype must not reuse the other run's scores
                 (bool(config.bf16_matmul), str(config.dtype)),
-                X[: min(64, n_samples)],
-                # whole-dataset moments so ANY changed X row or label set
-                # breaks the fingerprint (head rows alone can collide)
-                (X.shape, float(np.sum(X, dtype=np.float64)),
-                 float(np.sum(np.square(X, dtype=np.float64)))),
+                _x_head,
+                _x_moments,
                 self._hashable_labels(y),
                 np.asarray(train_masks),
                 # weighted searches must not resume an unweighted run's
@@ -1462,7 +1524,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 # though the candidate set / masks already differ, so
                 # two rungs can never alias one journal file
                 *(("halving", rung.itr, rung.n_resources)
-                  if rung is not None else ()))
+                  if rung is not None else ()),
+                # a streamed run's journal holds per-shard accumulator
+                # records addressed by the stream geometry — never let a
+                # device-mode resume read (or extend) it
+                *(("stream",) if data_mode == "stream" else ()))
             ckpt = SearchCheckpoint(config.checkpoint_dir, key)
 
         profiler_cm = None
@@ -1630,22 +1696,45 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             ledger.activate()
         try:
             with debug_ctx:
-                self._run_groups(
-                    groups=groups, base_params=base_params, family=family,
-                    meta=meta, scorers=scorers, scorer_names=scorer_names,
-                    data_dev=data_dev, fit_dev=fit_dev,
-                    test_dev=test_dev, train_sc_dev=train_sc_dev,
-                    test_unw_dev=test_unw_dev, train_unw_dev=train_unw_dev,
-                    sw_blind=sw_blind,
-                    fit_masks=fit_masks, mesh=mesh,
-                    config=config, n_task_shards=n_task_shards,
-                    task_shard=task_shard,
-                    max_cand_per_batch=max_cand_per_batch, n_folds=n_folds,
-                    dtype=dtype, return_train=return_train,
-                    test_scores=test_scores, train_scores=train_scores,
-                    fit_times=fit_times, score_times=score_times, ckpt=ckpt,
-                    fit_failed=fit_failed, candidates=candidates,
-                    host_eval=host_eval)
+                if data_mode == "stream":
+                    _stream.run_stream(
+                        self, groups=groups, base_params=base_params,
+                        family=family, meta=meta,
+                        scorer_names=scorer_names, data=data,
+                        fit_masks=fit_masks,
+                        test_sc_masks=test_sc_masks,
+                        train_sc_masks=train_sc_masks, repl=repl,
+                        config=config, n_task_shards=n_task_shards,
+                        max_cand_per_batch=max_cand_per_batch,
+                        n_folds=n_folds, dtype=dtype,
+                        return_train=return_train,
+                        test_scores=test_scores,
+                        train_scores=train_scores, fit_times=fit_times,
+                        score_times=score_times, ckpt=ckpt,
+                        fit_failed=fit_failed, candidates=candidates)
+                else:
+                    self._run_groups(
+                        groups=groups, base_params=base_params,
+                        family=family,
+                        meta=meta, scorers=scorers,
+                        scorer_names=scorer_names,
+                        data_dev=data_dev, fit_dev=fit_dev,
+                        test_dev=test_dev, train_sc_dev=train_sc_dev,
+                        test_unw_dev=test_unw_dev,
+                        train_unw_dev=train_unw_dev,
+                        sw_blind=sw_blind,
+                        fit_masks=fit_masks, mesh=mesh,
+                        config=config, n_task_shards=n_task_shards,
+                        task_shard=task_shard,
+                        max_cand_per_batch=max_cand_per_batch,
+                        n_folds=n_folds,
+                        dtype=dtype, return_train=return_train,
+                        test_scores=test_scores,
+                        train_scores=train_scores,
+                        fit_times=fit_times, score_times=score_times,
+                        ckpt=ckpt,
+                        fit_failed=fit_failed, candidates=candidates,
+                        host_eval=host_eval)
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
@@ -1990,7 +2079,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 if id(dev_arr) in seen_bufs:
                     continue
                 seen_bufs.add(id(dev_arr))
-                resident_est += int(getattr(dev_arr, "nbytes", 0))
+                # leaf-wise so a BCOO data operand prices its
+                # values+indices components (nnz-proportional; the
+                # wrapper itself has no nbytes) — dense arrays are
+                # their own single leaf, so this is the same number
+                # the old getattr spelling produced
+                for leaf in jax.tree_util.tree_leaves(dev_arr):
+                    resident_est += int(getattr(leaf, "nbytes", 0))
             mem_kw = dict(
                 task_batched=task_batched,
                 n_samples=int(fit_masks.shape[1]),

@@ -627,8 +627,19 @@ class SearchExecutor:
             dyn, 1, n_folds, task_batched=True, n_samples=n,
             return_train=bool(getattr(search, "return_train_score",
                                       False)))
-        x_bytes = int(getattr(X, "nbytes", 0) or 0)
-        y_bytes = int(getattr(y, "nbytes", 0) or 0)
+        # true dataset bytes: dense nbytes, or the CSR component sum
+        # for sparse X (scipy sparse has no .nbytes — the old getattr
+        # spelling priced it at zero and dense-equivalent pricing would
+        # over-reject by orders of magnitude)
+        x_bytes = _memledger.dataset_nbytes(X)
+        y_bytes = _memledger.dataset_nbytes(y)
+        from spark_sklearn_tpu.search import stream as _stream
+        if _stream.resolve_data_mode(cfg) == "stream":
+            # streamed submission: X is never wholly resident — price
+            # the double-buffered shard slab the stream planner will
+            # actually keep on device
+            x_bytes = min(x_bytes,
+                          2 * _stream.resolve_shard_bytes(cfg))
         # broadcast residents: X/y replicas + the base fold masks
         # (train + test, int32) the data plane keeps device-resident
         resident = x_bytes + y_bytes + 2 * n_folds * n * 4

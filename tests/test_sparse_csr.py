@@ -1,0 +1,156 @@
+"""sparse/csr.py contract tests (ISSUE PR 15 satellite #3).
+
+The UDT-replacement container is the seam every sparse feature rides
+through (serde <-> scipy <-> BCOO), so its invariants are pinned
+directly: lossless serialize/deserialize, canonical BCOO form out of
+non-canonical input (duplicates, unsorted rows), empty-row handling,
+dtype coercion at the device boundary, and the >=2^31-safe index
+dtype sizing that keeps a huge-axis matrix from aliasing rows through
+int32 truncation."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from spark_sklearn_tpu.sparse.csr import (
+    CSRMatrix, SparseOperand, index_dtype, register_bcoo_export)
+
+
+def _rand_csr(rng, n=23, d=17, density=0.2, dtype=np.float64):
+    m = sp.random(n, d, density=density, format="csr", random_state=rng)
+    return m.astype(dtype)
+
+
+class TestSerde:
+    def test_scipy_round_trip_lossless(self):
+        rng = np.random.default_rng(0)
+        m = _rand_csr(rng)
+        ours = CSRMatrix.from_scipy(m)
+        back = ours.to_scipy()
+        assert (back != m).nnz == 0
+        assert back.dtype == m.dtype
+
+    def test_serialize_deserialize_round_trip(self):
+        rng = np.random.default_rng(1)
+        m = _rand_csr(rng)
+        ours = CSRMatrix.from_scipy(m)
+        datum = ours.serialize()
+        # the UDT contract: a plain tuple of arrays (pickles/parquets
+        # without custom hooks), shape carried as int64
+        assert isinstance(datum, tuple) and len(datum) == 4
+        assert datum[3].dtype == np.int64
+        again = CSRMatrix.deserialize(datum)
+        assert again == ours
+        assert again.to_scipy().shape == m.shape
+
+    def test_serialize_preserves_empty_rows(self):
+        # rows 0 and 3 empty; row-structure lives in indptr alone
+        m = sp.csr_matrix(
+            (np.array([1.0, 2.0]), np.array([1, 0]),
+             np.array([0, 0, 1, 2, 2])), shape=(4, 3))
+        ours = CSRMatrix.deserialize(CSRMatrix.from_scipy(m).serialize())
+        dense = ours.to_scipy().toarray()
+        assert np.array_equal(dense[0], np.zeros(3))
+        assert np.array_equal(dense[3], np.zeros(3))
+        assert dense[1, 1] == 1.0 and dense[2, 0] == 2.0
+
+    def test_nbytes_is_component_sum_not_dense(self):
+        rng = np.random.default_rng(2)
+        m = _rand_csr(rng, n=50, d=40, density=0.05)
+        ours = CSRMatrix.from_scipy(m)
+        expect = (ours.data.nbytes + ours.indices.nbytes
+                  + ours.indptr.nbytes)
+        assert ours.nbytes == expect
+        assert ours.nbytes < 50 * 40 * 8  # never n x d
+
+
+class TestBcoo:
+    def test_round_trip_values_match_dense(self):
+        rng = np.random.default_rng(3)
+        m = _rand_csr(rng, dtype=np.float32)
+        b = CSRMatrix.from_scipy(m).to_bcoo()
+        assert np.allclose(np.asarray(b.todense()), m.toarray())
+
+    def test_canonical_form_flags_hold(self):
+        # duplicate entries in one row + unsorted column order: the
+        # conversion must SUM duplicates and emit row-major sorted,
+        # unique coordinates (the flags to_bcoo asserts to XLA)
+        data = np.array([1.0, 2.0, 5.0, 3.0], dtype=np.float32)
+        indices = np.array([2, 0, 2, 1])       # row 0: cols 2,0,2 (dup)
+        indptr = np.array([0, 3, 4])
+        m = sp.csr_matrix((data, indices, indptr), shape=(2, 3))
+        assert not m.has_canonical_format
+        op = SparseOperand.from_csr(m)
+        # unique + sorted: strictly increasing flattened coordinates
+        flat = op.indices[:, 0].astype(np.int64) * 3 + op.indices[:, 1]
+        assert np.all(np.diff(flat) > 0)
+        b = op.to_bcoo()
+        assert b.indices_sorted and b.unique_indices
+        dense = np.asarray(b.todense())
+        assert dense[0, 2] == pytest.approx(6.0)   # 1 + 5 summed
+        assert dense[0, 0] == pytest.approx(2.0)
+        assert dense[1, 1] == pytest.approx(3.0)
+
+    def test_empty_rows_and_all_empty_matrix(self):
+        m = sp.csr_matrix((3, 4), dtype=np.float64)  # nnz == 0
+        op = SparseOperand.from_csr(m)
+        assert op.nnz == 0 and op.values.shape == (0,)
+        assert op.indices.shape == (0, 2)
+        assert np.array_equal(np.asarray(op.to_bcoo().todense()),
+                              np.zeros((3, 4), np.float32))
+
+    def test_dtype_coercion_to_device_dtype(self):
+        rng = np.random.default_rng(4)
+        m = _rand_csr(rng, dtype=np.float64)
+        op = SparseOperand.from_csr(m, dtype=np.float32)
+        assert op.values.dtype == np.float32
+        op64 = SparseOperand.from_csr(m, dtype=np.float64)
+        assert op64.values.dtype == np.float64
+        assert op.signature() != op64.signature()
+
+    def test_signature_separates_layouts(self):
+        # same dense shape, different nnz -> different program identity
+        a = sp.csr_matrix(np.eye(4, dtype=np.float32))
+        b = sp.csr_matrix(np.ones((4, 4), np.float32))
+        sa = SparseOperand.from_csr(a).signature()
+        sb = SparseOperand.from_csr(b).signature()
+        assert sa != sb
+        assert sa[0] == "bcoo" and hash(sa) is not None
+
+    def test_register_bcoo_export_idempotent(self):
+        first = register_bcoo_export()
+        assert register_bcoo_export() == first
+
+
+class TestIndexDtypes:
+    def test_small_extents_stay_int32(self):
+        assert index_dtype(10, 20, 30) == np.int32
+        assert index_dtype(np.iinfo(np.int32).max) == np.int32
+
+    def test_huge_extent_promotes_to_int64(self):
+        assert index_dtype(np.iinfo(np.int32).max + 1) == np.int64
+        assert index_dtype(10, 2 ** 40) == np.int64
+
+    def test_component_independent_sizing(self):
+        # a tiny-nnz matrix over a >2^31 column axis: the column
+        # indices must be int64, but indptr (which indexes nnz) stays
+        # int32 -- each component sized by what IT addresses
+        huge_d = np.iinfo(np.int32).max + 10
+        m = CSRMatrix(
+            data=np.array([1.0, 2.0], dtype=np.float32),
+            indices=np.array([5, huge_d - 1], dtype=np.int64),
+            indptr=np.array([0, 1, 2]),
+            shape=(2, huge_d))
+        assert m.indices.dtype == np.int64
+        assert m.indptr.dtype == np.int32
+        assert m.indices[1] == huge_d - 1  # no truncation
+        datum = m.serialize()
+        again = CSRMatrix.deserialize(datum)
+        assert again.indices.dtype == np.int64
+        assert int(again.indices[1]) == huge_d - 1
+
+    def test_operand_index_dtype_from_extents(self):
+        rng = np.random.default_rng(5)
+        m = _rand_csr(rng)
+        op = SparseOperand.from_csr(m)
+        assert op.indices.dtype == np.int32

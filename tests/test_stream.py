@@ -1,0 +1,279 @@
+"""Streaming-fold data plane (ISSUE PR 15 tentpole a).
+
+The acceptance contract, pinned end to end:
+
+  - a streamed search's `cv_results_` is BIT-EXACT against the in-core
+    device path at pipeline depth 0 AND depth 2 (integer-statistics
+    families; zero-row padding adds exactly nothing);
+  - shard width is an analytic planning decision: a tiny HBM budget
+    yields a capped >=3-shard plan and the search completes with ZERO
+    OOM bisections;
+  - a search killed mid-shard resumes from the per-shard accumulator
+    journal and still matches bit-exactly;
+  - resuming under a different shard geometry fails loudly
+    (GeometryMismatchError), never silently mis-addresses journal
+    entries.
+
+Every search here runs `backend="tpu"` so a compiled-path failure
+raises instead of silently re-running on the (f64, NOT bit-exact)
+host tier."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+from sklearn.linear_model import Ridge
+from sklearn.naive_bayes import MultinomialNB
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.parallel.taskgrid import (
+    GeometryMismatchError, StreamPlanError, plan_stream_shards)
+from spark_sklearn_tpu.search import stream as stream_mod
+
+ALPHAS = [0.1, 1.0, 10.0]
+
+
+def _count_data(n=600, d=40, n_classes=3, seed=7):
+    """Integer-valued X: NB's count statistics and the accuracy
+    num/den are integers, exact in f32 -> streamed folds are
+    bit-identical to the one-shot in-core reduction."""
+    rng = np.random.default_rng(seed)
+    X = rng.integers(0, 6, size=(n, d)).astype(np.float64)
+    y = rng.integers(0, n_classes, size=n)
+    return X, y
+
+
+def _fit(X, y, est, grid, **cfg_kwargs):
+    cfg = sst.TpuConfig(**cfg_kwargs)
+    gs = sst.GridSearchCV(est, grid, cv=3, backend="tpu", refit=False,
+                          config=cfg)
+    with warnings.catch_warnings():
+        # belt over the backend="tpu" suspenders: any fallback warning
+        # (or accidental host tier) fails the test loudly
+        warnings.simplefilter("error", UserWarning)
+        gs.fit(X, y)
+    return gs
+
+
+def _split_scores(gs):
+    r = gs.cv_results_
+    return np.stack([r[f"split{i}_test_score"]
+                     for i in range(gs.n_splits_)])
+
+
+# 40 f64 X-cols/row is 320B; +8B y +12B masks = 340B/row ->
+# shard_rows 188 at 64 KiB?  No: pick bytes for ~150 rows so 600
+# samples stream as 4+ shards regardless of mask bookkeeping.
+_SHARD_BYTES = 150 * (40 * 8 + 8 + 3 * 3 * 4)
+
+
+class TestStreamParity:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_nb_bit_exact_vs_device(self, depth):
+        X, y = _count_data()
+        grid = {"alpha": ALPHAS}
+        ref = _fit(X, y, MultinomialNB(), grid)
+        got = _fit(X, y, MultinomialNB(), grid, data_mode="stream",
+                   stream_shard_bytes=_SHARD_BYTES,
+                   pipeline_depth=depth)
+        blk = got.search_report["streaming"]
+        assert blk["n_shards"] >= 3
+        assert blk["fit_shards_streamed"] == blk["n_shards"]
+        assert blk["score_shards_streamed"] == blk["n_shards"]
+        assert blk["h2d_bytes"] > 0
+        # THE tentpole claim: bit-exact, not allclose
+        assert np.array_equal(_split_scores(got), _split_scores(ref))
+        assert np.array_equal(got.cv_results_["mean_test_score"],
+                              ref.cv_results_["mean_test_score"])
+
+    def test_ridge_stream_matches_device(self, diabetes):
+        X, y = diabetes
+        grid = {"alpha": [0.01, 0.1, 1.0]}
+        ref = _fit(X, y, Ridge(), grid)
+        got = _fit(X, y, Ridge(), grid, data_mode="stream",
+                   stream_shard_bytes=150 * X.shape[1] * 8)
+        assert got.search_report["streaming"]["n_shards"] >= 2
+        # r2's sufficient statistics reduce in a different association
+        # order than the in-core scorer: allclose, not array_equal
+        assert np.allclose(_split_scores(got), _split_scores(ref),
+                           rtol=1e-4, atol=1e-5)
+
+    def test_streaming_block_absent_in_device_mode(self):
+        X, y = _count_data(n=120)
+        gs = _fit(X, y, MultinomialNB(), {"alpha": [1.0]})
+        assert "streaming" not in gs.search_report
+
+
+class TestStreamBudget:
+    def test_tiny_budget_caps_shards_no_oom_bisection(self):
+        """A budget far below the dataset: the planner (not OOM
+        trial-and-error) shrinks the shard; zero bisections."""
+        X, y = _count_data()
+        got = _fit(X, y, MultinomialNB(), {"alpha": ALPHAS},
+                   data_mode="stream",
+                   hbm_budget_bytes=64 << 10, memory_ledger=True)
+        blk = got.search_report["streaming"]
+        assert blk["capped"] is True
+        assert blk["n_shards"] >= 3
+        faults = got.search_report.get("faults", {})
+        assert faults.get("bisections", 0) == 0
+        assert faults.get("host_fallbacks", 0) == 0
+        ref = _fit(X, y, MultinomialNB(), {"alpha": ALPHAS})
+        assert np.array_equal(_split_scores(got), _split_scores(ref))
+
+    def test_h2d_bytes_tracks_two_passes(self):
+        """Streamed upload volume ~= fit pass + score pass (2x the
+        dataset + masks + small change), never a dense blowup."""
+        X, y = _count_data()
+        got = _fit(X, y, MultinomialNB(), {"alpha": ALPHAS},
+                   data_mode="stream", stream_shard_bytes=_SHARD_BYTES)
+        blk = got.search_report["streaming"]
+        dataset = X.astype(np.float32).nbytes
+        assert blk["h2d_bytes"] <= 4 * dataset
+        # padding waste is bounded by one shard per pass
+        assert blk["shard_rows"] * blk["n_shards"] \
+            < blk["n_samples"] + blk["shard_rows"]
+
+    def test_impossible_budget_raises_plan_error(self):
+        with pytest.raises(StreamPlanError, match="hbm_budget_bytes"):
+            plan_stream_shards(1000, 4096, 1 << 20,
+                               budget_bytes=8192, reserved_bytes=4096)
+
+
+class TestStreamResume:
+    def _kill_after(self, monkeypatch, n_fit_shards):
+        """Arm the journal so the search dies right AFTER the
+        n_fit_shards-th per-shard fit record is durable -- the
+        mid-stream analog of test_checkpoint_kill's SIGKILL."""
+        from spark_sklearn_tpu.utils.checkpoint import SearchCheckpoint
+        real_put = SearchCheckpoint.put
+        seen = {"n": 0}
+
+        def dying_put(self, chunk_id, record):
+            real_put(self, chunk_id, record)
+            if chunk_id.startswith("st:fit:"):
+                seen["n"] += 1
+                if seen["n"] >= n_fit_shards:
+                    raise RuntimeError("injected mid-stream kill")
+
+        monkeypatch.setattr(SearchCheckpoint, "put", dying_put)
+        return seen
+
+    def test_kill_mid_shard_resume_bit_exact(self, tmp_path,
+                                             monkeypatch):
+        X, y = _count_data()
+        grid = {"alpha": ALPHAS}
+        ckpt_dir = str(tmp_path / "ckpt")
+        seen = self._kill_after(monkeypatch, 2)
+        with pytest.raises(RuntimeError, match="injected"):
+            _fit(X, y, MultinomialNB(), grid, data_mode="stream",
+                 stream_shard_bytes=_SHARD_BYTES,
+                 checkpoint_dir=ckpt_dir)
+        assert seen["n"] >= 2          # died with >=2 shards durable
+        monkeypatch.undo()
+
+        got = _fit(X, y, MultinomialNB(), grid, data_mode="stream",
+                   stream_shard_bytes=_SHARD_BYTES,
+                   checkpoint_dir=ckpt_dir)
+        blk = got.search_report["streaming"]
+        assert blk["fit_shards_resumed"] >= 1
+        assert blk["fit_shards_streamed"] + blk["fit_shards_resumed"] \
+            == blk["n_shards"]
+        ref = _fit(X, y, MultinomialNB(), grid)
+        assert np.array_equal(_split_scores(got), _split_scores(ref))
+
+    def test_geometry_change_fails_loudly(self, tmp_path, monkeypatch):
+        X, y = _count_data()
+        grid = {"alpha": ALPHAS}
+        ckpt_dir = str(tmp_path / "ckpt")
+        self._kill_after(monkeypatch, 1)
+        with pytest.raises(RuntimeError, match="injected"):
+            _fit(X, y, MultinomialNB(), grid, data_mode="stream",
+                 stream_shard_bytes=_SHARD_BYTES,
+                 checkpoint_dir=ckpt_dir)
+        monkeypatch.undo()
+        with pytest.raises(GeometryMismatchError,
+                           match="stream-shard geometry"):
+            _fit(X, y, MultinomialNB(), grid, data_mode="stream",
+                 stream_shard_bytes=_SHARD_BYTES * 2,
+                 checkpoint_dir=ckpt_dir)
+
+    def test_clean_rerun_resumes_whole_chunks(self, tmp_path):
+        X, y = _count_data()
+        grid = {"alpha": ALPHAS}
+        ckpt_dir = str(tmp_path / "ckpt")
+        kw = dict(data_mode="stream", stream_shard_bytes=_SHARD_BYTES,
+                  checkpoint_dir=ckpt_dir)
+        first = _fit(X, y, MultinomialNB(), grid, **kw)
+        again = _fit(X, y, MultinomialNB(), grid, **kw)
+        blk = again.search_report["streaming"]
+        assert blk["n_live_chunks"] == 0
+        assert blk["fit_shards_streamed"] == 0
+        assert np.array_equal(_split_scores(again),
+                              _split_scores(first))
+
+
+class TestStreamKnobs:
+    def test_resolve_data_mode_default_and_config(self):
+        assert stream_mod.resolve_data_mode(sst.TpuConfig()) == "device"
+        assert stream_mod.resolve_data_mode(
+            sst.TpuConfig(data_mode="stream")) == "stream"
+
+    def test_resolve_data_mode_env_mirror(self, monkeypatch):
+        monkeypatch.setenv("SST_DATA_MODE", "stream")
+        assert stream_mod.resolve_data_mode(sst.TpuConfig()) == "stream"
+        # the config field wins over the env mirror
+        assert stream_mod.resolve_data_mode(
+            sst.TpuConfig(data_mode="device")) == "device"
+
+    def test_resolve_data_mode_rejects_unknown(self):
+        with pytest.raises(ValueError, match="data tier"):
+            stream_mod.resolve_data_mode(
+                sst.TpuConfig(data_mode="turbo"))
+
+    def test_resolve_shard_bytes_chain(self, monkeypatch):
+        assert stream_mod.resolve_shard_bytes(sst.TpuConfig()) \
+            == stream_mod.DEFAULT_SHARD_BYTES
+        monkeypatch.setenv("SST_STREAM_SHARD_BYTES", "12345")
+        assert stream_mod.resolve_shard_bytes(sst.TpuConfig()) == 12345
+        assert stream_mod.resolve_shard_bytes(
+            sst.TpuConfig(stream_shard_bytes=99)) == 99
+        with pytest.raises(ValueError, match="positive"):
+            stream_mod.resolve_shard_bytes(
+                sst.TpuConfig(stream_shard_bytes=0))
+
+    def test_check_stream_supported_contract(self):
+        import types
+        cfg = sst.TpuConfig()
+        ok = types.SimpleNamespace(supports_stream=True, name="ok",
+                                   default_scorer=None)
+        stream_mod.check_stream_supported(ok, None, cfg)
+        no = types.SimpleNamespace(supports_stream=False, name="no",
+                                   default_scorer=None)
+        with pytest.raises(ValueError, match="streaming-fold protocol"):
+            stream_mod.check_stream_supported(no, None, cfg)
+        with pytest.raises(ValueError, match="default scorer only"):
+            stream_mod.check_stream_supported(ok, "f1_macro", cfg)
+        with pytest.raises(ValueError, match="n_data_shards"):
+            stream_mod.check_stream_supported(
+                ok, None, sst.TpuConfig(n_data_shards=2))
+
+    def test_unsupported_family_fails_fast(self):
+        """KNN has no streaming fold: the stream tier must refuse
+        loudly instead of silently densifying."""
+        from sklearn.neighbors import KNeighborsClassifier
+        X, y = _count_data(n=80)
+        with pytest.raises(ValueError,
+                           match="streaming-fold protocol"):
+            _fit(X, y, KNeighborsClassifier(), {"n_neighbors": [3]},
+                 data_mode="stream")
+
+    def test_plan_stream_shards_geometry(self):
+        p = plan_stream_shards(1000, 100, 100 * 250)
+        assert (p.shard_rows, p.n_shards, p.capped) == (250, 4, False)
+        q = plan_stream_shards(1000, 100, 100 * 250,
+                               budget_bytes=100 * 100 * 2 * 2,
+                               reserved_bytes=0)
+        assert q.capped and q.shard_rows < 250
+        assert q.n_shards * q.shard_rows >= 1000

@@ -50,6 +50,13 @@ _WATCHED = (
     # aggregate searches/min at the deepest contended serve level —
     # the throughput cross-search launch fusion is accountable for
     ("serve_spm", "down"),
+    # sparse-vs-dense upload ratio in the stream_sparse leg: the BCOO
+    # tier's whole point is nnz-proportional h2d, so the ratio creeping
+    # up means something started densifying on the upload path
+    ("sparse_h2d_ratio", "up"),
+    # streamed h2d volume at the leg's fixed shape: growth means the
+    # stream tier re-uploads or pads more than its plan claims
+    ("stream_h2d_bytes", "up"),
 )
 
 
@@ -85,6 +92,7 @@ def _round_row(path: str) -> Dict[str, Any]:
                     + prot.get("quarantined", 0))
         if serve[key].get("searches_per_min") is not None:
             spm = serve[key]["searches_per_min"]
+    ss = det.get("stream_sparse") or {}
     return {
         "round": n,
         "rc": payload.get("rc"),
@@ -94,6 +102,9 @@ def _round_row(path: str) -> Dict[str, Any]:
         "store_hit_rate": hit_rate,
         "serve_shed": shed,
         "serve_spm": spm,
+        "sparse_h2d_ratio": ss.get("sparse_over_dense_h2d"),
+        "stream_h2d_bytes": ss.get("stream_block_h2d_bytes"),
+        "stream_shards": ss.get("stream_n_shards"),
         "parsed": bool(det),
     }
 
@@ -166,7 +177,8 @@ def _fmt(v: Any, nd: int = 2) -> str:
 def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
            f"{'halving x':>10} {'hit rate':>9} {'shed':>6} "
-           f"{'srch/min':>9}"]
+           f"{'srch/min':>9} {'sp/dn h2d':>10} {'strm h2d':>9} "
+           f"{'shards':>7}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
@@ -174,7 +186,10 @@ def format_table(digest: Dict[str, Any]) -> str:
             f"{_fmt(r['halving_speedup']):>10} "
             f"{_fmt(r['store_hit_rate']):>9} "
             f"{_fmt(r.get('serve_shed'), 0):>6} "
-            f"{_fmt(r.get('serve_spm')):>9}"
+            f"{_fmt(r.get('serve_spm')):>9} "
+            f"{_fmt(r.get('sparse_h2d_ratio'), 4):>10} "
+            f"{_fmt(r.get('stream_h2d_bytes'), 0):>9} "
+            f"{_fmt(r.get('stream_shards'), 0):>7}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

@@ -247,6 +247,12 @@ class Project:
                     Producer("dict-keys", "obs/attribution.py",
                              "attribution_block"),
                 )),
+                BlockSpec("streaming", "STREAMING_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "parallel/taskgrid.py",
+                             "StreamPlan.report_block"),
+                    Producer("dict-keys", "search/stream.py",
+                             "_streaming_counters"),
+                )),
                 BlockSpec("telemetry", "TELEMETRY_SNAPSHOT_SCHEMA", (
                     Producer("dict-keys", "obs/telemetry.py",
                              "TelemetryService.snapshot"),
