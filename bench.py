@@ -1175,6 +1175,81 @@ def leg_stream_sparse(cache_dir=None, n=4_000, d=512, density=0.01,
     }
 
 
+def leg_chunkloop(cache_dir=None, n_rows=484, n_candidates=48,
+                  folds=2, max_iter=25, tasks_per_batch=8):
+    """Device-resident chunk loop (ISSUE 16): the SAME LogReg grid run
+    with ``chunk_loop="per_chunk"`` vs ``"scan"``, WARM walls only,
+    recording the launch-count collapse — per-chunk pays one launch
+    per chunk per group while scan rolls each compile group's whole
+    chunk axis into ONE ``lax.scan`` launch (``launches_per_group``
+    -> 1.0) — and asserting byte-identical ``cv_results_``."""
+    import numpy as np
+    from sklearn.datasets import load_digits
+    from sklearn.linear_model import LogisticRegression
+
+    import spark_sklearn_tpu as sst
+
+    X, y = load_digits(return_X_y=True)
+    X = (X[:n_rows] / 16.0).astype(np.float32)
+    y = y[:n_rows]
+    grid = {"C": np.logspace(-4, 3, n_candidates).tolist()}
+
+    def timed(mode):
+        def mk():
+            # small task batches force several chunks per compile
+            # group, so the per-chunk arm's launch count is the
+            # boundary tax being measured, not an artifact of one
+            # giant chunk.  Pinned geometry costs keep BOTH arms on
+            # identical planned widths — the global cost model learns
+            # from the first arm's launches, and a width change means
+            # a different reduction shape, which would turn the
+            # byte-identity assertion into a 1-ulp lottery.
+            return sst.GridSearchCV(
+                LogisticRegression(max_iter=max_iter), grid, cv=folds,
+                refit=False, backend="tpu",
+                config=sst.TpuConfig(
+                    compilation_cache_dir=cache_dir, chunk_loop=mode,
+                    max_tasks_per_batch=tasks_per_batch,
+                    geometry_overhead_s=0.01,
+                    geometry_lane_cost_s=1e-3))
+        mk().fit(X, y)                      # warm the programs
+        t0 = time.perf_counter()
+        gs = mk().fit(X, y)
+        return gs, round(time.perf_counter() - t0, 3)
+
+    pc, wall_pc = timed("per_chunk")
+    sc, wall_sc = timed("scan")
+    blk = sc.search_report["chunkloop"]
+    n_groups = max(1, len(sc.search_report.get("per_group", {})))
+    n_l_pc = int(pc.search_report.get("n_launches", 0))
+    n_l_sc = int(sc.search_report.get("n_launches", 0))
+    parity = all(
+        np.array_equal(np.asarray(pc.cv_results_[k]),
+                       np.asarray(sc.cv_results_[k]))
+        for k in pc.cv_results_ if "time" not in k and k != "params")
+    return {
+        "shape": f"digits[{n_rows}], {n_candidates} C x {folds} "
+                 f"folds, {tasks_per_batch} tasks/batch",
+        "per_chunk_warm_wall_s": wall_pc,
+        "scan_warm_wall_s": wall_sc,
+        "wall_ratio_per_chunk_over_scan": round(
+            wall_pc / wall_sc, 3) if wall_sc else 0.0,
+        "n_groups": n_groups,
+        "n_launches_per_chunk": n_l_pc,
+        "n_launches_scan": n_l_sc,
+        "per_chunk_launches_per_group": round(n_l_pc / n_groups, 2),
+        "scan_launches_per_group": round(n_l_sc / n_groups, 2),
+        "launch_collapse_ratio": round(
+            n_l_pc / n_l_sc, 2) if n_l_sc else 0.0,
+        "n_segments": blk["n_segments"],
+        "n_chunks_scanned": blk["n_chunks_scanned"],
+        "n_launches_saved": blk["n_launches_saved"],
+        "scan_fallbacks": list(blk["fallbacks"]),
+        "scan_cv_results_identical": bool(parity),
+        "memory": _memory_summary(sc.search_report),
+    }
+
+
 #: (detail key, leg fn, kwargs builder) for the breadth legs the TPU
 #: child runs after the headline; each failure is contained per-leg.
 _BREADTH_LEGS = [
@@ -1187,6 +1262,7 @@ _BREADTH_LEGS = [
     ("serve_contended", leg_serve_contended, {}),
     ("halving_adaptive", leg_halving, {}),
     ("stream_sparse", leg_stream_sparse, {}),
+    ("chunkloop_scan", leg_chunkloop, {}),
 ]
 
 #: scaled-down per-leg kwargs for the BENCH_FORCE_BREADTH=1 rehearsal
@@ -1213,6 +1289,8 @@ _BREADTH_TOY_KWARGS = {
                              max_iter=10),
     "stream_sparse": dict(n=400, d=64, n_alphas=3, folds=2,
                           budget_mib=0.25),
+    "chunkloop_scan": dict(n_rows=242, n_candidates=24, folds=2,
+                           max_iter=10),
 }
 
 
@@ -1384,6 +1462,22 @@ def run_child(platform):
             detail["halving_adaptive"] = leg_detail
         except Exception as exc:  # noqa: BLE001 — breadth only
             detail["halving_adaptive_error"] = repr(exc)[:300]
+        _emit(payload)
+
+        # the chunk-loop A/B (ISSUE 16) must exist in every payload
+        # too: launches_per_group is the trend column that keeps the
+        # scan path's launch collapse honest across rounds, and the
+        # leg is CPU-affordable because both arms run WARM at a
+        # moderate grid
+        try:
+            leg_detail, leg_trace = _traced(
+                "chunkloop_scan", trace_dir, leg_chunkloop,
+                cache_dir=cache_dir)
+            if leg_trace and isinstance(leg_detail, dict):
+                leg_detail["trace_file"] = leg_trace
+            detail["chunkloop_scan"] = leg_detail
+        except Exception as exc:  # noqa: BLE001 — breadth only
+            detail["chunkloop_scan_error"] = repr(exc)[:300]
         _emit(payload)
 
     return 0

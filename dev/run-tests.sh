@@ -418,6 +418,64 @@ print("halving smoke:",
        "widths": [r["widths"] for r in hb["rungs"]]})
 PY
 
+echo "== chunk-loop smoke (device-resident scan vs per-chunk launches) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import os
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+
+rng = np.random.RandomState(0)
+X = rng.randn(160, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+grid = {"C": np.logspace(-2, 1, 24).tolist()}
+# pinned geometry costs keep both arms on identical planned widths
+# (a width change is a different reduction shape = 1-ulp lottery);
+# small batches force several chunks so the collapse is non-trivial
+geo = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3,
+           max_tasks_per_batch=8)
+
+
+def run(**kw):
+    return sst.GridSearchCV(
+        LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+        backend="tpu", config=sst.TpuConfig(**geo, **kw)).fit(X, y)
+
+
+pc, sc = run(chunk_loop="per_chunk"), run(chunk_loop="scan")
+blk = sc.search_report["chunkloop"]
+# the whole compile group ran as ONE lax.scan launch...
+assert blk["enabled"] and blk["mode"] == "scan", blk
+assert sc.search_report["n_launches"] == blk["n_segments"] == 1, blk
+assert blk["n_chunks_scanned"] > 1 and not blk["fallbacks"], blk
+assert blk["n_launches_saved"] == \
+    blk["n_chunks_scanned"] - blk["n_segments"], blk
+# ...while the per-chunk arm paid the boundary once per chunk
+assert pc.search_report["n_launches"] >= blk["n_chunks_scanned"]
+# ...and melting the launch boundary changed nothing numeric
+for k in pc.cv_results_:
+    if "time" in k or k == "params":
+        continue
+    np.testing.assert_array_equal(np.asarray(pc.cv_results_[k]),
+                                  np.asarray(sc.cv_results_[k]),
+                                  err_msg=k)
+# the env knob resolves too (config-field-less deployments)
+os.environ["SST_CHUNK_LOOP"] = "scan"
+try:
+    env_blk = run().search_report["chunkloop"]
+finally:
+    del os.environ["SST_CHUNK_LOOP"]
+assert env_blk["enabled"] and env_blk["mode"] == "scan", env_blk
+print("chunk-loop smoke:",
+      {"n_chunks_scanned": blk["n_chunks_scanned"],
+       "n_launches_saved": blk["n_launches_saved"],
+       "launches": {"per_chunk": pc.search_report["n_launches"],
+                    "scan": sc.search_report["n_launches"]}})
+PY
+
 echo "== device-memory smoke (HBM width ceiling + ledger flight bundle) =="
 MEM_FLIGHT_DIR=$(mktemp -d /tmp/sst_mem_smoke_XXXX)
 JAX_PLATFORMS=cpu SST_MEM_FLIGHT_DIR="$MEM_FLIGHT_DIR" python - <<'PY'

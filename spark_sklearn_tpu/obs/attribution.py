@@ -7,7 +7,12 @@ analyzer that decomposes a search's measured wall into mutually
 exclusive lanes, each pinned to one cause —
 
   compile_s     traced-program construction ('compile' spans, else
-                n_compiles x the cost model's compile_wall_s)
+                n_compiles x the cost model's compile_wall_s —
+                n_compiles counts PROGRAMS built, never chunks or
+                launches, so the estimate is launch-shape-invariant:
+                a scanned group (chunk_loop="scan", one launch for
+                many chunks) and the per-chunk path bill the same
+                compile lane)
   stage_s       host->device staging (h2d)
   compute_s     useful device compute
   gather_s      blocking device->host result transfer
@@ -323,6 +328,12 @@ def attribution_block(report: Dict[str, Any], wall_s: float,
         compile_s = compile_traced
     else:
         compile_source = "modeled"
+        # n_compiles is the pipeline's PROGRAM build count (grid.py
+        # bills _program_build_count deltas), and compile_wall_s is
+        # the cost model's per-program EMA (observe(n_builds=...)) —
+        # both sides count programs, so coarse launch shapes (a
+        # scanned compile group is ONE launch serving many chunks)
+        # don't inflate the modeled compile lane
         compile_s = n_compiles * float(cost.get("compile_wall_s", 0.0)
                                        or 0.0)
         if compile_s <= 0.0 and n_compiles > 0:

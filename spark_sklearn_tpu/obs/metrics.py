@@ -34,6 +34,7 @@ __all__ = [
     "PROGRAMSTORE_BLOCK_SCHEMA",
     "SCHEDULER_BLOCK_SCHEMA",
     "HALVING_BLOCK_SCHEMA",
+    "CHUNKLOOP_BLOCK_SCHEMA",
     "MEMORY_BLOCK_SCHEMA",
     "STREAMING_BLOCK_SCHEMA",
     "ATTRIBUTION_BLOCK_SCHEMA",
@@ -90,9 +91,9 @@ SEARCH_REPORT_SCHEMA = (
     MetricDef(
         "per_group", "struct",
         "Per-compile-group record: static_params (repr), n_launches, "
-        "fit_wall_s, score_wall_s, score_path (wide-fused/wide/nested) "
-        "and, when fused chunks calibrated, "
-        "score_s_per_task_calibrated."),
+        "fit_wall_s, score_wall_s, score_path "
+        "(scan-fused/wide-fused/wide/nested) and, when fused chunks "
+        "calibrated, score_s_per_task_calibrated."),
     MetricDef(
         "solver_iters_per_launch", "series",
         "Per-launch max executed solver iterations over the launch's "
@@ -162,6 +163,14 @@ SEARCH_REPORT_SCHEMA = (
         "geometry re-planning (search/halving.py).  Absent on "
         "exhaustive searches.",
         backends="tpu,host"),
+    MetricDef(
+        "chunkloop", "struct",
+        "The chunk-loop mode's per-search view (see the "
+        "chunkloop-block schema below): whether the device-resident "
+        "scan loop ran (TpuConfig.chunk_loop='scan' / SST_CHUNK_LOOP), "
+        "segments executed and chunks melted into them, launches "
+        "saved, fallback reasons, and halving's device-vs-host rung "
+        "elimination counts (search/grid.py scan path)."),
     MetricDef(
         "memory", "struct",
         "The device-memory ledger's per-search view (see the "
@@ -261,7 +270,9 @@ PIPELINE_BLOCK_SCHEMA = (
               "are in this timebase."),
     MetricDef("launches", "series",
               "One record per launch: key, group, kind "
-              "(fit/score/calibrate/fused), n_tasks, stage_bytes "
+              "(fit/score/calibrate/fused/scan), n_tasks, n_chunks "
+              "(chunks the launch served: 1 per-chunk, the segment's "
+              "member count for scan), stage_bytes "
               "(host->device transfer during its stage), per-phase "
               "walls (stage_s/stage_wait_s/dispatch_s/compute_s/"
               "gather_s/finalize_s) and the launch's t0_s/t1_s "
@@ -335,8 +346,12 @@ GEOMETRY_BLOCK_SCHEMA = (
               "overhead)."),
     MetricDef("cost_model", "struct",
               "The cost-model snapshot that priced the plan: "
-              "launch_overhead_s, lane_cost_s, compile_wall_s, "
-              "n_observations, source (default/measured/override)."),
+              "launch_overhead_s, lane_cost_s, compile_wall_s (a "
+              "PER-PROGRAM build wall — observe() divides the "
+              "compile excess by the launch's program-build count, "
+              "so chunk_loop=\"scan\"'s coarse launches don't skew "
+              "it), n_observations, source "
+              "(default/measured/override)."),
     MetricDef("groups", "series",
               "Per compile group: group index, n_candidates, chosen "
               "width, n_chunks, and whether convergence-sorted "
@@ -541,6 +556,59 @@ HALVING_BLOCK_SCHEMA = (
 )
 
 
+#: sub-keys of ``search_report["chunkloop"]`` (written by
+#: ``search.grid.chunkloop_block`` and mutated in place by the scan
+#: finalizers and halving's elimination accounting) — the
+#: device-resident chunk loop's per-search view.  Emitted for BOTH
+#: loop modes: per-chunk searches report the zeroed ``enabled=False``
+#: shape so the report schema never changes.
+CHUNKLOOP_BLOCK_SCHEMA = (
+    MetricDef("mode", "label",
+              "The resolved chunk-loop mode: 'per_chunk' (default; "
+              "one launch per chunk) or 'scan' "
+              "(TpuConfig.chunk_loop / SST_CHUNK_LOOP)."),
+    MetricDef("enabled", "label",
+              "True when the scan path actually ran: mode='scan' AND "
+              "the fused score path was available (the scan body is "
+              "the fused program)."),
+    MetricDef("n_segments", "counter",
+              "Scan segments executed — each is ONE device launch "
+              "serving a whole compile group (or the memory-ledger-"
+              "sized slice of one)."),
+    MetricDef("n_chunks_scanned", "counter",
+              "Chunks melted into scan segments (journalled "
+              "per chunk, so kill-resume replays at scan-segment "
+              "granularity)."),
+    MetricDef("n_launches_saved", "counter",
+              "Launch boundaries the scan melted: sum over segments "
+              "of (member chunks - 1) vs. the per-chunk path."),
+    MetricDef("segment_lengths", "series",
+              "Member-chunk count of each executed segment, in "
+              "dispatch order."),
+    MetricDef("fallbacks", "series",
+              "Why (parts of) the search stayed per-chunk: "
+              "'unfused-score-path' (scan requested without the fused "
+              "program), 'segment-capped:<group>' (the HBM budget "
+              "split the group into multiple segments), "
+              "'oom-per-chunk:<group>' (an OOM on a scanned segment "
+              "fell back to the per-chunk recovery path for that "
+              "segment)."),
+    MetricDef("rung_topk_device", "counter",
+              "Halving rungs whose top-k elimination ran ON DEVICE "
+              "inside the scanned launch (no score round-trip between "
+              "rungs)."),
+    MetricDef("rung_topk_host", "counter",
+              "Halving rungs that fell back to sklearn's host _top_k "
+              "(partial scan, multiple segments, or a recovered "
+              "segment) while scan was enabled."),
+    MetricDef("score_attribution", "label",
+              "'folded' when scan melted the score launch into the "
+              "segment wall (score-time columns are 0.0 and the whole "
+              "wall lands in fit time); 'calibrated' on the per-chunk "
+              "path (warm calibration launch splits fused walls)."),
+)
+
+
 #: sub-keys of ``search_report["memory"]`` (written by
 #: ``parallel.memledger.report_block``) — the device-memory ledger's
 #: per-search view: what the search modeled, what the budget allowed,
@@ -668,7 +736,9 @@ ATTRIBUTION_BLOCK_SCHEMA = (
               "Seconds charged to traced-program construction: "
               "summed 'compile' span walls when the search was "
               "traced, else n_compiles x the geometry cost model's "
-              "compile_wall_s estimate."),
+              "compile_wall_s estimate (programs built, not chunks "
+              "or launches — invariant to chunk_loop=\"scan\"'s "
+              "coarser launch shape)."),
     MetricDef("stage_s", "gauge",
               "Seconds charged to host->device staging (h2d "
               "transfer) that was not hidden behind device compute."),
@@ -1071,6 +1141,16 @@ def schema_markdown() -> str:
         "`HalvingRandomSearchCV` fits (`search/halving.py`).\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in HALVING_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"chunkloop\"]` block\n")
+    out.append(
+        "\nThe device-resident chunk loop's per-search view "
+        "(`TpuConfig.chunk_loop=\"scan\"` / `SST_CHUNK_LOOP`; "
+        "`search/grid.py`).  Always present on compiled-tier "
+        "searches — per-chunk runs report the zeroed "
+        "`enabled=False` shape.\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in CHUNKLOOP_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `search_report[\"memory\"]` block\n")
     out.append(

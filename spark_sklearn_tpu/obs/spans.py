@@ -89,6 +89,17 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "One successive-halving rung: fit + score of the "
             "surviving candidates at this rung's resource (carries "
             "iter, n_candidates, n_resources)."),
+    SpanDef("chunkloop.segment", "span", "search.grid",
+            "Host-side staging of one scan segment (chunk_loop="
+            "\"scan\"): the member chunks' operands stacked along the "
+            "leading step axis and uploaded as one slab (carries "
+            "group, n_chunks)."),
+    SpanDef("chunkloop.scan", "span", "search.grid",
+            "One lax.scan launch executing a whole scan segment — "
+            "n_chunks member chunks — as a single device program "
+            "(carries group, n_chunks, and topk: the on-device rung "
+            "elimination's keep count, 0 when the carry is score-"
+            "only)."),
     # parallel/taskgrid.py
     SpanDef("build_compile_groups", "span", "parallel.taskgrid",
             "Partitioning candidates into static-signature groups."),

@@ -848,7 +848,7 @@ class LaunchSupervisor:
             key=item.key, launch=guarded_launch, stage=item.stage,
             gather=guarded_gather, finalize=item.finalize,
             group=item.group, kind=item.kind, n_tasks=item.n_tasks,
-            wait=guarded_wait)
+            n_chunks=item.n_chunks, wait=guarded_wait)
 
     # -- recovery --------------------------------------------------------
     def _recover(self, st: Dict[str, Any], exc: Exception):

@@ -121,6 +121,18 @@ class TpuConfig:
     # everywhere.  Applies to the wide score path only (custom scorers
     # keep separate launches).
     fuse_fit_score: bool = True
+    # chunk-loop strategy (parallel/taskgrid.resolve_chunk_loop):
+    # "per_chunk" dispatches one launch per chunk — the default, the
+    # resumable/faultable baseline, and the fallback for a scanned
+    # segment that OOMs.  "scan" rolls a compile group's chunk loop
+    # into the program via lax.scan (carry buffers donated by XLA
+    # across scan steps), so an entire scan segment — a whole group,
+    # or a whole halving rung including its on-device top_k
+    # elimination — executes as ONE launch.  Requires the fused
+    # fit+score path (fuse_fit_score, wide scoring); searches that
+    # cannot fuse fall back to per_chunk and record the reason in
+    # search_report["chunkloop"].  None defers to SST_CHUNK_LOOP.
+    chunk_loop: Optional[str] = None
     # force the nested per-(candidate, fold) score path even when every
     # scorer exposes a task-batched core — the A/B control arm
     # (tools/score_ab.py).  None/False keeps the wide path; the

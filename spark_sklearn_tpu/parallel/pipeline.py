@@ -230,6 +230,11 @@ class LaunchItem:
     group: int = 0
     kind: str = "launch"
     n_tasks: int = 0
+    #: chunks this launch serves — 1 for the per-chunk paths, the
+    #: scan-segment member count for kind="scan" items (search/grid.py
+    #: chunk_loop="scan"): the timeline then pins the launch-boundary
+    #: collapse (one record, many chunks)
+    n_chunks: int = 1
     wait: Optional[Callable[[Any], Any]] = None
     bisect: Optional[Callable[[Any], Any]] = None
     host_fallback: Optional[Callable[[], Any]] = None
@@ -518,7 +523,7 @@ class ChunkPipeline:
         _memledger.note_launch_boundary()
         rec = {
             "key": item.key, "group": item.group, "kind": item.kind,
-            "n_tasks": item.n_tasks,
+            "n_tasks": item.n_tasks, "n_chunks": int(item.n_chunks),
             "stage_bytes": int(tm.stage_bytes),
             "stage_s": round(tm.stage_s, 6),
             "stage_wait_s": round(tm.stage_wait_s, 6),

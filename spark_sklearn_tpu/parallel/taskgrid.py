@@ -18,6 +18,7 @@ the same grid must become *arrays*:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -253,14 +254,26 @@ class GeometryCostModel:
         self.compile_wall_s = 0.0
         self.n_observations = 0
 
-    def observe(self, launches) -> None:
+    def observe(self, launches,
+                n_builds: Optional[int] = None) -> None:
         """Fold one search's per-launch timeline records (the
         ``search_report["pipeline"]["launches"]`` series) into the
         model.  Overhead is the MEDIAN per-launch host-side wall
         (robust to the first launch's trace+compile landing in
         dispatch_s); lane cost is total device compute over total real
         lanes; the excess dispatch over the median is recorded as the
-        observed compile wall."""
+        observed compile wall.
+
+        ``n_builds`` — how many XLA programs were actually built behind
+        this timeline slice — normalizes the compile wall to ONE
+        program.  The attribution doctor prices modeled compile time as
+        ``n_compiles x compile_wall_s``, so an aggregate (per-search)
+        excess double-counts whenever several launches share one
+        program: a scanned compile group builds one program but runs
+        many chunks.  With ``n_builds=0`` the slice compiled nothing
+        and its dispatch jitter is NOT folded into the compile wall at
+        all.  ``None`` keeps the legacy per-slice aggregate (callers
+        that cannot count builds)."""
         recs = [r for r in (launches or []) if r.get("n_tasks", 0) > 0]
         if not recs:
             return
@@ -273,16 +286,24 @@ class GeometryCostModel:
         med_overhead = overheads[(len(overheads) - 1) // 2]
         compute = sum(r.get("compute_s", 0.0) for r in recs)
         lanes = sum(r["n_tasks"] for r in recs)
-        compile_excess = sum(
+        compile_excess: Optional[float] = sum(
             max(0.0, o - med_overhead) for o in overheads)
+        if n_builds is not None:
+            # per-PROGRAM compile lane: divide the slice's excess over
+            # the builds that caused it, or skip the EMA entirely when
+            # nothing compiled (the excess is then launch jitter, not
+            # compile wall)
+            compile_excess = (compile_excess / n_builds
+                              if n_builds > 0 else None)
         with self._lock:
             lane_cost = compute / lanes if lanes else self.lane_cost_s
             alpha = 0.5 if self.n_observations else 1.0
             self.launch_overhead_s += alpha * (
                 med_overhead - self.launch_overhead_s)
             self.lane_cost_s += alpha * (lane_cost - self.lane_cost_s)
-            self.compile_wall_s += alpha * (
-                compile_excess - self.compile_wall_s)
+            if compile_excess is not None:
+                self.compile_wall_s += alpha * (
+                    compile_excess - self.compile_wall_s)
             self.n_observations += 1
 
     def snapshot(self) -> Dict[str, Any]:
@@ -418,10 +439,126 @@ def _chunk_cost(nc: int, width: int, n_folds: int, overhead: float,
             n_chunks, width)
 
 
+#: chunk-loop strategies: "per_chunk" dispatches one launch per chunk
+#: (the default, resumable/faultable at chunk granularity); "scan"
+#: rolls a compile group's chunk loop into the program via ``lax.scan``
+#: so a whole scan segment executes as ONE launch.
+CHUNK_LOOP_MODES = ("per_chunk", "scan")
+
+
+def resolve_chunk_loop(config) -> str:
+    """The search's chunk-loop strategy: ``TpuConfig.chunk_loop`` wins,
+    then the ``SST_CHUNK_LOOP`` env mirror, then ``"per_chunk"`` (the
+    byte-identical legacy path)."""
+    mode = getattr(config, "chunk_loop", None)
+    if mode is None:
+        mode = os.environ.get("SST_CHUNK_LOOP", "").strip().lower() or None
+    if mode is None:
+        return "per_chunk"
+    mode = str(mode).strip().lower()
+    if mode not in CHUNK_LOOP_MODES:
+        raise ValueError(
+            f"chunk_loop={mode!r} is not a chunk-loop strategy; "
+            f"expected one of {CHUNK_LOOP_MODES}")
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanKey:
+    """Named-field identity of one geometry plan.
+
+    The plan-cache key grew positionally for five PRs (min_width as a
+    bolted-on 9th element, HBM width caps the 10th, the fusion lane
+    discount the 11th) until every new planner input meant another
+    length-gated ``j[k] if len(j) > k`` in the JSON decoder.  This
+    struct names the fields; new planner inputs (``chunk_loop`` is the
+    first) arrive as defaulted fields instead of positional appendage.
+
+    Frozen + all-hashable fields, so instances key ``_PLAN_CACHE``
+    directly.  :meth:`from_json` is the ONE back-compat decoder: it
+    accepts both the named-dict form this process writes and the legacy
+    positional list (8/9/10/11 elements) older processes persisted into
+    the program store's ``plans.json``.
+    """
+
+    sizes: Tuple[int, ...]
+    sorted_caps: Tuple[Optional[int], ...]
+    n_folds: int
+    n_task_shards: int
+    max_width: int
+    mode: str
+    overhead_override: Optional[float]
+    lane_cost_override: Optional[float]
+    min_width: int = 0
+    width_caps: Tuple[Optional[int], ...] = ()
+    fusion_lane_discount: float = 0.0
+    #: the chunk-loop strategy the plan was priced under ("per_chunk" |
+    #: "scan").  Scan-mode plans cache separately — their segment
+    #: planning (``plan_scan_segments``) and any future scan-aware
+    #: pricing must never alias a per-chunk plan — but today's pricing
+    #: is identical by construction: chunk BOUNDARIES have to match
+    #: across modes so the checkpoint journal and the per-chunk OOM
+    #: fallback stay chunk-id-compatible.
+    chunk_loop: str = "per_chunk"
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["sizes"] = list(self.sizes)
+        d["sorted_caps"] = list(self.sorted_caps)
+        d["width_caps"] = list(self.width_caps)
+        return d
+
+    @classmethod
+    def from_json(cls, j: Any) -> "PlanKey":
+        """Decode a persisted key: named dict (current) or legacy
+        positional list.  Raises KeyError/IndexError/TypeError/
+        ValueError on malformed records (``import_plan_state`` skips
+        those)."""
+        if isinstance(j, Mapping):
+            return cls(
+                sizes=tuple(int(x) for x in j["sizes"]),
+                sorted_caps=tuple(None if c is None else int(c)
+                                  for c in j["sorted_caps"]),
+                n_folds=int(j["n_folds"]),
+                n_task_shards=int(j["n_task_shards"]),
+                max_width=int(j["max_width"]),
+                mode=str(j["mode"]),
+                overhead_override=(
+                    None if j["overhead_override"] is None
+                    else float(j["overhead_override"])),
+                lane_cost_override=(
+                    None if j["lane_cost_override"] is None
+                    else float(j["lane_cost_override"])),
+                min_width=int(j.get("min_width", 0)),
+                width_caps=tuple(
+                    None if c is None else int(c)
+                    for c in j.get("width_caps",
+                                   [None] * len(j["sizes"]))),
+                fusion_lane_discount=float(
+                    j.get("fusion_lane_discount", 0.0)),
+                chunk_loop=str(j.get("chunk_loop", "per_chunk")))
+        # legacy positional lists, length-gated exactly as the old
+        # decoder was: min_width rode in after plans.json shipped (8
+        # elements = floor 0), HBM caps later still (= uncapped), the
+        # fusion discount with cross-search fusion (= solo pricing)
+        return cls(
+            sizes=tuple(int(x) for x in j[0]),
+            sorted_caps=tuple(None if c is None else int(c)
+                              for c in j[1]),
+            n_folds=int(j[2]), n_task_shards=int(j[3]),
+            max_width=int(j[4]), mode=str(j[5]),
+            overhead_override=None if j[6] is None else float(j[6]),
+            lane_cost_override=None if j[7] is None else float(j[7]),
+            min_width=int(j[8]) if len(j) > 8 else 0,
+            width_caps=tuple(None if c is None else int(c) for c in j[9])
+            if len(j) > 9 else tuple([None] * len(j[0])),
+            fusion_lane_discount=float(j[10]) if len(j) > 10 else 0.0)
+
+
 #: first plan computed for a (structure, constraints) key is reused for
 #: the process lifetime — cost-model drift must not re-plan identical
 #: searches onto new widths (each new width is a fresh XLA compile).
-_PLAN_CACHE: Dict[Any, GeometryPlan] = {}
+_PLAN_CACHE: Dict[PlanKey, GeometryPlan] = {}
 _PLAN_CACHE_LOCK = named_lock("taskgrid._PLAN_CACHE_LOCK")
 
 
@@ -436,6 +573,7 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                   preferred: Optional[Sequence[Optional[int]]] = None,
                   width_caps: Optional[Sequence[Optional[int]]] = None,
                   fusion_lane_discount: float = 0.0,
+                  chunk_loop: str = "per_chunk",
                   ) -> GeometryPlan:
     """Choose every compile group's chunk width.
 
@@ -470,6 +608,16 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     plan-cache key, so fusion-on and fusion-off searches in one
     process never share plans.
 
+    ``chunk_loop`` names the chunk-loop strategy the caller will run
+    the plan under ("per_chunk" | "scan") and joins the plan-cache key
+    as a named :class:`PlanKey` field.  It does NOT change the chosen
+    widths: a scanned group's chunk boundaries must be byte-identical
+    to the per-chunk path's, because the checkpoint journal addresses
+    results by chunk id and a scanned segment that OOMs falls back to
+    per-chunk launches over the SAME chunks.  What scan mode prices
+    differently — the carry buffer and the stacked per-segment
+    operands — is planned separately by :func:`plan_scan_segments`.
+
     ``min_width`` floors every auto-chosen unsorted width (rounded up
     to the shard multiple, capped by ``max_width``) — the halving
     scheduler's ``TpuConfig.min_rung_width`` guard against
@@ -502,10 +650,19 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
             c -= c % max(1, n_task_shards)
             caps[gi] = max(n_task_shards, min(int(max_width), c))
     fusion_lane_discount = min(1.0, max(0.0, float(fusion_lane_discount)))
-    cache_key = (tuple(sizes), tuple(sorted_caps), int(n_folds),
-                 int(n_task_shards), int(max_width), mode,
-                 overhead_override, lane_cost_override, int(min_width),
-                 tuple(caps), fusion_lane_discount)
+    if chunk_loop not in CHUNK_LOOP_MODES:
+        raise ValueError(
+            f"chunk_loop must be one of {CHUNK_LOOP_MODES}, "
+            f"got {chunk_loop!r}")
+    cache_key = PlanKey(
+        sizes=tuple(sizes), sorted_caps=tuple(sorted_caps),
+        n_folds=int(n_folds), n_task_shards=int(n_task_shards),
+        max_width=int(max_width), mode=mode,
+        overhead_override=overhead_override,
+        lane_cost_override=lane_cost_override,
+        min_width=int(min_width), width_caps=tuple(caps),
+        fusion_lane_discount=fusion_lane_discount,
+        chunk_loop=str(chunk_loop))
     if reuse:
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)
@@ -628,26 +785,14 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
 # the publishing process ran, instead of re-pricing from scratch.
 
 
-def _plan_key_to_json(key: Tuple) -> List[Any]:
-    return [list(key[0]), list(key[1]), *key[2:]]
+def _plan_key_to_json(key: PlanKey) -> Dict[str, Any]:
+    return key.to_json()
 
 
-def _plan_key_from_json(j: Sequence[Any]) -> Tuple:
-    return (tuple(int(x) for x in j[0]),
-            tuple(None if c is None else int(c) for c in j[1]),
-            int(j[2]), int(j[3]), int(j[4]), str(j[5]),
-            None if j[6] is None else float(j[6]),
-            None if j[7] is None else float(j[7]),
-            # min_width rode in after plans.json shipped: records
-            # persisted by older processes carry 8 elements (= floor 0)
-            int(j[8]) if len(j) > 8 else 0,
-            # HBM width caps (memledger) rode in later still: older
-            # records carry no caps (= uncapped per group)
-            tuple(None if c is None else int(c) for c in j[9])
-            if len(j) > 9 else tuple([None] * len(j[0])),
-            # the fusion lane discount rode in with cross-search launch
-            # fusion: older records price lanes at full (solo) cost
-            float(j[10]) if len(j) > 10 else 0.0)
+def _plan_key_from_json(j: Any) -> PlanKey:
+    """Named-dict (current) or legacy positional-list (pre-PlanKey)
+    records — :meth:`PlanKey.from_json` is the one decoder."""
+    return PlanKey.from_json(j)
 
 
 def export_plan_state() -> Dict[str, Any]:
@@ -817,3 +962,83 @@ def plan_stream_shards(n_samples: int, row_bytes: int,
         n_shards=int(n_shards), row_bytes=int(row_bytes),
         target_shard_bytes=int(target), budget_bytes=budget_bytes,
         reserved_bytes=int(reserved_bytes), capped=bool(capped))
+
+
+# ---------------------------------------------------------------------------
+# Scan-segment planning (chunk_loop="scan")
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScanSegmentPlan:
+    """The planned scan-segment geometry of one compile group under
+    ``chunk_loop="scan"``.
+
+    A scanned launch stacks ``segment_len`` chunks' dynamic operands
+    into one ``(segment_len, lanes, ...)`` upload and carries the
+    score buffer through ``lax.scan`` — the whole slab plus the carry
+    is resident for the launch's lifetime, so the segment length is an
+    analytic decision against the memory ledger made BEFORE the first
+    upload, like :class:`StreamPlan`'s shard width.  ``capped=True``
+    records that the HBM budget split the group into more than one
+    segment; a budget that cannot even hold a single-chunk segment
+    plans ``segment_len=1`` rather than failing — the per-chunk OOM
+    fallback (bisection, host bottom-out) takes over from there, which
+    is exactly the path an OOMing scanned segment degrades to anyway.
+    """
+
+    n_chunks: int
+    segment_len: int           # chunks folded into one launch
+    n_segments: int
+    chunk_bytes: int           # modeled slab bytes per stacked chunk
+    carry_bytes: int           # modeled scan-carry residency
+    budget_bytes: int          # resolved HBM budget (0 = unbounded)
+    reserved_bytes: int        # modeled non-scan resident footprint
+    capped: bool = False
+
+    def segments(self) -> List[Tuple[int, int]]:
+        """``[lo, hi)`` chunk-index ranges, in launch order."""
+        return [(lo, min(lo + self.segment_len, self.n_chunks))
+                for lo in range(0, self.n_chunks, self.segment_len)]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+#: headroom factor on the modeled scanned-slab residency — the stacked
+#: operands plus scan outputs never plan past budget/margin of the
+#: free bytes (same safety style as the streaming planner's slabs)
+_SCAN_SLAB_MARGIN = 1.25
+
+
+def plan_scan_segments(n_chunks: int, *, chunk_bytes: int,
+                       carry_bytes: int = 0,
+                       budget_bytes: int = 0,
+                       reserved_bytes: int = 0,
+                       margin: float = _SCAN_SLAB_MARGIN
+                       ) -> ScanSegmentPlan:
+    """Analytically size the scan segments of a device-resident chunk
+    loop.
+
+    ``chunk_bytes`` is the summed modeled bytes ONE chunk contributes
+    to a scanned launch (stacked dynamic operands + its slice of the
+    stacked outputs — ``memledger.model_group_footprint``'s pricing);
+    ``carry_bytes`` the scan carry (the on-device score buffer a
+    halving rung accumulates for its device-resident ``top_k``);
+    ``reserved_bytes`` everything already resident (data plane, masks,
+    program footprint).  No budget plans ONE segment holding the whole
+    group — the melt-the-launch-boundary ideal."""
+    n_chunks = max(1, int(n_chunks))
+    chunk_bytes = max(1, int(chunk_bytes))
+    seg = n_chunks
+    budget_bytes = int(budget_bytes or 0)
+    if budget_bytes:
+        free = (budget_bytes // max(1.0, float(margin))
+                - int(reserved_bytes) - int(carry_bytes))
+        seg = max(1, min(n_chunks, int(free // chunk_bytes)))
+    n_segments = -(-n_chunks // seg)
+    return ScanSegmentPlan(
+        n_chunks=n_chunks, segment_len=int(seg),
+        n_segments=int(n_segments), chunk_bytes=chunk_bytes,
+        carry_bytes=int(carry_bytes), budget_bytes=budget_bytes,
+        reserved_bytes=int(reserved_bytes), capped=seg < n_chunks)

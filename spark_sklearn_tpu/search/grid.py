@@ -239,6 +239,38 @@ def _models_health(models):
     return bad
 
 
+def chunkloop_block(state, *, mode="per_chunk", enabled=False,
+                    score_attribution="calibrated"):
+    """Normalize the ``search_report["chunkloop"]`` block in place
+    (schema pinned in ``obs.metrics.CHUNKLOOP_BLOCK_SCHEMA``).
+
+    The state dict is the registry's own ``metrics.struct("chunkloop")``
+    object, so the scan-path finalizers (and halving's elimination
+    accounting) mutate the same dict this function returns — a halving
+    search's rungs accumulate into one whole-search block.  Emitted for
+    BOTH loop modes: a per-chunk search reports the zeroed
+    ``enabled=False`` shape, so the report schema never changes.
+    """
+    defaults = {
+        "mode": mode,
+        "enabled": bool(enabled),
+        "n_segments": 0,
+        "n_chunks_scanned": 0,
+        "n_launches_saved": 0,
+        "segment_lengths": [],
+        "fallbacks": [],
+        "rung_topk_device": 0,
+        "rung_topk_host": 0,
+        "score_attribution": score_attribution,
+    }
+    for k, v in defaults.items():
+        state.setdefault(k, v)
+    state["mode"] = mode
+    state["enabled"] = bool(enabled)
+    state["score_attribution"] = score_attribution
+    return state
+
+
 def _looks_like_estimator(obj) -> bool:
     return hasattr(obj, "get_params") and (
         hasattr(obj, "fit") or hasattr(obj, "predict"))
@@ -1968,15 +2000,45 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
         # launch that measures the steady-state score cost later fused
         # chunks attribute out of their single-launch wall.
         fused_mode = all_cores and config.fuse_fit_score
+        # device-resident chunk loop (chunk_loop="scan"): roll the
+        # compile group's chunk loop INTO the program via lax.scan so a
+        # whole scan segment — ideally the whole group, or a whole
+        # halving rung including its on-device top_k elimination —
+        # executes as ONE launch.  The scan body is the group's fused
+        # program, so scan requires the fused score path: a search that
+        # asks for scan without it (custom scorer on the nested path,
+        # fuse_fit_score=False) runs per-chunk and the chunkloop block
+        # records why.  Per-chunk stays the default and the
+        # resumable/faultable fallback.
+        from spark_sklearn_tpu.parallel.taskgrid import (
+            plan_scan_segments, resolve_chunk_loop)
+        chunk_loop = resolve_chunk_loop(config)
+        scan_mode = (chunk_loop == "scan") and fused_mode
+        cl_state = chunkloop_block(
+            metrics.struct("chunkloop"), mode=chunk_loop,
+            enabled=scan_mode,
+            score_attribution="folded" if scan_mode else "calibrated")
+        if chunk_loop == "scan" and not fused_mode:
+            cl_state["fallbacks"].append("unfused-score-path")
+        if scan_mode:
+            from jax import lax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # stacked per-chunk operands carry a leading scan-step axis;
+            # each step's slice keeps the per-chunk task sharding
+            scan_shard = NamedSharding(
+                mesh, P(None, mesh_lib.TASK_AXIS))
+            repl_shard = mesh_lib.replicated_sharding(mesh)
         # cross-search launch fusion (serve/executor.py): steady-state
         # fused chunks of an executor-submitted search offer a FuseSpec
         # so same-program chunks from OTHER searches coalesce into one
         # wide launch.  Donated buffers are excluded (a fused re-stage
         # would read host rows a donated solo launch may have consumed),
-        # and first-chunk fit/score/calibration items never fuse (they
-        # share cross-item group state).
+        # first-chunk fit/score/calibration items never fuse (they
+        # share cross-item group state), and scanned segments never
+        # fuse (one segment already serves many chunks; its lanes are
+        # billed to DRR by the member count instead).
         fusion_on = (fused_mode and binding is not None and not donate
-                     and _serve.resolve_fusion(config))
+                     and not scan_mode and _serve.resolve_fusion(config))
         score_key = tuple(sorted(scorers.items()))
         # deterministic identity parts for the persistent program store
         # (parallel/programstore.py): everything in a store key must
@@ -2119,7 +2181,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # lane is fillable by a same-program peer, so it prices at
             # half the solo waste; 0.0 keeps pre-fusion plans
             # byte-identical
-            fusion_lane_discount=0.5 if fusion_on else 0.0)
+            fusion_lane_discount=0.5 if fusion_on else 0.0,
+            # chunk widths are loop-mode-invariant (chunk ids must stay
+            # byte-identical across modes so journals and the per-chunk
+            # OOM fallback interoperate); the key field keeps the two
+            # modes' plans distinct cache residents all the same
+            chunk_loop=chunk_loop)
         #: per-group structure identity ACROSS rungs: the static params
         #: minus the budgeted resource (survivor groups at rung k+1
         #: carry the same key as the rung-0 group they came from, even
@@ -2455,9 +2522,90 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                              return_train, bool(all_cores)),
                 store=search_store)
             progs = {"fit": fit_jit, "score": score_jit,
-                     "fused": fused_jit}
+                     "fused": fused_jit,
+                     # the raw (un-jitted) fused body: the scan program
+                     # below wraps it as its lax.scan step function
+                     "fused_body": fused_batch if fused_mode else None}
             cache[nc_batch] = progs
             return progs
+
+        def build_scan(plan, n_steps, topk_k=0):
+            """ONE jitted program executing `n_steps` chunks of the
+            group as a `lax.scan` over the stacked chunk axis — the
+            melted launch boundary.  The step function is the group's
+            fused body, so every lane computes exactly what its solo
+            fused launch would (scan carries no cross-lane state into
+            the step), and XLA's loop buffer aliasing keeps ONE set of
+            model/score working buffers live across steps — the donated
+            carry the per-chunk path only gets via donate_chunk_buffers.
+
+            `topk_k > 0` additionally folds the halving rung's
+            elimination on device: a score carry (one row per group
+            candidate position plus a dump row for padded lanes)
+            accumulates each chunk's first-scorer test scores, and the
+            program returns the top-k candidate POSITIONS mirroring
+            sklearn's `_top_k` (ascending mean with NaNs rolled to the
+            front) — rung N+1's candidate set never round-trips scores
+            to host.
+            """
+            cache = plan.setdefault("scan_progs", {})
+            ck = (int(n_steps), int(topk_k))
+            prog = cache.get(ck)
+            if prog is not None:
+                return prog
+            fused_body = build_programs(plan)["fused_body"]
+            nc = int(plan["nc"])
+            donate_kw = {"donate_argnums": (0,)} if donate else {}
+            score0 = scorer_names[0]
+
+            def scan_batch(dyn_st, idx_st, data_d, w_fit, test_m,
+                           train_m, test_u, train_u):
+                if topk_k:
+                    carry0 = jnp.full((nc + 1, n_folds),
+                                      jnp.float32(errval))
+                else:
+                    carry0 = jnp.zeros((), jnp.float32)
+
+                def step(carry, xs):
+                    dyn_c, idx_c = xs
+                    te, tr, bad, im, isum = fused_body(
+                        dyn_c, data_d, w_fit, test_m, train_m,
+                        test_u, train_u)
+                    if topk_k:
+                        # mirror the host-side error_score substitution
+                        # BEFORE the mean, so the device ranking sees
+                        # the same scores sklearn's _top_k would
+                        sc = jnp.where(
+                            bad, jnp.float32(errval),
+                            te[score0].astype(jnp.float32))
+                        carry = carry.at[idx_c].set(sc)
+                    return carry, (te, tr, bad, im, isum)
+
+                carry, ys = lax.scan(step, carry0, (dyn_st, idx_st))
+                if topk_k:
+                    mean = carry[:nc].mean(axis=1)
+                    order = jnp.roll(jnp.argsort(mean),
+                                     jnp.count_nonzero(jnp.isnan(mean)))
+                    surv = order[-topk_k:].astype(jnp.int32)
+                else:
+                    surv = jnp.zeros((0,), jnp.int32)
+                return ys, surv
+
+            # the nan error_score (the default) breaks dict-key
+            # equality (nan != nan), so the key carries its repr; scan
+            # programs skip the persistent program store — the
+            # exported-wrapper path has no scan coverage yet, and a
+            # store-warm process still skips the python->HLO walk via
+            # this cache
+            scan_jit = _cached_program(
+                ("scan", family, plan["static"], meta, plan["nc_batch"],
+                 n_folds, int(n_steps), bool(config.bf16_matmul), mesh,
+                 score_key, return_train, sw_blind, donate,
+                 int(topk_k), nc, repr(float(errval))),
+                lambda: jax.jit(scan_batch, **donate_kw),
+                store_parts=None)
+            cache[ck] = scan_jit
+            return scan_jit
 
         def group_masks(plan):
             """The group's fit-mask device buffer.  Task-batched families
@@ -2527,7 +2675,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             compile options); failure here only means the jit path
             compiles at first dispatch, as it always did."""
             if plan.get("aot_submitted") or pipe.depth == 0 \
-                    or not fused_mode or plan["n_live"] < 2:
+                    or not fused_mode or scan_mode \
+                    or plan["n_live"] < 2:
+                # scan mode has no per-chunk fused dispatch to warm:
+                # its program compiles once at the segment launch
                 return
             plan["aot_submitted"] = True
             try:
@@ -2865,7 +3016,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             return bisect
 
         def write_cells(plan, idx, lo, hi, chunk_id, te, tr, t_fit,
-                        t_score):
+                        t_score, count_launch=True):
             # charge the launch wall to the REAL candidates in the chunk
             # (not the padded lane count), so summing ALL per-split
             # fit-time cells (mean_fit_time x n_splits over candidates)
@@ -2880,7 +3031,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 if return_train:
                     train_scores[s][idx, :] = \
                         np.asarray(tr[s])[:hi - lo]
-            metrics.counter("n_launches").inc()
+            if count_launch:
+                # scan segments call this once per MEMBER chunk (the
+                # per-chunk journal records give segment-granular
+                # resume for free) but count their one real launch in
+                # the segment finalize instead
+                metrics.counter("n_launches").inc()
             metrics.gauge("fit_wall_s").add(t_fit)
             metrics.gauge("score_wall_s").add(t_score)
             lanes_launch = plan["nc_batch"] * n_folds
@@ -2892,7 +3048,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # average (XLA executes them as one program, so a finer
             # split is not measurable; see ROADMAP)
             rec = per_group_rec(plan)
-            rec["n_launches"] += 1
+            if count_launch:
+                rec["n_launches"] += 1
             rec["fit_wall_s"] += t_fit
             rec["score_wall_s"] += t_score
             if self.verbose > 1:
@@ -2924,7 +3081,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             return pg.setdefault(key, {
                 "static_params": repr(plan["group"].static_params),
                 "n_launches": 0, "fit_wall_s": 0.0, "score_wall_s": 0.0,
-                "score_path": ("wide-fused" if fused_mode else
+                "score_path": ("scan-fused" if scan_mode else
+                               "wide-fused" if fused_mode else
                                "wide" if all_cores else "nested")})
 
         def record_iters(it_max, it_sum, lanes):
@@ -2933,12 +3091,281 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 int(it_sum))
             metrics.series("lanes_per_launch").append(int(lanes))
 
+        def replay_chunk(idx, rec):
+            """Write a journalled chunk's cells back — shared by the
+            per-chunk and scan dispatch paths, so resume semantics are
+            loop-mode-invariant."""
+            for s_ in scorer_names:
+                test_scores[s_][idx, :] = np.asarray(rec["test"][s_])
+                if return_train:
+                    train_scores[s_][idx, :] = np.asarray(
+                        rec["train"][s_])
+            fit_times[idx, :] = rec["fit_t"]
+            score_times[idx, :] = rec["score_t"]
+            if rec.get("failed") is not None:
+                fit_failed[idx, :] |= np.asarray(rec["failed"], bool)
+            metrics.counter("n_chunks_resumed").inc()
+            if pctx is not None:
+                pctx["done"][idx] = True
+
+        def shed_chunk(idx, chunk_id):
+            """True when the search deadline expired and this chunk was
+            shed to error_score (best_effort); raises under
+            partial_results='raise'.  Shared by both dispatch paths."""
+            if pctx is None or pctx["t_deadline"] is None \
+                    or time.perf_counter() < pctx["t_deadline"]:
+                return False
+            elapsed = time.perf_counter() - pctx["t_start"]
+            if str(getattr(config, "partial_results", "raise")
+                   or "raise") != "best_effort":
+                raise _faults.SearchDeadlineError(
+                    float(config.search_deadline_s), elapsed,
+                    n_remaining=int((~pctx["done"]).sum()))
+            if not pctx["deadline_hit"]:
+                pctx["deadline_hit"] = True
+                _telemetry.note_protection("deadline_hit")
+                logger.warning(
+                    "search deadline %.3gs expired after %.3fs: "
+                    "shedding the remaining chunks to error_score "
+                    "(partial_results='best_effort')",
+                    float(config.search_deadline_s), elapsed,
+                    chunk=chunk_id)
+            # un-run candidates carry sklearn's error_score with ZERO
+            # times (like a fit that never ran) — declared in the
+            # protection block, NOT routed through fit_failed
+            for s_ in scorer_names:
+                test_scores[s_][idx, :] = errval
+                if return_train:
+                    train_scores[s_][idx, :] = errval
+            fit_times[idx, :] = 0.0
+            score_times[idx, :] = 0.0
+            pctx["done"][idx] = True
+            pctx["shed"].append({
+                "reason": "deadline", "chunk": chunk_id,
+                "candidates": [int(i) for i in idx]})
+            _telemetry.note_protection("shed", len(idx))
+            return True
+
+        def scan_plan_items(plan):
+            """The plan's live chunks as scan-segment LaunchItems: each
+            segment stacks its member chunks' operands along a leading
+            step axis and executes them as ONE `lax.scan` launch
+            (build_scan above).  Segment length is planned against the
+            memory ledger (taskgrid.plan_scan_segments): the stacked
+            operands and the top-k carry are priced BEFORE launch, and
+            an OOM that still slips through falls back to the
+            per-chunk path for that segment only (the bisect hook)."""
+            gi, group = plan["gi"], plan["group"]
+            nc_batch = plan["nc_batch"]
+            lanes = nc_batch * n_folds
+            repeat = n_folds if task_batched else 1
+            live = []
+            for lo, hi, chunk_id, rec in plan["chunks"]:
+                idx = group.candidate_indices[lo:hi]
+                if rec is not None:
+                    replay_chunk(idx, rec)
+                    continue
+                if shed_chunk(idx, chunk_id):
+                    continue
+                live.append((lo, hi, chunk_id))
+            if not live:
+                return
+            # device-resident rung elimination is gated to the shapes
+            # where the carry's candidate-position rows are the whole
+            # rung: one compile group, one scorer, zero resumed/shed
+            # chunks, and (below) a single segment — any partial shape
+            # falls back to sklearn's host _top_k, which reads the
+            # same scores from cv_results_ either way
+            topk_k = 0
+            if rung is not None and len(plans) == 1 \
+                    and len(scorer_names) == 1 \
+                    and len(live) == len(plan["chunks"]):
+                k = int(getattr(rung, "keep_next", 0) or 0)
+                if 0 < k < int(plan["nc"]):
+                    topk_k = k
+            carry_bytes = (int(plan["nc"]) + 1) * n_folds * 4 \
+                if topk_k else 0
+            # per-step stacked bytes: the dynamic operand rows plus the
+            # stacked per-step outputs (scores/bad/iters) — the model
+            # working set itself is step-reused by XLA's loop aliasing
+            # and is priced once via reserved_bytes
+            chunk_dyn_bytes = 0
+            for arr in group.dynamic_params.values():
+                per = 1
+                for d in arr.shape[1:]:
+                    per *= int(d)
+                chunk_dyn_bytes += nc_batch * repeat * per \
+                    * int(arr.dtype.itemsize)
+            out_bytes = nc_batch * n_folds * (
+                len(scorer_names) * (2 if return_train else 1)
+                * int(np.dtype(dtype).itemsize) + 1) + 8
+            budget = int(mem_ctx.get("budget_bytes", 0)) \
+                if mem_ctx is not None else 0
+            seg_plan = plan_scan_segments(
+                len(live), chunk_bytes=chunk_dyn_bytes + out_bytes,
+                carry_bytes=carry_bytes, budget_bytes=budget,
+                reserved_bytes=int(resident_est)
+                + int(plan.get("mem_chunk_bytes", 0)))
+            if seg_plan.capped:
+                topk_k = 0   # the carry cannot cross launches
+                cl_state["fallbacks"].append(
+                    f"segment-capped:{cid_ns}{gi}")
+            cl_state["n_segments"] += seg_plan.n_segments
+
+            for si, (slo, shi) in enumerate(seg_plan.segments()):
+                members = live[slo:shi]
+                n_steps = len(members)
+                seg_key = cid_ns + f"{gi}:scan{si}"
+                seg_tasks = sum((hi - lo) * n_folds
+                                for lo, hi, _ in members)
+                seg_topk = topk_k if n_steps == len(live) else 0
+
+                def stage(members=members, plan=plan, n_steps=n_steps):
+                    with get_tracer().span(
+                            "chunkloop.segment", group=plan["gi"],
+                            n_chunks=n_steps):
+                        dyn = {}
+                        for k, arr in \
+                                plan["group"].dynamic_params.items():
+                            rows = np.stack([
+                                pad_chunk(arr, lo, hi, nc_batch, repeat)
+                                for lo, hi, _ in members])
+                            dyn[k] = _dataplane.upload(
+                                rows, scan_shard, label="dyn.scan")
+                        if not dyn and not task_batched:
+                            dyn["_pad"] = _dataplane.upload(
+                                np.zeros((n_steps, nc_batch),
+                                         dtype=dtype),
+                                scan_shard, label="dyn.scan.pad")
+                        # per-step candidate POSITIONS for the top-k
+                        # carry scatter (padded lanes hit the dump
+                        # row); always staged — the non-topk program
+                        # ignores it, and the shape keeps one item
+                        # contract for both
+                        idx_rows = np.full((n_steps, nc_batch),
+                                           int(plan["nc"]), np.int32)
+                        for i, (lo, hi, _) in enumerate(members):
+                            idx_rows[i, :hi - lo] = np.arange(
+                                lo, hi, dtype=np.int32)
+                        idx_st = _dataplane.upload(
+                            idx_rows, repl_shard, label="dyn.scan.idx")
+                        w = group_masks(plan)
+                        with stage_lock:
+                            done = plan.setdefault("staged_ids", set())
+                            for _, _, cid in members:
+                                done.add(cid)
+                            if len(done) >= plan["n_live"]:
+                                plan.pop("w_task_dev", None)
+                        return dyn, idx_st, w
+
+                def launch(payload, plan=plan, n_steps=n_steps,
+                           seg_topk=seg_topk):
+                    dyn, idx_st, w = payload
+                    # the trace pin for "no score round-trip": a rung
+                    # scanned with topk > 0 ran its elimination inside
+                    # this one launch
+                    with get_tracer().span(
+                            "chunkloop.scan", group=plan["gi"],
+                            n_chunks=n_steps, topk=seg_topk):
+                        return build_scan(plan, n_steps, seg_topk)(
+                            dyn, idx_st, data_dev, w, test_dev,
+                            train_sc_dev, test_unw_dev, train_unw_dev)
+
+                def gather(out, members=members, seg_topk=seg_topk):
+                    ys, surv = out
+                    te_st, tr_st, bad_st, im_st, isum_st = ys
+                    te_h = {s: np.asarray(mesh_lib.device_get_tree(v))
+                            for s, v in te_st.items()}
+                    tr_h = {s: np.asarray(mesh_lib.device_get_tree(v))
+                            for s, v in tr_st.items()}
+                    bad_h = np.asarray(mesh_lib.device_get_tree(bad_st))
+                    im_h = np.asarray(mesh_lib.device_get_tree(im_st))
+                    isum_h = np.asarray(
+                        mesh_lib.device_get_tree(isum_st))
+                    chunks = []
+                    for i in range(len(members)):
+                        chunks.append((
+                            {s: v[i] for s, v in te_h.items()},
+                            {s: v[i] for s, v in tr_h.items()},
+                            bad_h[i], int(im_h[i]), int(isum_h[i])))
+                    surv_h = (np.asarray(
+                        mesh_lib.device_get_tree(surv))
+                        if seg_topk else None)
+                    return {"chunks": chunks, "survivors": surv_h}
+
+                def bisect(sup, members=members, plan=plan,
+                           seg_key=seg_key):
+                    # OOM on the scanned segment: fall back to the
+                    # per-chunk path for THIS segment only — each
+                    # member relaunches through the existing fused
+                    # bisection recursion (host bottom-out included),
+                    # and the rung's elimination reverts to host
+                    # _top_k (survivors never set)
+                    sup.record_bisection(seg_key, plan["gi"])
+                    cl_state["fallbacks"].append(
+                        f"oom-per-chunk:{cid_ns}{plan['gi']}")
+                    chunks = [exec_fused_range(plan, lo, hi, sup, cid)
+                              for lo, hi, cid in members]
+                    return {"chunks": chunks, "survivors": None}
+
+                def finalize(host, tm, members=members, plan=plan,
+                             seg_topk=seg_topk, lanes=lanes):
+                    chunks = host["chunks"]
+                    wall = tm.dispatch_s + tm.compute_s + tm.gather_s
+                    total_real = sum((hi - lo) * n_folds
+                                     for lo, hi, _ in members)
+                    for (lo, hi, chunk_id), \
+                            (te, tr, bad, im, isum) in \
+                            zip(members, chunks):
+                        idx = plan["group"].candidate_indices[lo:hi]
+                        n_real = (hi - lo) * n_folds
+                        # the melted boundary makes per-chunk walls
+                        # unmeasurable: the segment wall splits by
+                        # real lanes and scoring is folded into fit
+                        # ("folded" attribution in the chunkloop
+                        # block) — time columns are estimates, scores
+                        # are exact
+                        t_fit = wall * n_real / max(1, total_real)
+                        fit_failed[idx, :] |= np.asarray(
+                            bad[:hi - lo], bool)
+                        if im >= 0:
+                            record_iters(im, isum, lanes)
+                        write_cells(plan, idx, lo, hi, chunk_id, te,
+                                    tr, t_fit, 0.0, count_launch=False)
+                    metrics.counter("n_launches").inc()
+                    rec = per_group_rec(plan)
+                    rec["n_launches"] += 1
+                    cl_state["n_chunks_scanned"] += len(members)
+                    cl_state["segment_lengths"].append(len(members))
+                    cl_state["n_launches_saved"] += len(members) - 1
+                    surv = host.get("survivors")
+                    if surv is not None and rung is not None:
+                        # device positions -> rung candidate indices,
+                        # in sklearn _top_k order (ascending mean) —
+                        # halving consumes these instead of its host
+                        # elimination
+                        rung.device_survivors = np.asarray(
+                            plan["group"].candidate_indices)[
+                                np.asarray(surv, int)]
+                        cl_state["rung_topk_device"] += 1
+
+                yield LaunchItem(
+                    key=seg_key, kind="scan", group=gi,
+                    n_tasks=seg_tasks, n_chunks=n_steps, stage=stage,
+                    launch=launch, gather=gather, finalize=finalize,
+                    bisect=bisect)
+
         def chunk_items():
             """Yield this search's LaunchItems in dispatch order.  Runs
             on the dispatching thread: the group-level work between
             yields (program build, AOT future consumption) overlaps the
             already-dispatched launches' device compute."""
             for pi, plan in enumerate(plans):
+                if scan_mode:
+                    # device-resident chunk loop: the whole group rolls
+                    # into scan-segment launches (one, memory allowing)
+                    yield from scan_plan_items(plan)
+                    continue
                 gi, group = plan["gi"], plan["group"]
                 nc_batch = plan["nc_batch"]
                 lanes = nc_batch * n_folds
@@ -2957,59 +3384,9 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 for lo, hi, chunk_id, rec in plan["chunks"]:
                     idx = group.candidate_indices[lo:hi]
                     if rec is not None:
-                        for s_ in scorer_names:
-                            test_scores[s_][idx, :] = np.asarray(
-                                rec["test"][s_])
-                            if return_train:
-                                train_scores[s_][idx, :] = np.asarray(
-                                    rec["train"][s_])
-                        fit_times[idx, :] = rec["fit_t"]
-                        score_times[idx, :] = rec["score_t"]
-                        if rec.get("failed") is not None:
-                            fit_failed[idx, :] |= np.asarray(
-                                rec["failed"], bool)
-                        metrics.counter("n_chunks_resumed").inc()
-                        if pctx is not None:
-                            pctx["done"][idx] = True
+                        replay_chunk(idx, rec)
                         continue
-                    if pctx is not None and pctx["t_deadline"] is not None \
-                            and time.perf_counter() >= pctx["t_deadline"]:
-                        # deadline expired before this chunk launched
-                        elapsed = (time.perf_counter()
-                                   - pctx["t_start"])
-                        if str(getattr(config, "partial_results",
-                                       "raise") or "raise") \
-                                != "best_effort":
-                            raise _faults.SearchDeadlineError(
-                                float(config.search_deadline_s),
-                                elapsed,
-                                n_remaining=int(
-                                    (~pctx["done"]).sum()))
-                        if not pctx["deadline_hit"]:
-                            pctx["deadline_hit"] = True
-                            _telemetry.note_protection("deadline_hit")
-                            logger.warning(
-                                "search deadline %.3gs expired after "
-                                "%.3fs: shedding the remaining chunks "
-                                "to error_score (partial_results="
-                                "'best_effort')",
-                                float(config.search_deadline_s),
-                                elapsed, chunk=chunk_id)
-                        # un-run candidates carry sklearn's error_score
-                        # with ZERO times (like a fit that never ran) —
-                        # declared in the protection block, NOT routed
-                        # through fit_failed
-                        for s_ in scorer_names:
-                            test_scores[s_][idx, :] = errval
-                            if return_train:
-                                train_scores[s_][idx, :] = errval
-                        fit_times[idx, :] = 0.0
-                        score_times[idx, :] = 0.0
-                        pctx["done"][idx] = True
-                        pctx["shed"].append({
-                            "reason": "deadline", "chunk": chunk_id,
-                            "candidates": [int(i) for i in idx]})
-                        _telemetry.note_protection("shed", len(idx))
+                    if shed_chunk(idx, chunk_id):
                         continue
                     live_seen += 1
                     n_real = (hi - lo) * n_folds
@@ -3393,8 +3770,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # distinct traced-program constructions this search (program-
             # cache misses; each is one python->jaxpr->HLO walk whether
             # the compile then ran on the AOT thread or at jit dispatch)
-            pr["n_compiles"] = _program_build_count() - builds0
+            total_builds = _program_build_count() - builds0
+            pr["n_compiles"] = total_builds
             metrics.put("pipeline", pr)
+            metrics.put("chunkloop", chunkloop_block(
+                metrics.struct("chunkloop"), mode=chunk_loop,
+                enabled=scan_mode,
+                score_attribution="folded" if scan_mode
+                else "calibrated"))
             # feed the measured per-launch overhead / per-lane cost back
             # into the geometry planner's cost model: the NEXT search
             # over a new structure prices its widths from real walls
@@ -3404,11 +3787,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # rung's timeline slice — rung k+1's re-plan prices its
             # widths from rung k's measured overhead and lane cost, not
             # from cross-search priors.
+            # n_builds normalizes the compile lane PER PROGRAM: a
+            # scanned group compiles once however many chunks it
+            # serves, and the old per-timeline-median heuristic would
+            # double-count that one compile into every launch's excess
             launches = pr.get("launches") or []
             if rung is not None:
                 new_launches = launches[rung.launches_seen:]
                 rung.launches_seen = len(launches)
-                geometry_cost_model().observe(new_launches)
+                nb = total_builds - int(
+                    getattr(rung, "builds_observed", 0))
+                rung.builds_observed = total_builds
+                geometry_cost_model().observe(new_launches, n_builds=nb)
                 rung_rec = rung.current
                 if rung_rec is not None:
                     rung_rec["n_chunks_resumed"] = int(
@@ -3423,7 +3813,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     # analyzer slices per-rung lanes from
                     rung_rec["launches_end"] = len(launches)
             else:
-                geometry_cost_model().observe(launches)
+                geometry_cost_model().observe(launches,
+                                              n_builds=total_builds)
             # persist the plan cache + cost-model state next to the AOT
             # artifacts: a fresh process then plans the SAME chunk
             # widths — and resolves the same stored programs — without

@@ -121,8 +121,17 @@ class _RungContext(_ownership.LaunchOwner):
         self.last_widths: Dict[Any, int] = {}
         self.planned_total = 0         # cumulative live chunks
         self.launches_seen = 0         # timeline prefix already observed
+        self.builds_observed = 0       # build-count prefix already fed
         self.prev_pipe_wall = 0.0
         self.lanes_reclaimed_total = 0
+        #: device-resident elimination (grid's chunk_loop="scan" path):
+        #: the scheduler announces the NEXT rung's survivor count
+        #: before evaluate_candidates; a rung scanned as one launch
+        #: runs sklearn's _top_k on device and hands the surviving
+        #: candidate indices back here — ascending-mean order, exactly
+        #: _top_k's — so the rung boundary skips the score round-trip
+        self.keep_next = 0
+        self.device_survivors = None
 
     def begin_rung(self, itr: int, n_resources: int,
                    n_candidates: int) -> Dict[str, Any]:
@@ -411,6 +420,12 @@ class BaseSuccessiveHalvingTPU(BaseSearchTPU):
                 }
 
                 rung_rec = rc.begin_rung(itr, n_resources, n_candidates)
+                n_candidates_to_keep = ceil(n_candidates / self.factor)
+                # announced BEFORE the rung runs so a scanned rung
+                # (grid chunk_loop="scan") can fold the elimination
+                # into its one device launch
+                rc.keep_next = n_candidates_to_keep
+                rc.device_survivors = None
                 t_rung0 = time.perf_counter()
                 with tracer.span("halving.rung", iter=itr,
                                  n_candidates=n_candidates,
@@ -420,11 +435,32 @@ class BaseSuccessiveHalvingTPU(BaseSearchTPU):
                 rung_rec["wall_s"] = round(
                     time.perf_counter() - t_rung0, 4)
 
-                n_candidates_to_keep = ceil(n_candidates / self.factor)
-                # sklearn's own top-k (NaN placement and tie order
-                # included) — the surviving set is byte-exact theirs
-                candidate_params = list(
-                    _top_k(results, n_candidates_to_keep, itr))
+                surv = rc.device_survivors
+                rc.device_survivors = None
+                if surv is not None \
+                        and len(surv) == n_candidates_to_keep:
+                    # device-resident elimination: the scanned rung's
+                    # on-device _top_k mirror already picked the
+                    # survivors (positions into THIS rung's candidate
+                    # list, ascending-mean order) — no score
+                    # round-trip between rungs.  For tie-free means
+                    # this is bit-identical to _top_k below; exactly
+                    # tied means may break ties differently (stable
+                    # device sort vs numpy's unstable quicksort) —
+                    # both pick an equally-scoring survivor set, the
+                    # same arbitrariness sklearn itself has
+                    candidate_params = [candidate_params[int(i)]
+                                        for i in surv]
+                else:
+                    # sklearn's own top-k (NaN placement and tie order
+                    # included) — the surviving set is byte-exact
+                    # theirs
+                    candidate_params = list(
+                        _top_k(results, n_candidates_to_keep, itr))
+                    cl = self._search_metrics.data.get("chunkloop")
+                    if cl is not None and cl.get("enabled"):
+                        cl["rung_topk_host"] = int(
+                            cl.get("rung_topk_host", 0)) + 1
         finally:
             pipe = rc.pipeline
             rc.pipeline = None

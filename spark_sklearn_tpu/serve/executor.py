@@ -976,6 +976,10 @@ class SearchExecutor:
                   item: LaunchItem) -> LaunchItem:
         inner_launch = item.launch
         inner_finalize = item.finalize
+        # DRR billing is in task units: a scanned segment (kind="scan",
+        # chunk_loop="scan") carries the SUM of its member chunks' real
+        # lanes in n_tasks, so its one coarse launch debits the tenant
+        # exactly what the per-chunk launches it replaced would have
         cost = max(1, int(item.n_tasks or 0))
         #: first_wait = the dispatch-phase call's queue wait (the
         #: pipeline calls launch exactly once; later calls are
@@ -1033,7 +1037,7 @@ class SearchExecutor:
             key=item.key, launch=routed_launch, stage=item.stage,
             gather=item.gather, finalize=routed_finalize,
             group=item.group, kind=item.kind, n_tasks=item.n_tasks,
-            wait=item.wait, bisect=item.bisect,
+            n_chunks=item.n_chunks, wait=item.wait, bisect=item.bisect,
             host_fallback=item.host_fallback, fuse=item.fuse)
 
     def _try_fastpath(self, handle: SearchHandle, cost: int,
@@ -1178,7 +1182,12 @@ class SearchExecutor:
                     # same-program peer from another search may arrive
                     # and fill its padded lanes.  The head stays at its
                     # queue front (FIFO intact) and dispatches solo
-                    # once the window expires peer-less.
+                    # once the window expires peer-less.  Scanned
+                    # segments (kind="scan") never enter: their
+                    # stacked step axis admits no peer lanes, so
+                    # grid.py yields them with fuse=None (and turns
+                    # cross-search fusion off for the whole search
+                    # when chunk_loop="scan").
                     self._fuse_defer = True
                     continue
                 if t.deficit < head.cost:

@@ -120,6 +120,25 @@ class TestLegsToyShapes:
         assert d["best_params_agree"] is True
         assert d["memory"]["peak_modeled_bytes"] > 0
 
+    def test_chunkloop_scan(self):
+        d = bench.leg_chunkloop(n_rows=242, n_candidates=24, folds=2,
+                                max_iter=10)
+        _assert_finite(d, ["per_chunk_warm_wall_s", "scan_warm_wall_s",
+                           "n_launches_per_chunk", "n_launches_scan",
+                           "scan_launches_per_group",
+                           "launch_collapse_ratio"])
+        # the launch boundary actually melts: the scan arm runs ONE
+        # launch per compile group while the per-chunk arm pays one
+        # per chunk, and the collapse changes nothing numeric
+        assert d["scan_launches_per_group"] == 1.0
+        assert d["n_launches_scan"] == d["n_groups"]
+        assert d["n_launches_per_chunk"] > d["n_launches_scan"]
+        assert d["n_launches_saved"] == \
+            d["n_chunks_scanned"] - d["n_segments"]
+        assert d["scan_fallbacks"] == []
+        assert d["scan_cv_results_identical"] is True
+        assert d["memory"]["peak_modeled_bytes"] > 0
+
     def test_serve_contended(self):
         d = bench.leg_serve_contended(n_rows=96, n_candidates=16,
                                       folds=2, max_iter=5, levels=(2,))

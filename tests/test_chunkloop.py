@@ -1,0 +1,275 @@
+"""Device-resident chunk loop (``TpuConfig(chunk_loop="scan")``).
+
+Contracts under test:
+
+  - **bit-exact parity**: rolling a compile group's chunk loop into
+    the program via ``lax.scan`` changes the launch shape, never the
+    numbers — ``cv_results_`` is exactly equal to the per-chunk path
+    for exhaustive and halving searches at pipeline depths 0 and 2;
+  - **the launch boundary actually melts**: the pipeline timeline
+    records ONE ``kind="scan"`` launch per segment whose ``n_chunks``
+    is the member count, ``n_launches`` collapses to the segment
+    count, and ``search_report["chunkloop"]`` books the savings;
+  - **device-resident rung elimination**: a halving rung's top-k runs
+    inside the scanned program (``chunkloop.scan`` span with
+    ``topk > 0``, ``rung_topk_device`` counted) and the surviving
+    candidate set matches sklearn's host ``_top_k`` on tie-free
+    means;
+  - **fault/resume at scan-segment granularity**: a fatal mid-search
+    leaves completed segments durable (their chunks replay, the
+    interrupted segment re-runs, bit-exact), checkpoints interoperate
+    ACROSS loop modes (chunk ids are loop-mode-invariant), and an
+    injected OOM on a scanned segment falls back to the per-chunk
+    path for that segment only — still exact.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.obs.metrics import CHUNKLOOP_BLOCK_SCHEMA
+from spark_sklearn_tpu.obs.trace import get_tracer
+
+
+def _non_time_results(gs):
+    return {k: v for k, v in gs.cv_results_.items()
+            if "time" not in k and k != "params"}
+
+
+def _assert_exact_equal(ra, rb):
+    assert set(ra) == set(rb)
+    for k in ra:
+        np.testing.assert_array_equal(
+            np.asarray(ra[k]), np.asarray(rb[k]), err_msg=k)
+
+
+#: C-grid sized to several chunks in one compile group at width 8
+_GRID = {"C": np.logspace(-2, 1, 24).tolist()}
+#: adds a static axis -> TWO compile groups, one scan segment each
+_GRID_2G = {"C": np.logspace(-2, 1, 12).tolist(),
+            "fit_intercept": [True, False]}
+
+
+#: explicit cost overrides so planned widths are process-order
+#: independent (the global geometry cost model learns across tests —
+#: different widths mean different reduction shapes, hence 1-ulp
+#: drift between the two runs under comparison)
+_OVR = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3)
+
+
+def _fit_grid(X, y, grid, **cfg_kw):
+    from sklearn.linear_model import LogisticRegression
+    cfg_kw.setdefault("max_tasks_per_batch", 16)
+    cfg_kw.update(_OVR)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.GridSearchCV(
+            LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+            backend="tpu", config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+def _clf_data(n=240, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.25 * rng.randn(n) > 0).astype(np.int64)
+    return X, y
+
+
+def _fit_halving(X, y, grid=None, **cfg_kw):
+    # neg_log_loss: continuous fold means, no exact ties — the regime
+    # where the device top-k mirror is bit-identical to host _top_k
+    # (tied means may break differently: stable device sort vs
+    # numpy's unstable quicksort, see search/halving.py)
+    from sklearn.linear_model import LogisticRegression
+    cfg_kw.setdefault("max_tasks_per_batch", 16)
+    cfg_kw.update(_OVR)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.HalvingGridSearchCV(
+            LogisticRegression(max_iter=10),
+            grid or {"C": np.logspace(-2, 1, 16).tolist()},
+            cv=3, factor=2, random_state=7, backend="tpu",
+            scoring="neg_log_loss",
+            config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+class TestScanParityExhaustive:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_scan_matches_per_chunk_exact(self, digits, depth):
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        per_chunk = _fit_grid(Xs, ys, _GRID, chunk_loop="per_chunk",
+                              pipeline_depth=depth)
+        scan = _fit_grid(Xs, ys, _GRID, chunk_loop="scan",
+                         pipeline_depth=depth)
+        _assert_exact_equal(_non_time_results(per_chunk),
+                            _non_time_results(scan))
+
+        cl = scan.search_report["chunkloop"]
+        assert cl["mode"] == "scan" and cl["enabled"]
+        assert cl["fallbacks"] == []
+        pl = scan.search_report["pipeline"]
+        scan_recs = [r for r in pl["launches"] if r["kind"] == "scan"]
+        # the boundary melted: one launch per segment, each serving
+        # every member chunk — and fewer launches than per-chunk
+        assert len(scan_recs) == cl["n_segments"]
+        assert pl["n_launches"] == cl["n_segments"]
+        assert sum(r["n_chunks"] for r in scan_recs) == \
+            cl["n_chunks_scanned"]
+        assert cl["n_chunks_scanned"] > cl["n_segments"]
+        assert cl["n_launches_saved"] == \
+            cl["n_chunks_scanned"] - cl["n_segments"]
+        assert pl["n_launches"] < \
+            per_chunk.search_report["pipeline"]["n_launches"]
+
+    def test_per_group_names_the_scan_path(self, digits):
+        X, y = digits
+        scan = _fit_grid(X[:240], y[:240], _GRID, chunk_loop="scan")
+        groups = scan.search_report["per_group"]
+        recs = groups.values() if isinstance(groups, dict) else groups
+        assert any(g["score_path"] == "scan-fused" for g in recs)
+
+    def test_report_block_matches_schema(self, digits):
+        X, y = digits
+        scan = _fit_grid(X[:240], y[:240], _GRID, chunk_loop="scan")
+        cl = scan.search_report["chunkloop"]
+        assert set(cl) == {d.name for d in CHUNKLOOP_BLOCK_SCHEMA}
+        # the per-chunk default reports itself too, disabled
+        base = _fit_grid(X[:240], y[:240], _GRID)
+        bl = base.search_report["chunkloop"]
+        assert bl["mode"] == "per_chunk" and not bl["enabled"]
+        assert bl["n_chunks_scanned"] == 0
+
+    def test_env_knob_resolves_scan(self, digits, monkeypatch):
+        monkeypatch.setenv("SST_CHUNK_LOOP", "scan")
+        X, y = digits
+        gs = _fit_grid(X[:240], y[:240], _GRID)
+        assert gs.search_report["chunkloop"]["enabled"]
+        # an explicit config wins over the env
+        monkeypatch.setenv("SST_CHUNK_LOOP", "per_chunk")
+        gs2 = _fit_grid(X[:240], y[:240], _GRID, chunk_loop="scan")
+        assert gs2.search_report["chunkloop"]["enabled"]
+
+
+class TestScanHalving:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_halving_parity_and_device_topk(self, depth):
+        X, y = _clf_data()
+        per_chunk = _fit_halving(X, y, chunk_loop="per_chunk",
+                                 pipeline_depth=depth)
+        tr = get_tracer()
+        was = tr.enabled
+        tr.clear()
+        tr.enable()
+        try:
+            scan = _fit_halving(X, y, chunk_loop="scan",
+                                pipeline_depth=depth)
+            events = tr.events()
+        finally:
+            tr.clear()
+            if not was:
+                tr.disable()
+        _assert_exact_equal(_non_time_results(per_chunk),
+                            _non_time_results(scan))
+        assert per_chunk.best_params_ == scan.best_params_
+
+        # elimination ran on device: the rung's scanned launch carried
+        # a top-k carry (trace pin — no score round-trip decided it)
+        cl = scan.search_report["chunkloop"]
+        assert cl["rung_topk_device"] >= 1, cl
+        topk_spans = [ev for ev in events
+                      if ev[1] == "chunkloop.scan"
+                      and int((ev[6] or {}).get("topk", 0)) > 0]
+        assert len(topk_spans) >= cl["rung_topk_device"]
+        assert any(ev[1] == "chunkloop.segment" for ev in events)
+
+
+class TestScanFaultsAndResume:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_oom_on_segment_falls_back_per_chunk_exact(self, digits,
+                                                       depth):
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        base = _fit_grid(Xs, ys, _GRID, chunk_loop="scan",
+                         pipeline_depth=depth)
+        faulted = _fit_grid(Xs, ys, _GRID, chunk_loop="scan",
+                            pipeline_depth=depth, fault_plan="oom@0",
+                            retry_backoff_s=0.01)
+        f = faulted.search_report["faults"]
+        assert f["bisections"] >= 1, f
+        cl = faulted.search_report["chunkloop"]
+        assert any(fb.startswith("oom-per-chunk:")
+                   for fb in cl["fallbacks"]), cl
+        _assert_exact_equal(_non_time_results(base),
+                            _non_time_results(faulted))
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_kill_mid_segment_resume_exact_grid(self, digits, tmp_path,
+                                                depth):
+        """Two compile groups -> two scan segments: the fatal takes
+        down segment 1 AFTER segment 0's member chunks are durable;
+        the resume replays them and re-runs only the dead segment."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        full = _fit_grid(Xs, ys, _GRID_2G, chunk_loop="scan",
+                         pipeline_depth=depth)
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Exception, match="[Ii]njected"):
+            _fit_grid(Xs, ys, _GRID_2G, chunk_loop="scan",
+                      pipeline_depth=depth, checkpoint_dir=ckpt,
+                      fault_plan="fatal@1")
+        resumed = _fit_grid(Xs, ys, _GRID_2G, chunk_loop="scan",
+                            pipeline_depth=depth, checkpoint_dir=ckpt)
+        rep = resumed.search_report
+        assert rep["n_chunks_resumed"] > 0
+        # the replayed chunks launched nothing: only the interrupted
+        # segment's chunks were re-scanned
+        assert rep["chunkloop"]["n_chunks_scanned"] < \
+            full.search_report["chunkloop"]["n_chunks_scanned"]
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_kill_mid_rung_resume_exact_halving(self, tmp_path, depth):
+        """Each rung runs under a fresh supervisor, so launch indices
+        reset per rung — a two-group grid gives every rung two scan
+        segments, and fatal@1 lands with segment 0's chunks already
+        durable."""
+        grid = {"C": np.logspace(-2, 1, 8).tolist(),
+                "fit_intercept": [True, False]}
+        X, y = _clf_data()
+        full = _fit_halving(X, y, grid, chunk_loop="scan",
+                            pipeline_depth=depth)
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Exception, match="[Ii]njected"):
+            _fit_halving(X, y, grid, chunk_loop="scan",
+                         pipeline_depth=depth, checkpoint_dir=ckpt,
+                         fault_plan="fatal@1")
+        resumed = _fit_halving(X, y, grid, chunk_loop="scan",
+                               pipeline_depth=depth,
+                               checkpoint_dir=ckpt)
+        assert resumed.search_report["n_chunks_resumed"] > 0
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))
+        assert full.best_params_ == resumed.best_params_
+
+    def test_checkpoints_interoperate_across_loop_modes(self, digits,
+                                                        tmp_path):
+        """Chunk ids are loop-mode-invariant: a journal written under
+        per_chunk resumes under scan (and the scores stay exact)."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        full = _fit_grid(Xs, ys, _GRID_2G)
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Exception, match="[Ii]njected"):
+            _fit_grid(Xs, ys, _GRID_2G, checkpoint_dir=ckpt,
+                      fault_plan="fatal@2")
+        resumed = _fit_grid(Xs, ys, _GRID_2G, chunk_loop="scan",
+                            checkpoint_dir=ckpt)
+        rep = resumed.search_report
+        assert rep["n_chunks_resumed"] > 0
+        assert rep["chunkloop"]["enabled"]
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))

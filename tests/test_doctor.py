@@ -682,6 +682,26 @@ class TestBenchTrend:
         assert {f["metric"] for f in cmp_["flags"]} == \
             {"halving_speedup", "store_hit_rate"}
 
+    def test_scan_launches_per_group_regresses_upward(self, tmp_path):
+        from tools.bench_trend import format_table, trend
+
+        # the chunkloop A/B's scan arm holds at one launch per compile
+        # group; segment splitting or per-chunk fallback shows up as
+        # this column creeping up and must trip the gate
+        a = _bench_round(1, 50.0, 60.0)
+        a["parsed"]["detail"]["chunkloop_scan"] = {
+            "scan_launches_per_group": 1.0}
+        b = _bench_round(2, 50.0, 60.0)
+        b["parsed"]["detail"]["chunkloop_scan"] = {
+            "scan_launches_per_group": 3.0}
+        self._write(tmp_path, [a, b])
+        digest = trend(str(tmp_path))
+        cmp_ = digest["comparison"]
+        assert [f["metric"] for f in cmp_["flags"]] == \
+            ["launches_per_group"]
+        assert digest["rows"][-1]["launches_per_group"] == 3.0
+        assert "l/grp" in format_table(digest)
+
     def test_unparsed_rounds_are_skipped(self, tmp_path):
         from tools.bench_trend import trend
 

@@ -323,8 +323,9 @@ class TestPlanPersistence:
         plan = taskgrid.plan_geometry([41], [None], **self._KW)
         state = taskgrid.export_plan_state()
         assert "cost_model" in state and "plans" in state
-        rec = [r for r in state["plans"] if r["key"][0] == [41]
-               and r["key"][6] == 0.0625]
+        rec = [r for r in state["plans"]
+               if r["key"]["sizes"] == [41]
+               and r["key"]["overhead_override"] == 0.0625]
         assert rec, state["plans"]
         json.dumps(state)                        # JSON-able end to end
         key = taskgrid._plan_key_from_json(rec[0]["key"])
@@ -342,7 +343,8 @@ class TestPlanPersistence:
         state = taskgrid.export_plan_state()
         # importing on top of a live cache seeds nothing new and the
         # live plan keeps its provenance (widths never flap mid-process)
-        rec = [r for r in state["plans"] if r["key"][0] == [43]]
+        rec = [r for r in state["plans"]
+               if r["key"]["sizes"] == [43]]
         assert taskgrid.import_plan_state({"plans": rec}) == 0
         again = taskgrid.plan_geometry([43], [None], **self._KW)
         assert again.source in ("computed", "plan-cache")
@@ -353,6 +355,34 @@ class TestPlanPersistence:
         assert taskgrid.import_plan_state(
             {"plans": [{"key": [1, 2], "plan": {}}, {"bogus": 1}],
              "cost_model": {"bad": "state"}}) == 0
+
+    def test_legacy_positional_keys_still_import(self):
+        """Pre-PlanKey processes persisted positional key lists (8, 10
+        and 11 elements across three vintages): the one back-compat
+        decoder maps every vintage onto the named struct with the
+        documented defaults."""
+        k8 = taskgrid._plan_key_from_json(
+            [[41], [None], 2, 8, 64, "auto", 0.0625, 0.0017])
+        assert isinstance(k8, taskgrid.PlanKey)
+        assert k8.min_width == 0 and k8.width_caps == (None,)
+        assert k8.fusion_lane_discount == 0.0
+        assert k8.chunk_loop == "per_chunk"
+        k11 = taskgrid._plan_key_from_json(
+            [[41], [None], 2, 8, 64, "auto", 0.0625, 0.0017, 8,
+             [16], 0.5])
+        assert k11.min_width == 8 and k11.width_caps == (16,)
+        assert k11.fusion_lane_discount == 0.5
+        # the named form round-trips through JSON to an EQUAL key
+        named = taskgrid._plan_key_from_json(
+            json.loads(json.dumps(taskgrid._plan_key_to_json(k11))))
+        assert named == k11
+        # a legacy import still serves a current-process lookup: seed
+        # under the legacy-decoded key, then plan the same structure
+        plan = taskgrid.plan_geometry([41], [None], **self._KW)
+        with taskgrid._PLAN_CACHE_LOCK:
+            assert taskgrid._PLAN_CACHE.get(k8) is not None, \
+                "legacy-decoded key must alias the live PlanKey"
+        assert plan.widths()
 
     def test_cost_model_adoption_more_observations_wins(self):
         m = taskgrid.GeometryCostModel()

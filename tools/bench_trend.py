@@ -57,6 +57,11 @@ _WATCHED = (
     # streamed h2d volume at the leg's fixed shape: growth means the
     # stream tier re-uploads or pads more than its plan claims
     ("stream_h2d_bytes", "up"),
+    # scan-arm launches per compile group in the chunkloop A/B: the
+    # device-resident loop's whole point is ONE launch per group, so
+    # this sits at 1.0 and any creep up means segments are splitting
+    # (budget miscounts) or segments are falling back per-chunk
+    ("launches_per_group", "up"),
 )
 
 
@@ -93,6 +98,7 @@ def _round_row(path: str) -> Dict[str, Any]:
         if serve[key].get("searches_per_min") is not None:
             spm = serve[key]["searches_per_min"]
     ss = det.get("stream_sparse") or {}
+    cl = det.get("chunkloop_scan") or {}
     return {
         "round": n,
         "rc": payload.get("rc"),
@@ -105,6 +111,7 @@ def _round_row(path: str) -> Dict[str, Any]:
         "sparse_h2d_ratio": ss.get("sparse_over_dense_h2d"),
         "stream_h2d_bytes": ss.get("stream_block_h2d_bytes"),
         "stream_shards": ss.get("stream_n_shards"),
+        "launches_per_group": cl.get("scan_launches_per_group"),
         "parsed": bool(det),
     }
 
@@ -178,7 +185,7 @@ def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
            f"{'halving x':>10} {'hit rate':>9} {'shed':>6} "
            f"{'srch/min':>9} {'sp/dn h2d':>10} {'strm h2d':>9} "
-           f"{'shards':>7}"]
+           f"{'shards':>7} {'l/grp':>6}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
@@ -189,7 +196,8 @@ def format_table(digest: Dict[str, Any]) -> str:
             f"{_fmt(r.get('serve_spm')):>9} "
             f"{_fmt(r.get('sparse_h2d_ratio'), 4):>10} "
             f"{_fmt(r.get('stream_h2d_bytes'), 0):>9} "
-            f"{_fmt(r.get('stream_shards'), 0):>7}"
+            f"{_fmt(r.get('stream_shards'), 0):>7} "
+            f"{_fmt(r.get('launches_per_group')):>6}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

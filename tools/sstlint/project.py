@@ -261,6 +261,10 @@ class Project:
                     Producer("dict-keys", "parallel/faults.py",
                              "protection_block"),
                 )),
+                BlockSpec("chunkloop", "CHUNKLOOP_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "search/grid.py",
+                             "chunkloop_block"),
+                )),
             ),
             launch_paths=(
                 "parallel/faults.py",
