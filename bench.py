@@ -1194,7 +1194,7 @@ def leg_chunkloop(cache_dir=None, n_rows=484, n_candidates=48,
     y = y[:n_rows]
     grid = {"C": np.logspace(-4, 3, n_candidates).tolist()}
 
-    def timed(mode):
+    def timed(mode, heartbeat=False):
         def mk():
             # small task batches force several chunks per compile
             # group, so the per-chunk arm's launch count is the
@@ -1209,6 +1209,7 @@ def leg_chunkloop(cache_dir=None, n_rows=484, n_candidates=48,
                 refit=False, backend="tpu",
                 config=sst.TpuConfig(
                     compilation_cache_dir=cache_dir, chunk_loop=mode,
+                    heartbeat=heartbeat,
                     max_tasks_per_batch=tasks_per_batch,
                     geometry_overhead_s=0.01,
                     geometry_lane_cost_s=1e-3))
@@ -1219,6 +1220,14 @@ def leg_chunkloop(cache_dir=None, n_rows=484, n_candidates=48,
 
     pc, wall_pc = timed("per_chunk")
     sc, wall_sc = timed("scan")
+    # heartbeat A/B (ISSUE 17): the same scanned grid with the
+    # in-flight beacon on — the beacon-bearing program compiles
+    # separately (its presence joins the cache key), the wall delta
+    # and the hub's own measured host fraction are the overhead the
+    # <2% contract bounds, and the beat cadence is the watchdog's
+    # operating signal
+    hb, wall_hb = timed("scan", heartbeat=True)
+    hb_blk = hb.search_report.get("heartbeat", {})
     blk = sc.search_report["chunkloop"]
     n_groups = max(1, len(sc.search_report.get("per_group", {})))
     n_l_pc = int(pc.search_report.get("n_launches", 0))
@@ -1246,6 +1255,13 @@ def leg_chunkloop(cache_dir=None, n_rows=484, n_candidates=48,
         "n_launches_saved": blk["n_launches_saved"],
         "scan_fallbacks": list(blk["fallbacks"]),
         "scan_cv_results_identical": bool(parity),
+        "heartbeat_warm_wall_s": wall_hb,
+        "hb_wall_delta_frac": round(
+            (wall_hb - wall_sc) / wall_sc, 4) if wall_sc else 0.0,
+        "hb_overhead_frac": hb_blk.get("overhead_frac", 0.0),
+        "hb_beats": hb_blk.get("beats_total", 0),
+        "hb_cadence_p50_s": hb_blk.get("cadence_p50_s", 0.0),
+        "hb_cadence_p95_s": hb_blk.get("cadence_p95_s", 0.0),
         "memory": _memory_summary(sc.search_report),
     }
 

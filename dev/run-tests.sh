@@ -33,7 +33,7 @@ SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_faults.py tests/test_serve.py tests/test_telemetry.py \
     tests/test_halving.py tests/test_memory.py tests/test_sstlint.py \
     tests/test_doctor.py tests/test_protection.py \
-    tests/test_fusion.py -q
+    tests/test_fusion.py tests/test_heartbeat.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)
@@ -474,6 +474,81 @@ print("chunk-loop smoke:",
        "n_launches_saved": blk["n_launches_saved"],
        "launches": {"per_chunk": pc.search_report["n_launches"],
                     "scan": sc.search_report["n_launches"]}})
+PY
+
+echo "== heartbeat smoke (in-flight beats, watchdog stall, off-parity) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.linear_model import LogisticRegression
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.obs import heartbeat
+from spark_sklearn_tpu.parallel.faults import LaunchTimeoutError
+
+rng = np.random.RandomState(0)
+X = rng.randn(160, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.int64)
+grid = {"C": np.logspace(-2, 1, 24).tolist()}
+geo = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3,
+           max_tasks_per_batch=16, chunk_loop="scan")
+
+
+def run(**kw):
+    return sst.GridSearchCV(
+        LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+        backend="tpu", config=sst.TpuConfig(**geo, **kw)).fit(X, y)
+
+
+# beats flowed while the scan was in flight: every step beat exactly
+# once and intra-segment progress advanced monotonically to total
+samples = []
+orig_beat = heartbeat.HeartbeatHub.beat
+
+
+def spying_beat(hub, token, step):
+    orig_beat(hub, token, step)
+    st = hub._scope_stats(None)
+    samples.append(st["steps_done"])
+
+
+heartbeat.HeartbeatHub.beat = spying_beat
+try:
+    on = run(heartbeat=True)
+finally:
+    heartbeat.HeartbeatHub.beat = orig_beat
+hb = on.search_report["heartbeat"]
+assert hb["enabled"] and hb["beats_total"] == hb["steps_total"] == \
+    hb["steps_done"] > 1, hb
+assert samples == sorted(samples) and len(samples) == hb["beats_total"]
+assert hb["overhead_frac"] < 0.02, hb
+
+# an injected mid-scan stall (beats capped at step 1) trips the
+# heartbeat watchdog, which names the dead step
+heartbeat.get_hub().reset()
+try:
+    run(heartbeat=True, heartbeat_timeout_s=0.4, fault_plan="hung@0:1")
+    raise SystemExit("heartbeat watchdog did not fire")
+except LaunchTimeoutError as exc:
+    assert exc.mode == "heartbeat" and exc.last_step == 1, exc
+    assert "last beat at scan step 1 of" in str(exc), exc
+
+# heartbeat off is an exact no-op: no report block, no hub traffic,
+# byte-identical numbers
+heartbeat.get_hub().reset()
+off = run()
+assert "heartbeat" not in off.search_report
+assert heartbeat.get_hub().stats()["beats_total"] == 0
+for k in off.cv_results_:
+    if "time" in k or k == "params":
+        continue
+    np.testing.assert_array_equal(np.asarray(off.cv_results_[k]),
+                                  np.asarray(on.cv_results_[k]),
+                                  err_msg=k)
+print("heartbeat smoke:",
+      {"beats": hb["beats_total"], "steps": hb["steps_total"],
+       "cadence_p50_ms": round(1e3 * hb["cadence_p50_s"], 3),
+       "overhead_frac": hb["overhead_frac"]})
 PY
 
 echo "== device-memory smoke (HBM width ceiling + ledger flight bundle) =="

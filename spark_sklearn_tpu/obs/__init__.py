@@ -27,7 +27,13 @@ problem of MPMD pipeline schedulers (arXiv:2412.14374).  Four pieces:
     (``TpuConfig(telemetry_port)`` / ``SST_TELEMETRY_PORT``), and an
     always-on flight recorder that dumps a correlated black-box bundle
     to ``SST_FLIGHT_DIR`` on FATAL faults, watchdog timeouts, OOMs,
-    cancellations and store quarantines.
+    cancellations and store quarantines;
+  - ``obs.heartbeat`` — in-flight device telemetry for the scanned
+    chunk loop: a ``jax.debug.callback`` beacon in the scan step body
+    feeds a process-global ``HeartbeatHub`` (live progress/ETA, the
+    heartbeat-aware watchdog, the ``search_report["heartbeat"]``
+    block), enabled with ``TpuConfig(heartbeat=True)`` /
+    ``SST_HEARTBEAT`` — off is an exact no-op.
 
 Enable tracing per search with ``TpuConfig(trace=True)`` (record only)
 or ``TpuConfig(trace="out.json")`` (record + export), or process-wide

@@ -37,6 +37,7 @@ _SORT_HINTS = (
     ("device", 10),
     ("launches", 11),
     ("compile-groups", 12),
+    ("progress", 13),
 )
 
 

@@ -318,6 +318,47 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
     ln.add("sst_flight_dumps_total", flight.get("n_dumps"),
            mtype="counter",
            help_text="Black-box bundles dumped.")
+    hb = snap.get("heartbeat") or {}
+    ln.add("sst_heartbeat_beats_total", hb.get("beats_total"),
+           mtype="counter",
+           help_text="In-flight device beats received from scanned "
+                     "launches (one per scan step).")
+    ln.add("sst_heartbeat_chunk_beats_total",
+           hb.get("chunk_beats_total"), mtype="counter",
+           help_text="Dispatch-time beats from the per-chunk launch "
+                     "path.")
+    ln.add("sst_heartbeat_segments_total", hb.get("segments_total"),
+           mtype="counter",
+           help_text="Scan segments registered with the heartbeat "
+                     "hub.")
+    ln.add("sst_heartbeat_live_segments", hb.get("live_segments"),
+           help_text="Scanned launches currently in flight and "
+                     "beating.")
+    ln.add("sst_heartbeat_cadence_seconds",
+           hb.get("cadence_p50_s"), labels={"quantile": "0.5"},
+           help_text="Inter-beat gap quantiles across segments.")
+    ln.add("sst_heartbeat_cadence_seconds",
+           hb.get("cadence_p95_s"), labels={"quantile": "0.95"})
+    ln.add("sst_heartbeat_staleness_max_seconds",
+           hb.get("staleness_max_s"),
+           help_text="Largest inter-beat gap observed — what "
+                     "heartbeat_timeout_s must exceed.")
+    for handle, pr in sorted((hb.get("searches") or {}).items()):
+        if not isinstance(pr, dict):
+            continue
+        lbl = {"handle": str(handle)}
+        ln.add("sst_heartbeat_steps_done", pr.get("steps_done"),
+               labels=lbl,
+               help_text="Scan steps confirmed done per live search "
+                         "handle.")
+        ln.add("sst_heartbeat_steps_total", pr.get("steps_total"),
+               labels=lbl,
+               help_text="Scan steps planned per live search handle.")
+        ln.add("sst_heartbeat_eta_seconds", pr.get("eta_s"),
+               labels=lbl,
+               help_text="Blended remaining-time estimate per live "
+                         "search handle (geometry model prior + "
+                         "observed beat cadence).")
     return ln.text()
 
 

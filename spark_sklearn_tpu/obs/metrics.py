@@ -39,6 +39,7 @@ __all__ = [
     "STREAMING_BLOCK_SCHEMA",
     "ATTRIBUTION_BLOCK_SCHEMA",
     "PROTECTION_BLOCK_SCHEMA",
+    "HEARTBEAT_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
@@ -209,6 +210,14 @@ SEARCH_REPORT_SCHEMA = (
         "partial_results='raise', admission_mode='static') — the "
         "byte-identical pre-protection report shape.",
         backends="tpu,host"),
+    MetricDef(
+        "heartbeat", "struct",
+        "The in-flight heartbeat view for this search (see the "
+        "heartbeat-block schema below): beats and steps observed, "
+        "inter-beat cadence percentiles, staleness and the host-side "
+        "overhead estimate (obs/heartbeat.py).  Absent when the "
+        "heartbeat is off (TpuConfig.heartbeat / SST_HEARTBEAT "
+        "unset) — the byte-identical beacon-less report shape."),
     MetricDef(
         "n_tasks", "gauge",
         "Host tier: number of (candidate, fold) fit-and-score tasks.",
@@ -854,6 +863,50 @@ PROTECTION_BLOCK_SCHEMA = (
 )
 
 
+#: pinned keys of ``search_report["heartbeat"]`` — rendered by
+#: ``obs.heartbeat.heartbeat_block`` only when the in-flight heartbeat
+#: resolved on (``TpuConfig.heartbeat`` / ``SST_HEARTBEAT``); off, the
+#: report stays byte-identical to the beacon-less shape.
+HEARTBEAT_BLOCK_SCHEMA = (
+    MetricDef("enabled", "label",
+              "Always True when present: the block only renders when "
+              "the heartbeat beacon is on."),
+    MetricDef("beats_total", "counter",
+              "Device beats received for this search's scanned "
+              "segments (one jax.debug.callback firing per scan "
+              "step)."),
+    MetricDef("chunk_beats_total", "counter",
+              "Cheap dispatch-time beats from the per-chunk launch "
+              "path (parallel/pipeline.py note_chunk) — process-wide "
+              "while the search ran."),
+    MetricDef("n_segments", "counter",
+              "Scan segments registered under this search's scope "
+              "(live + completed)."),
+    MetricDef("steps_total", "gauge",
+              "Scan steps planned across the search's segments."),
+    MetricDef("steps_done", "gauge",
+              "Scan steps confirmed done — beats observed plus the "
+              "completion clamp, so a finished search always reports "
+              "steps_done == steps_total."),
+    MetricDef("cadence_p50_s", "gauge",
+              "Median inter-beat gap (seconds) across the search's "
+              "segments — the observed per-step cost the ETA blend "
+              "weighs against the geometry model's prior."),
+    MetricDef("cadence_p95_s", "gauge",
+              "95th-percentile inter-beat gap (seconds)."),
+    MetricDef("staleness_max_s", "gauge",
+              "Largest inter-beat gap observed (seconds) — what the "
+              "heartbeat watchdog's timeout must exceed to avoid "
+              "false HUNG verdicts."),
+    MetricDef("overhead_est_s", "gauge",
+              "Host seconds spent inside the beat callback for this "
+              "search (locked hub update + tracer instant)."),
+    MetricDef("overhead_frac", "gauge",
+              "overhead_est_s over the segments' summed wall — the "
+              "<2% contract tests/test_heartbeat.py enforces."),
+)
+
+
 #: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
 #: telemetry service's JSON view (``obs/telemetry.py``), also served
 #: as ``/snapshot.json`` (and rendered to Prometheus text) by the
@@ -924,6 +977,12 @@ TELEMETRY_SNAPSHOT_SCHEMA = (
     MetricDef("flight", "struct",
               "Flight-recorder state: records seen, ring occupancy, "
               "black-box bundles dumped."),
+    MetricDef("heartbeat", "struct",
+              "In-flight heartbeat totals (beats, chunk beats, "
+              "segments, cadence/staleness) plus every live search "
+              "handle's steps_done/steps_total progress and blended "
+              "ETA — also rendered as the sst_heartbeat_* Prometheus "
+              "family and tools/fleet_top.py's progress column."),
 )
 
 
@@ -1184,6 +1243,14 @@ def schema_markdown() -> str:
         "`admission_mode`; `parallel/faults.py`).\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in PROTECTION_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"heartbeat\"]` block\n")
+    out.append(
+        "\nPresent when the in-flight heartbeat beacon is on "
+        "(`TpuConfig.heartbeat` / `SST_HEARTBEAT`; "
+        "`obs/heartbeat.py`).\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in HEARTBEAT_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
                "endpoint schema\n")

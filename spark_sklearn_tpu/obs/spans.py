@@ -185,6 +185,12 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "modeled_bytes and whether the HBM ceiling capped the "
             "width) — trace_summary digests these into the per-group "
             "memory line."),
+    # obs/heartbeat.py
+    SpanDef("heartbeat.beat", "instant", "obs.heartbeat",
+            "One in-flight device beat from the scanned program's "
+            "step body (jax.debug.callback; carries key, group, "
+            "step) — only recorded when the heartbeat beacon is on "
+            "(TpuConfig.heartbeat / SST_HEARTBEAT)."),
     # utils/session.py
     SpanDef("session.init", "span", "utils.session",
             "TpuSession bootstrap (mesh, caches, fault plan)."),
@@ -198,6 +204,11 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
     SpanDef("compile-group", "async", "parallel.pipeline",
             "Compile-group boundary span on the `compile-groups` "
             "track."),
+    SpanDef("heartbeat.segment", "async", "obs.heartbeat",
+            "One scan segment's register..complete lifetime on the "
+            "`progress` track (carries group, steps, beats) — the "
+            "per-segment progress lane the Chrome export lays the "
+            "heartbeat.beat instants over."),
 )
 
 #: async-span name prefixes, longest first so `compile-group 3` never
@@ -207,7 +218,8 @@ ASYNC_PREFIXES: Tuple[str, ...] = tuple(sorted(
     key=len, reverse=True))
 
 #: virtual track names the exporter lays spans out on.
-KNOWN_TRACKS: Tuple[str, ...] = ("device", "launches", "compile-groups")
+KNOWN_TRACKS: Tuple[str, ...] = ("device", "launches", "compile-groups",
+                                 "progress")
 
 
 def known_span_names() -> frozenset:

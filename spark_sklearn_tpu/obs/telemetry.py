@@ -902,6 +902,10 @@ class TelemetryService:
         the same dict backs the endpoint's ``/snapshot.json`` and the
         Prometheus rendering (``obs.fleet.prometheus_text``)."""
         now = time.perf_counter()
+        # the heartbeat hub owns its own named lock — render its block
+        # BEFORE taking ours (no cross-module lock nesting)
+        from spark_sklearn_tpu.obs import heartbeat as _heartbeat
+        hb_block = _heartbeat.snapshot_block()
         with self._lock:
             return {
                 "enabled": self.enabled,
@@ -920,6 +924,7 @@ class TelemetryService:
                 "protection": self._protection_block(),
                 "fusion": self._fusion_block(),
                 "flight": _FLIGHT.stats(),
+                "heartbeat": hb_block,
             }
 
 

@@ -150,23 +150,40 @@ class InjectedFault(RuntimeError):
 
 
 class LaunchTimeoutError(TimeoutError):
-    """A launch exceeded ``TpuConfig.launch_timeout_s``.  Names the
-    chunk and compile group; never silently re-run on the host (a hung
-    device would only hang the host re-run's next compiled search)."""
+    """A launch exceeded its watchdog budget.  ``mode="wall"`` is the
+    classic whole-launch ``TpuConfig.launch_timeout_s`` expiry;
+    ``mode="heartbeat"`` means a scanned launch's in-flight beats
+    (``obs/heartbeat.py``) went silent for ``heartbeat_timeout_s`` —
+    the error then names the last scan step that beat, so a postmortem
+    knows WHERE inside the multi-minute launch the device died.  Never
+    silently re-run on the host (a hung device would only hang the
+    host re-run's next compiled search)."""
 
     #: consumed by grid._dispatch: no compiled->host fallback
     _sst_no_fallback = True
 
     def __init__(self, key: str, group: int, timeout_s: float,
-                 injected: bool = False):
-        super().__init__(
-            f"launch {key!r} (compile group {group}) exceeded "
-            f"launch_timeout_s={timeout_s}s"
-            + (" [injected]" if injected else ""))
+                 injected: bool = False, mode: str = "wall",
+                 last_step: Optional[int] = None,
+                 steps_total: Optional[int] = None):
+        if mode == "heartbeat":
+            at = (f"last beat at scan step {last_step}"
+                  if last_step is not None
+                  else "no beat ever arrived")
+            msg = (f"launch {key!r} (compile group {group}) heartbeat "
+                   f"went silent for heartbeat_timeout_s={timeout_s}s "
+                   f"({at} of {steps_total} step(s))")
+        else:
+            msg = (f"launch {key!r} (compile group {group}) exceeded "
+                   f"launch_timeout_s={timeout_s}s")
+        super().__init__(msg + (" [injected]" if injected else ""))
         self.key = key
         self.group = group
         self.timeout_s = timeout_s
         self.injected = injected
+        self.mode = mode
+        self.last_step = last_step
+        self.steps_total = steps_total
 
 
 class SearchDeadlineError(RuntimeError):
@@ -241,8 +258,12 @@ def is_oom(exc: BaseException) -> bool:
 class FaultSpec:
     """Inject `fault_class` at launch `index` for its first `count`
     attempts (count=1: the launch fails once and the first retry
-    succeeds).  ``factor`` only applies to the ``slow`` brownout class:
-    absolute seconds the launch is stalled before running."""
+    succeeds).  ``factor`` carries the class's scalar knob: for the
+    ``slow`` brownout class, absolute seconds the launch is stalled
+    before running; for ``hung`` under the heartbeat watchdog
+    (``heartbeat_timeout_s`` set and the launch is a live scanned
+    segment), the scan STEP after which beats go silent — the drill
+    the watchdog must catch naming that step (``hung@IDX:STEP``)."""
 
     index: int
     fault_class: str
@@ -427,6 +448,17 @@ class LaunchSupervisor:
         self.retry_jitter_frac = float(
             getattr(config, "retry_jitter_frac", 0.25) or 0.0)
         self.launch_timeout_s = getattr(config, "launch_timeout_s", None)
+        #: heartbeat-aware watchdog (obs/heartbeat.py): a scanned
+        #: launch with a live hub segment is declared HUNG when its
+        #: beats go silent this long — launches without one (per-chunk
+        #: path, heartbeat off) keep the wall-clock semantics above
+        self.heartbeat_timeout_s = getattr(
+            config, "heartbeat_timeout_s", None)
+        #: keys whose hung injection capped the beat stream instead of
+        #: raising at launch: wait_ready treats them as wedged even
+        #: though the drill's device work completes (guarded by
+        #: self._lock)
+        self._hb_stall_keys: set = set()
         self.plan = FaultPlan.resolve(config)
         self.verbose = int(verbose)
         self._ckpt = ckpt
@@ -498,9 +530,21 @@ class LaunchSupervisor:
         except Exception:
             return {}
 
+    def _hb_extra(self, exc: Optional[BaseException]) -> Dict[str, Any]:
+        """The heartbeat watchdog's forensics for a HUNG verdict: which
+        scan step last beat before the silence — stamped onto the fault
+        event and the flight bundle so a postmortem names the step."""
+        if not isinstance(exc, LaunchTimeoutError) or \
+                exc.mode != "heartbeat":
+            return {}
+        return {"watchdog_mode": exc.mode,
+                "last_step": exc.last_step,
+                "steps_total": exc.steps_total}
+
     def _record_event(self, key: str, group: int, cls: str, action: str,
                       exc: Optional[BaseException], attempt: int) -> None:
         mem = self._mem_extra(key, group) if cls == OOM else {}
+        hb = self._hb_extra(exc) if cls == HUNG else {}
         with self._lock:
             by = self.faults["by_class"]
             by[cls] = by.get(cls, 0) + 1
@@ -511,7 +555,7 @@ class LaunchSupervisor:
                     "action": action, "attempt": attempt,
                     "error": (f"{type(exc).__name__}: {exc}"[:200]
                               if exc is not None else ""),
-                    **mem})
+                    **mem, **hb})
         if self._ckpt is not None:
             # durable fault journal: a resume after a failed recovery
             # still knows which chunk was in trouble (and the completed
@@ -569,13 +613,14 @@ class LaunchSupervisor:
         with self._lock:
             faults_copy = copy.deepcopy(self.faults)
         mem = self._mem_extra(key, group) if cls == OOM else {}
+        hb = self._hb_extra(exc) if cls == HUNG else {}
         _telemetry.flight_recorder().dump(
             reason, config=self._config, faults=faults_copy,
             context={"key": key, "group": group, "class": cls,
                      "action": action, "attempt": attempt,
                      "error": (f"{type(exc).__name__}: {exc}"[:300]
                                if exc is not None else ""),
-                     **mem})
+                     **mem, **hb})
 
     def record_bisection(self, key: str, group: int,
                          fault_class: str = OOM) -> None:
@@ -663,6 +708,17 @@ class LaunchSupervisor:
                 time.sleep(spec.factor)
             return
         if spec.fault_class == HUNG:
+            if self.heartbeat_timeout_s:
+                # heartbeat-mode stall drill: instead of failing at
+                # launch, silence the beat stream after step FACTOR on
+                # the live scanned segment — the heartbeat watchdog in
+                # wait_ready must detect the silence and name the step
+                from spark_sklearn_tpu.obs import heartbeat as _hb
+                if _hb.get_hub().cap_beats(item.key,
+                                           int(spec.factor)):
+                    with self._lock:
+                        self._hb_stall_keys.add(item.key)
+                    return
             raise LaunchTimeoutError(
                 item.key, item.group, float(self.launch_timeout_s or 0.0),
                 injected=True)
@@ -693,7 +749,14 @@ class LaunchSupervisor:
 
     # -- watchdog --------------------------------------------------------
     def wait_ready(self, out, key: str = "", group: int = 0):
-        """``jax.block_until_ready`` bounded by ``launch_timeout_s``.
+        """``jax.block_until_ready`` bounded by the watchdog budget.
+
+        Two modes: the classic whole-launch ``launch_timeout_s`` wall
+        clock, and — when ``heartbeat_timeout_s`` is set AND the hub
+        owns a live scanned segment for ``key`` — a heartbeat poll
+        that declares the launch HUNG when in-flight beats go silent,
+        naming the last scan step that beat (a scanned rung can
+        legitimately run for many minutes; its beats must not).
 
         The blocking wait runs on a disposable daemon thread; on
         timeout the search fails with :class:`LaunchTimeoutError`
@@ -702,7 +765,14 @@ class LaunchSupervisor:
         of a gather thread hung forever."""
         if isinstance(out, _Recovered):
             return out
-        if not self.launch_timeout_s:
+        hub = None
+        hb_timeout = float(self.heartbeat_timeout_s or 0.0)
+        if hb_timeout > 0.0 and key:
+            from spark_sklearn_tpu.obs import heartbeat as _hb
+            h = _hb.get_hub()
+            if h.live_segment(key):
+                hub = h
+        if not self.launch_timeout_s and hub is None:
             return _block_until_ready(out)
         box: Dict[str, Any] = {}
         done = threading.Event()
@@ -721,9 +791,37 @@ class LaunchSupervisor:
 
         threading.Thread(target=blocker, daemon=True,
                          name="sst-watchdog-wait").start()
-        if not done.wait(float(self.launch_timeout_s)):
-            raise LaunchTimeoutError(key, group,
-                                     float(self.launch_timeout_s))
+        if hub is None:
+            if not done.wait(float(self.launch_timeout_s)):
+                raise LaunchTimeoutError(key, group,
+                                         float(self.launch_timeout_s))
+        else:
+            with self._lock:
+                stalled = key in self._hb_stall_keys
+            t0 = time.perf_counter()
+            poll = max(0.005, min(hb_timeout / 4.0, 0.25))
+            while True:
+                if done.is_set():
+                    # an injected stall's drill work completes; the
+                    # watchdog must still see the silence, so keep
+                    # polling staleness instead of returning
+                    finished = True
+                    time.sleep(poll)
+                else:
+                    finished = done.wait(poll)
+                st = hub.staleness(key)
+                if finished and (not stalled or st is None):
+                    break
+                if st is not None and st["age_s"] >= hb_timeout:
+                    raise LaunchTimeoutError(
+                        key, group, hb_timeout, injected=stalled,
+                        mode="heartbeat", last_step=st["last_step"],
+                        steps_total=st["n_steps"])
+                if self.launch_timeout_s and \
+                        time.perf_counter() - t0 \
+                        > float(self.launch_timeout_s):
+                    raise LaunchTimeoutError(
+                        key, group, float(self.launch_timeout_s))
         if "exc" in box:
             raise box["exc"]
         return box["out"]

@@ -153,6 +153,15 @@ class TpuConfig:
     # hanging the gather thread forever.  None/0 disables the watchdog
     # (no wait threads are spawned).
     launch_timeout_s: Optional[float] = None
+    # heartbeat-aware watchdog (requires heartbeat=True below): a
+    # SCANNED launch whose in-flight beats stop arriving for this many
+    # seconds is declared HUNG with the last-beat step index stamped
+    # into the LaunchTimeoutError, the fault event and the flight
+    # bundle — intra-launch liveness instead of a whole-segment
+    # wall-clock budget.  Launches with no live heartbeat segment
+    # (per-chunk items, heartbeat off) keep the launch_timeout_s
+    # behavior unchanged.  None/0 disables the heartbeat mode.
+    heartbeat_timeout_s: Optional[float] = None
     # deterministic fault injection for tests/drills: "transient@3,oom@5"
     # style spec (see faults.FaultPlan).  None defers to SST_FAULT_PLAN.
     fault_plan: Any = None
@@ -283,6 +292,17 @@ class TpuConfig:
     # disables dumping (the bounded in-memory event ring still
     # records).
     flight_dir: Optional[str] = None
+    # in-flight device heartbeats (obs/heartbeat.py): thread a
+    # jax.debug.callback beacon into the scanned chunk loop's step body
+    # (and a cheap host-side beat into per-chunk dispatches) so
+    # SearchFuture.progress() reports intra-segment steps_done/ETA,
+    # the heartbeat_timeout_s watchdog sees liveness per scan step,
+    # and search_report grows a "heartbeat" block.  Off (the default)
+    # is an exact no-op: no callback is traced into the program — its
+    # presence joins the program cache key, so on/off never alias —
+    # and cv_results_/search_report stay byte-identical.  None defers
+    # to SST_HEARTBEAT.
+    heartbeat: Optional[bool] = None
     # ---- search doctor (obs/attribution.py + obs/runlog.py) ----
     # critical-path attribution: decompose each search's measured wall
     # into pinned cause lanes (compile/stage/compute/gather/queue

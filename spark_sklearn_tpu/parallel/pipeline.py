@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from spark_sklearn_tpu.obs import heartbeat as _heartbeat
 from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import (
@@ -353,9 +354,15 @@ class ChunkPipeline:
     depth 1, deeper lookahead beyond.
     """
 
-    def __init__(self, depth: int = 2, verbose: int = 0):
+    def __init__(self, depth: int = 2, verbose: int = 0,
+                 heartbeat: bool = False):
         self.depth = max(0, int(depth))
         self.verbose = int(verbose)
+        # in-flight heartbeats (obs/heartbeat.py): per-chunk launches
+        # emit a cheap dispatch-time beat when the constructing search
+        # resolved heartbeat on (scan segments beacon from the device
+        # instead); False keeps the exact-no-op default
+        self.heartbeat = bool(heartbeat)
         self.timeline: List[Dict[str, Any]] = []
         self._wall_t0: Optional[float] = None
         # the run epoch: the FIRST run()'s start, stable across rung
@@ -574,6 +581,8 @@ class ChunkPipeline:
             with tr.span("dispatch", key=item.key, kind=item.kind,
                          group=item.group):
                 out = item.launch(staged)
+            if self.heartbeat and item.kind != "scan":
+                _heartbeat.note_chunk(item.key, item.group)
             t2 = time.perf_counter()
             tm.dispatch_s = t2 - t1
             with tr.span("compute.wait", key=item.key):
@@ -685,6 +694,8 @@ class ChunkPipeline:
                 with tr.span("dispatch", key=item.key, kind=item.kind,
                              group=item.group):
                     out = item.launch(payload)
+                if self.heartbeat and item.kind != "scan":
+                    _heartbeat.note_chunk(item.key, item.group)
                 t2 = time.perf_counter()
                 tm.dispatch_s = t2 - t1
                 inflight.append(

@@ -1403,6 +1403,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             if rung.mem_before is None:
                 rung.mem_before = mem_before
             mem_before = rung.mem_before
+        # in-flight heartbeats (obs/heartbeat.py): allocate ONE hub
+        # scope per fit (halving rungs share it) so the report block
+        # aggregates exactly this search's segments — cid_ns is empty
+        # for plain fits and cannot key the hub.  Off is an exact
+        # no-op: no ctx, no block, no beacon traced.
+        from spark_sklearn_tpu.obs import heartbeat as _heartbeat
+        _hb_enabled = _heartbeat.resolve_heartbeat(config)
+        if _hb_enabled and (rung is None or rung.itr == 0):
+            self._hb_ctx = {"scope": _heartbeat.get_hub().new_scope()}
+        hb_ctx = getattr(self, "_hb_ctx", None) if _hb_enabled else None
         # a search submitted through a session's SearchExecutor charges
         # its broadcast residents to its tenant's data-plane quota
         from spark_sklearn_tpu import serve as _serve
@@ -1794,6 +1804,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 metrics.put("memory", _memledger.report_block(
                     ledger, mem_before,
                     getattr(self, "_memory_ctx", {}) or {}))
+            # this search's in-flight heartbeat view (beats/steps,
+            # cadence percentiles, staleness, overhead estimate) —
+            # schema in obs.metrics.HEARTBEAT_BLOCK_SCHEMA.  Rendered
+            # ONLY when heartbeat is on: off, the report shape is
+            # byte-identical to the beacon-less engine.
+            if hb_ctx is not None:
+                metrics.put("heartbeat", _heartbeat.heartbeat_block(
+                    hb_ctx["scope"]))
             # the search's protection verdict (deadline/shed/quarantine
             # state) — schema in obs.metrics.PROTECTION_BLOCK_SCHEMA.
             # Rendered ONLY when protection is on: off, the report is
@@ -2028,6 +2046,41 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             scan_shard = NamedSharding(
                 mesh, P(None, mesh_lib.TASK_AXIS))
             repl_shard = mesh_lib.replicated_sharding(mesh)
+        # in-flight heartbeats (obs/heartbeat.py): the scanned step body
+        # beacons (segment token, step index) through jax.debug.callback
+        # while the device is mid-launch, so progress/ETA and the
+        # heartbeat watchdog see liveness per scan step.  The scope was
+        # created by _fit_compiled_impl; hb_on gates EVERY heartbeat
+        # touch below, so off is an exact no-op (no callback traced —
+        # the "hb" cache-key component in build_scan keeps on/off
+        # programs from ever aliasing).
+        from spark_sklearn_tpu.obs import heartbeat as _heartbeat
+        _hb_ctx = getattr(self, "_hb_ctx", None)
+        hb_on = _hb_ctx is not None \
+            and _heartbeat.resolve_heartbeat(config)
+        hb_scope = _hb_ctx["scope"] if hb_on else ""
+        hb_handle = binding.handle.id \
+            if (hb_on and binding is not None) else ""
+        hb_tenant = binding.tenant \
+            if (hb_on and binding is not None) else ""
+        if hb_on:
+            # the geometry cost model's prior prices the ETA blend's
+            # model side: per scan step, one chunk's padded lanes at
+            # lane_cost_s plus the launch overhead amortized over the
+            # segment (config overrides win, like plan_geometry's)
+            from spark_sklearn_tpu.parallel.taskgrid import (
+                geometry_cost_model)
+            _cm_snap = geometry_cost_model().snapshot()
+            hb_overhead_s = getattr(config, "geometry_overhead_s", None)
+            if hb_overhead_s is None:
+                hb_overhead_s = float(
+                    _cm_snap.get("launch_overhead_s", 0.0))
+            hb_lane_cost_s = getattr(config, "geometry_lane_cost_s",
+                                     None)
+            if hb_lane_cost_s is None:
+                hb_lane_cost_s = float(_cm_snap.get("lane_cost_s", 0.0))
+        else:
+            hb_overhead_s = hb_lane_cost_s = 0.0
         # cross-search launch fusion (serve/executor.py): steady-state
         # fused chunks of an executor-submitted search offer a FuseSpec
         # so same-program chunks from OTHER searches coalesce into one
@@ -2529,7 +2582,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             cache[nc_batch] = progs
             return progs
 
-        def build_scan(plan, n_steps, topk_k=0):
+        def build_scan(plan, n_steps, topk_k=0, hb=False):
             """ONE jitted program executing `n_steps` chunks of the
             group as a `lax.scan` over the stacked chunk axis — the
             melted launch boundary.  The step function is the group's
@@ -2547,9 +2600,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             sklearn's `_top_k` (ascending mean with NaNs rolled to the
             front) — rung N+1's candidate set never round-trips scores
             to host.
+
+            `hb=True` threads the heartbeat beacon into the step body:
+            the step index rides the scan xs and a jax.debug.callback
+            emits (token, step) to the HeartbeatHub while the device is
+            mid-launch.  The token is a RUNTIME operand (never baked
+            into the trace), so ONE compiled program serves every
+            search's segments; the flag joins the cache key below so
+            on/off programs never alias, and off leaves the key (and
+            the traced program) byte-identical to the beacon-less one.
             """
             cache = plan.setdefault("scan_progs", {})
-            ck = (int(n_steps), int(topk_k))
+            ck = (int(n_steps), int(topk_k)) + (("hb",) if hb else ())
             prog = cache.get(ck)
             if prog is not None:
                 return prog
@@ -2559,7 +2621,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             score0 = scorer_names[0]
 
             def scan_batch(dyn_st, idx_st, data_d, w_fit, test_m,
-                           train_m, test_u, train_u):
+                           train_m, test_u, train_u, hb_tok=None):
                 if topk_k:
                     carry0 = jnp.full((nc + 1, n_folds),
                                       jnp.float32(errval))
@@ -2567,10 +2629,21 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     carry0 = jnp.zeros((), jnp.float32)
 
                 def step(carry, xs):
-                    dyn_c, idx_c = xs
+                    if hb:
+                        dyn_c, idx_c, step_i = xs
+                    else:
+                        dyn_c, idx_c = xs
                     te, tr, bad, im, isum = fused_body(
                         dyn_c, data_d, w_fit, test_m, train_m,
                         test_u, train_u)
+                    if hb:
+                        # in-flight beat: fires on jax's callback
+                        # thread as each scan step executes; unordered
+                        # (no token threading cost) — the hub takes
+                        # the max step either way
+                        jax.debug.callback(
+                            _heartbeat.device_beat, hb_tok, step_i,
+                            ordered=False)
                     if topk_k:
                         # mirror the host-side error_score substitution
                         # BEFORE the mean, so the device ranking sees
@@ -2581,7 +2654,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         carry = carry.at[idx_c].set(sc)
                     return carry, (te, tr, bad, im, isum)
 
-                carry, ys = lax.scan(step, carry0, (dyn_st, idx_st))
+                xs = (dyn_st, idx_st)
+                if hb:
+                    xs = xs + (jnp.arange(n_steps, dtype=jnp.int32),)
+                carry, ys = lax.scan(step, carry0, xs)
                 if topk_k:
                     mean = carry[:nc].mean(axis=1)
                     order = jnp.roll(jnp.argsort(mean),
@@ -2597,11 +2673,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # exported-wrapper path has no scan coverage yet, and a
             # store-warm process still skips the python->HLO walk via
             # this cache
+            # the beacon's presence joins the cache key ONLY when on:
+            # the off-state tuple is byte-identical to the beacon-less
+            # engine's, and on/off programs can never alias (a cached
+            # beacon-less program must not serve a heartbeat fit)
             scan_jit = _cached_program(
                 ("scan", family, plan["static"], meta, plan["nc_batch"],
                  n_folds, int(n_steps), bool(config.bf16_matmul), mesh,
                  score_key, return_train, sw_blind, donate,
-                 int(topk_k), nc, repr(float(errval))),
+                 int(topk_k), nc, repr(float(errval)))
+                + (("hb",) if hb else ()),
                 lambda: jax.jit(scan_batch, **donate_kw),
                 store_parts=None)
             cache[ck] = scan_jit
@@ -2664,7 +2745,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # rung's jax config.
             pipe = rung.pipeline
         else:
-            pipe = ChunkPipeline(depth, verbose=self.verbose)
+            pipe = ChunkPipeline(depth, verbose=self.verbose,
+                                 heartbeat=hb_on)
             if rung is not None:
                 rung.pipeline = pipe
 
@@ -3219,8 +3301,16 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 seg_tasks = sum((hi - lo) * n_folds
                                 for lo, hi, _ in members)
                 seg_topk = topk_k if n_steps == len(live) else 0
+                # per-step cost estimate seeding the ETA blend: the
+                # geometry model's launch overhead amortizes across the
+                # scanned steps, lane cost scales with the segment's
+                # lane width — observed beat cadence refines this as
+                # beats arrive (heartbeat._Segment.blended_step_s)
+                hb_est = hb_overhead_s / max(1, n_steps) \
+                    + hb_lane_cost_s * lanes
 
-                def stage(members=members, plan=plan, n_steps=n_steps):
+                def stage(members=members, plan=plan, n_steps=n_steps,
+                          seg_key=seg_key, si=si, hb_est=hb_est):
                     with get_tracer().span(
                             "chunkloop.segment", group=plan["gi"],
                             n_chunks=n_steps):
@@ -3256,17 +3346,37 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                 done.add(cid)
                             if len(done) >= plan["n_live"]:
                                 plan.pop("w_task_dev", None)
-                        return dyn, idx_st, w
+                        # heartbeat segment registration happens at
+                        # stage time (before dispatch) so a launch
+                        # that never produces a beat still shows up
+                        # stale to the watchdog
+                        tok = None
+                        if hb_on:
+                            tok = _heartbeat.get_hub().register_segment(
+                                seg_key, group=plan["gi"], segment=si,
+                                n_steps=n_steps, scope=hb_scope,
+                                handle=hb_handle, tenant=hb_tenant,
+                                est_step_s=hb_est)
+                        return dyn, idx_st, w, tok
 
                 def launch(payload, plan=plan, n_steps=n_steps,
                            seg_topk=seg_topk):
-                    dyn, idx_st, w = payload
+                    dyn, idx_st, w, tok = payload
                     # the trace pin for "no score round-trip": a rung
                     # scanned with topk > 0 ran its elimination inside
                     # this one launch
                     with get_tracer().span(
                             "chunkloop.scan", group=plan["gi"],
                             n_chunks=n_steps, topk=seg_topk):
+                        if tok is not None:
+                            # token as RUNTIME operand — the compiled
+                            # scan program is shared across searches
+                            return build_scan(
+                                plan, n_steps, seg_topk, hb=True)(
+                                dyn, idx_st, data_dev, w, test_dev,
+                                train_sc_dev, test_unw_dev,
+                                train_unw_dev,
+                                np.asarray(tok, np.int32))
                         return build_scan(plan, n_steps, seg_topk)(
                             dyn, idx_st, data_dev, w, test_dev,
                             train_sc_dev, test_unw_dev, train_unw_dev)
@@ -3309,7 +3419,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     return {"chunks": chunks, "survivors": None}
 
                 def finalize(host, tm, members=members, plan=plan,
-                             seg_topk=seg_topk, lanes=lanes):
+                             seg_topk=seg_topk, lanes=lanes,
+                             seg_key=seg_key):
+                    if hb_on:
+                        # runs after scan success AND after the OOM
+                        # per-chunk fallback (bisect), so progress
+                        # always lands on steps_total for the segment
+                        _heartbeat.get_hub().complete_segment(seg_key)
                     chunks = host["chunks"]
                     wall = tm.dispatch_s + tm.compute_s + tm.gather_s
                     total_real = sum((hi - lo) * n_folds

@@ -70,6 +70,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from spark_sklearn_tpu.obs import heartbeat as _heartbeat
 from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer, set_correlation
@@ -403,7 +404,11 @@ class SearchFuture:
     def progress(self) -> Dict[str, Any]:
         """Live progress: state, chunks dispatched, the planned live-
         chunk estimate (known once geometry is planned) and their
-        ratio."""
+        ratio.  With the in-flight heartbeat on
+        (``TpuConfig.heartbeat`` / ``SST_HEARTBEAT``) a ``heartbeat``
+        sub-dict adds intra-segment ``steps_done/steps_total`` and a
+        blended ETA, so a scanned rung no longer freezes progress for
+        its whole multi-minute launch."""
         return self._executor.progress(self._handle)
 
 
@@ -901,6 +906,11 @@ class SearchExecutor:
         return True
 
     def progress(self, handle: SearchHandle) -> Dict[str, Any]:
+        # the heartbeat hub owns its own named lock — query it BEFORE
+        # taking ours (no cross-module lock nesting).  None (heartbeat
+        # off / no scanned segments yet) leaves the dict unchanged, so
+        # the pre-heartbeat progress shape is byte-identical.
+        hb = _heartbeat.get_hub().progress_for_handle(handle.id)
         with self._lock:
             frac = (min(1.0, handle.n_dispatched / handle.planned)
                     if handle.planned else None)
@@ -914,6 +924,11 @@ class SearchExecutor:
             if handle.rung >= 0:
                 out["rung"] = handle.rung
                 out["rung_frac"] = round(handle.rung_frac, 4)
+            if hb is not None:
+                # intra-segment steps_done/steps_total + blended ETA:
+                # the scanned rung no longer freezes progress for a
+                # whole multi-minute launch
+                out["heartbeat"] = hb
             return out
 
     def note_planned(self, handle: SearchHandle, n: int) -> None:

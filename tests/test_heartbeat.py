@@ -1,0 +1,450 @@
+"""In-flight device telemetry (``TpuConfig(heartbeat=...)``).
+
+Contracts under test:
+
+  - **exact no-op off** (the default): no ``heartbeat`` block in the
+    report, no hub traffic, and ``cv_results_`` byte-identical to the
+    heartbeat-on run — the beacon's presence joins the program cache
+    key, so on/off compiled programs never alias even within one
+    process;
+  - **live progress**: the scanned step body's beacon advances
+    ``steps_done`` monotonically and reaches ``steps_total``,
+    including across an OOM -> per-chunk fallback segment and a
+    kill/resume (the finalize-side ``complete_segment`` clamps, so
+    progress converges even when beats stop);
+  - **overhead contract**: the hub's own measured host cost stays
+    under 2% of the scanned segments' wall;
+  - **heartbeat watchdog**: with ``heartbeat_timeout_s`` set, a
+    deterministically injected mid-scan stall (``hung@I:STEP``) is
+    declared HUNG naming the exact step — in the raised
+    ``LaunchTimeoutError``, the fault event, the flight bundle and
+    the offline doctor's digest;
+  - **fleet surfacing**: the report block matches
+    ``HEARTBEAT_BLOCK_SCHEMA`` key-for-key, the telemetry snapshot
+    carries the hub's totals + per-handle progress, and the
+    ``sst_heartbeat_*`` Prometheus families render validly.
+"""
+
+import glob
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.obs import heartbeat
+from spark_sklearn_tpu.obs.metrics import HEARTBEAT_BLOCK_SCHEMA
+from spark_sklearn_tpu.parallel.faults import LaunchTimeoutError
+
+
+def _non_time_results(gs):
+    return {k: v for k, v in gs.cv_results_.items()
+            if "time" not in k and k != "params"}
+
+
+def _assert_exact_equal(ra, rb):
+    assert set(ra) == set(rb)
+    for k in ra:
+        np.testing.assert_array_equal(
+            np.asarray(ra[k]), np.asarray(rb[k]), err_msg=k)
+
+
+#: several chunks in ONE compile group at width 8 -> one scan segment
+_GRID = {"C": np.logspace(-2, 1, 24).tolist()}
+#: adds a static axis -> TWO compile groups, one scan segment each
+_GRID_2G = {"C": np.logspace(-2, 1, 12).tolist(),
+            "fit_intercept": [True, False]}
+
+#: pinned geometry costs: process-order-independent planned widths
+#: (and a deterministic model prior for the ETA blend)
+_OVR = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3)
+
+
+def _fit_grid(X, y, grid, **cfg_kw):
+    from sklearn.linear_model import LogisticRegression
+    cfg_kw.setdefault("max_tasks_per_batch", 16)
+    cfg_kw.setdefault("chunk_loop", "scan")
+    cfg_kw.update(_OVR)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.GridSearchCV(
+            LogisticRegression(max_iter=10), grid, cv=2, refit=False,
+            backend="tpu", config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    heartbeat.get_hub().reset()
+    yield
+    heartbeat.get_hub().reset()
+
+
+# ---------------------------------------------------------------------------
+# knob resolution
+# ---------------------------------------------------------------------------
+
+
+class TestResolveKnob:
+    def test_default_off(self, monkeypatch):
+        monkeypatch.delenv("SST_HEARTBEAT", raising=False)
+        assert heartbeat.resolve_heartbeat(None) is False
+        assert heartbeat.resolve_heartbeat(sst.TpuConfig()) is False
+
+    @pytest.mark.parametrize("env,want", [
+        ("1", True), ("true", True), ("on", True), ("yes", True),
+        ("0", False), ("false", False), ("off", False), ("no", False),
+        ("", False), ("  ", False),
+    ])
+    def test_env_values(self, monkeypatch, env, want):
+        monkeypatch.setenv("SST_HEARTBEAT", env)
+        assert heartbeat.resolve_heartbeat(sst.TpuConfig()) is want
+
+    def test_config_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("SST_HEARTBEAT", "1")
+        assert heartbeat.resolve_heartbeat(
+            sst.TpuConfig(heartbeat=False)) is False
+        monkeypatch.setenv("SST_HEARTBEAT", "0")
+        assert heartbeat.resolve_heartbeat(
+            sst.TpuConfig(heartbeat=True)) is True
+
+    def test_env_knob_end_to_end(self, digits, monkeypatch):
+        """A config-field-less deployment flips the beacon on through
+        the environment alone."""
+        X, y = digits
+        monkeypatch.setenv("SST_HEARTBEAT", "1")
+        gs = _fit_grid(X[:240], y[:240], _GRID)
+        hb = gs.search_report["heartbeat"]
+        assert hb["enabled"] and hb["beats_total"] > 0
+        assert hb["steps_done"] == hb["steps_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# hub unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestHubUnit:
+    def test_beat_progress_staleness_complete(self):
+        hub = heartbeat.HeartbeatHub()
+        tok = hub.register_segment("0:scan0", group=0, segment=0,
+                                   n_steps=4, scope="fit-1",
+                                   handle="h-1", est_step_s=0.5)
+        st = hub.staleness("0:scan0")
+        assert st["last_step"] is None and st["n_steps"] == 4
+        hub.beat(tok, 0)
+        hub.beat(tok, 1)
+        # duplicate / out-of-order beats never move progress backwards
+        hub.beat(tok, 0)
+        st = hub.staleness("0:scan0")
+        assert st["last_step"] == 1 and st["steps_done"] == 2
+        pr = hub.progress_for_handle("h-1")
+        assert pr["steps_done"] == 2 and pr["steps_total"] == 4
+        assert 0.0 < pr["frac"] < 1.0 and pr["eta_s"] > 0.0
+        hub.complete_segment("0:scan0")
+        assert not hub.live_segment("0:scan0")
+        assert hub.staleness("0:scan0") is None
+        # the done segment still reports, clamped to total
+        pr = hub.progress_for_handle("h-1")
+        assert pr["steps_done"] == pr["steps_total"] == 4
+        assert pr["frac"] == 1.0 and pr["eta_s"] == 0.0
+
+    def test_unknown_token_and_handle(self):
+        hub = heartbeat.HeartbeatHub()
+        hub.beat(999, 0)                     # stray beat: dropped
+        assert hub.stats()["beats_total"] == 0
+        assert hub.progress_for_handle("nope") is None
+        assert hub.progress_for_handle("") is None
+
+    def test_cap_freezes_last_step(self):
+        hub = heartbeat.HeartbeatHub()
+        tok = hub.register_segment("k", n_steps=5)
+        assert hub.cap_beats("k", 1)
+        for s in range(5):
+            hub.beat(tok, s)
+        st = hub.staleness("k")
+        assert st["last_step"] == 1 and st["steps_done"] == 2
+        assert hub.stats()["capped_dropped"] == 3
+        assert not hub.cap_beats("missing", 0)
+
+    def test_reregistered_key_retires_stale_token(self):
+        hub = heartbeat.HeartbeatHub()
+        tok1 = hub.register_segment("k", n_steps=3)
+        tok2 = hub.register_segment("k", n_steps=3)   # retry
+        hub.beat(tok1, 2)                    # stale token: dropped
+        assert hub.staleness("k")["last_step"] is None
+        hub.beat(tok2, 0)
+        assert hub.staleness("k")["last_step"] == 0
+
+    def test_new_scope_unique(self):
+        hub = heartbeat.HeartbeatHub()
+        scopes = {hub.new_scope() for _ in range(8)}
+        assert len(scopes) == 8
+
+    def test_block_matches_pinned_schema(self):
+        hub = heartbeat.get_hub()
+        tok = hub.register_segment("k", n_steps=2, scope="s-1")
+        hub.beat(tok, 0)
+        block = heartbeat.heartbeat_block("s-1")
+        assert list(block) == [d.name for d in HEARTBEAT_BLOCK_SCHEMA]
+
+    def test_snapshot_block_and_prometheus(self):
+        hub = heartbeat.get_hub()
+        tok = hub.register_segment("k", n_steps=3, handle="h-7")
+        hub.beat(tok, 0)
+        hub.beat(tok, 1)
+        heartbeat.note_chunk("c0", 0)
+        snap_hb = heartbeat.snapshot_block()
+        assert snap_hb["beats_total"] == 2
+        assert snap_hb["chunk_beats_total"] == 1
+        assert snap_hb["searches"]["h-7"]["steps_done"] == 2
+        # ...surfaced through the telemetry snapshot...
+        from spark_sklearn_tpu.obs.telemetry import get_telemetry
+        assert get_telemetry().snapshot()["heartbeat"][
+            "beats_total"] == 2
+        # ...and rendered as valid sst_heartbeat_* families
+        from spark_sklearn_tpu.obs.fleet import (METRIC_LINE_RE,
+                                                 prometheus_text)
+        text = prometheus_text({"heartbeat": snap_hb})
+        assert 'sst_heartbeat_beats_total 2' in text
+        assert 'sst_heartbeat_steps_done{handle="h-7"} 2' in text
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert METRIC_LINE_RE.match(line), line
+
+    def test_ring_is_bounded(self):
+        hub = heartbeat.HeartbeatHub(max_records=16)
+        tok = hub.register_segment("k", n_steps=10 ** 6)
+        for s in range(64):
+            hub.beat(tok, s)
+        assert len(hub._ring) == 16
+        assert hub.stats()["beats_total"] == 64
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: exact no-op off, progress on
+# ---------------------------------------------------------------------------
+
+
+class TestOffIsExactNoOp:
+    def test_parity_and_cache_separation(self, digits):
+        """off -> on -> off in ONE process: byte-identical numbers,
+        no ``heartbeat`` report key when off, and the off runs never
+        touch the hub — which also proves the beacon-bearing and
+        beacon-less compiled programs do not alias in the cache."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        hub = heartbeat.get_hub()
+
+        off = _fit_grid(Xs, ys, _GRID)
+        assert "heartbeat" not in off.search_report
+        assert hub.stats()["beats_total"] == 0
+        assert hub.stats()["segments_total"] == 0
+
+        on = _fit_grid(Xs, ys, _GRID, heartbeat=True)
+        hb = on.search_report["heartbeat"]
+        assert hb["enabled"] and hb["n_segments"] >= 1
+        assert hb["beats_total"] == hb["steps_total"] == \
+            hb["steps_done"] > 1
+        assert hb["cadence_p50_s"] >= 0.0
+        beats_after_on = hub.stats()["beats_total"]
+        assert beats_after_on == hb["beats_total"]
+
+        # a second off fit reuses the beacon-less program: zero new
+        # beats, no report block
+        off2 = _fit_grid(Xs, ys, _GRID)
+        assert "heartbeat" not in off2.search_report
+        assert hub.stats()["beats_total"] == beats_after_on
+
+        _assert_exact_equal(_non_time_results(off),
+                            _non_time_results(on))
+        _assert_exact_equal(_non_time_results(off),
+                            _non_time_results(off2))
+
+    def test_per_chunk_path_beats_at_dispatch(self, digits):
+        X, y = digits
+        gs = _fit_grid(X[:240], y[:240], _GRID, chunk_loop="per_chunk",
+                       heartbeat=True)
+        hb = gs.search_report["heartbeat"]
+        assert hb["chunk_beats_total"] > 0
+        assert hb["n_segments"] == 0      # nothing scanned
+
+    def test_overhead_contract_under_2pct(self, digits):
+        """The hub's own accounting of beacon host time stays under
+        2% of the scanned segments' wall — the report block carries
+        the fraction, so the contract is checkable in production too."""
+        X, y = digits
+        gs = _fit_grid(X[:240], y[:240], _GRID, heartbeat=True)
+        hb = gs.search_report["heartbeat"]
+        assert hb["beats_total"] > 0
+        assert hb["overhead_frac"] < 0.02, hb
+
+
+class TestProgressMonotone:
+    def _spy(self, monkeypatch):
+        samples = []
+        orig = heartbeat.HeartbeatHub.beat
+
+        def spy(hub, token, step):
+            orig(hub, token, step)
+            st = hub._scope_stats(None)
+            samples.append((st["steps_done"], st["steps_total"]))
+
+        monkeypatch.setattr(heartbeat.HeartbeatHub, "beat", spy)
+        return samples
+
+    def test_monotone_reaches_total(self, digits, monkeypatch):
+        X, y = digits
+        samples = self._spy(monkeypatch)
+        gs = _fit_grid(X[:240], y[:240], _GRID_2G, heartbeat=True)
+        hb = gs.search_report["heartbeat"]
+        assert hb["n_segments"] == 2       # two compile groups
+        assert hb["steps_done"] == hb["steps_total"] > 0
+        assert len(samples) == hb["beats_total"] > 0
+        done = [d for d, _ in samples]
+        assert done == sorted(done)        # never decreases
+
+    def test_monotone_across_oom_fallback(self, digits, monkeypatch):
+        """An injected OOM on the scanned segment degrades it to the
+        per-chunk path; finalize still completes the segment, so
+        progress reaches total — and the numbers stay exact."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        base = _fit_grid(Xs, ys, _GRID)
+        samples = self._spy(monkeypatch)
+        faulted = _fit_grid(Xs, ys, _GRID, heartbeat=True,
+                            fault_plan="oom@0", retry_backoff_s=0.01)
+        cl = faulted.search_report["chunkloop"]
+        assert any(fb.startswith("oom-per-chunk:")
+                   for fb in cl["fallbacks"]), cl
+        hb = faulted.search_report["heartbeat"]
+        assert hb["steps_done"] == hb["steps_total"] > 0
+        done = [d for d, _ in samples]
+        assert done == sorted(done)
+        _assert_exact_equal(_non_time_results(base),
+                            _non_time_results(faulted))
+
+    @pytest.mark.parametrize("hb_on_resume", [True, False])
+    def test_progress_across_kill_resume(self, digits, tmp_path,
+                                         hb_on_resume):
+        """A fatal takes down segment 1 with segment 0 durable; the
+        resumed fit replays it and progress converges to the resumed
+        run's own total — with the beacon on and off."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        full = _fit_grid(Xs, ys, _GRID_2G)
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Exception, match="[Ii]njected"):
+            _fit_grid(Xs, ys, _GRID_2G, heartbeat=True,
+                      checkpoint_dir=ckpt, fault_plan="fatal@1")
+        heartbeat.get_hub().reset()
+        resumed = _fit_grid(Xs, ys, _GRID_2G, heartbeat=hb_on_resume,
+                            checkpoint_dir=ckpt)
+        assert resumed.search_report["n_chunks_resumed"] > 0
+        if hb_on_resume:
+            hb = resumed.search_report["heartbeat"]
+            assert hb["steps_done"] == hb["steps_total"] > 0
+        else:
+            assert "heartbeat" not in resumed.search_report
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))
+
+
+# ---------------------------------------------------------------------------
+# heartbeat watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatWatchdog:
+    def test_injected_stall_names_the_step(self, digits, tmp_path):
+        """``hung@0:1`` caps beats at scan step 1: the heartbeat goes
+        silent, the watchdog fires naming step 1, and the step lands
+        in the fault event, the flight bundle and the doctor digest."""
+        X, y = digits
+        with pytest.raises(LaunchTimeoutError) as ei:
+            _fit_grid(X[:240], y[:240], _GRID, heartbeat=True,
+                      heartbeat_timeout_s=0.4, fault_plan="hung@0:1",
+                      flight_dir=str(tmp_path))
+        exc = ei.value
+        assert exc.mode == "heartbeat" and exc.injected
+        assert exc.last_step == 1 and exc.steps_total == 3
+        assert "heartbeat went silent" in str(exc)
+        assert "last beat at scan step 1 of 3" in str(exc)
+
+        bundles = glob.glob(str(tmp_path / "flight-watchdog-*.json"))
+        assert bundles, list(tmp_path.iterdir())
+        with open(bundles[0]) as f:
+            bundle = json.load(f)
+        ctx = bundle["context"]
+        assert ctx["watchdog_mode"] == "heartbeat"
+        assert ctx["last_step"] == 1 and ctx["steps_total"] == 3
+        evs = [e for e in bundle["faults"]["events"]
+               if e["class"] == "hung"]
+        assert evs and evs[0]["watchdog_mode"] == "heartbeat"
+        assert evs[0]["last_step"] == 1
+
+        from tools import sst_doctor
+        d = sst_doctor.digest(bundle, sst_doctor.load_analyzer())
+        text = sst_doctor.format_digest(d, None)
+        assert "watchdog: heartbeat" in text
+        assert "last beat at scan step 1 of 3" in text
+
+    def test_beating_scan_does_not_trip_watchdog(self, digits):
+        """A healthy scanned fit under a tight heartbeat timeout
+        completes: liveness is judged per beat, not per segment
+        wall — the melted boundary no longer needs a whole-launch
+        ``launch_timeout_s`` budget."""
+        X, y = digits
+        gs = _fit_grid(X[:240], y[:240], _GRID, heartbeat=True,
+                       heartbeat_timeout_s=30.0)
+        hb = gs.search_report["heartbeat"]
+        assert hb["steps_done"] == hb["steps_total"] > 0
+        assert gs.search_report["faults"]["timeouts"] == 0
+
+    def test_timeout_error_carries_fields(self):
+        exc = LaunchTimeoutError("0:scan0", 0, 0.5, injected=True,
+                                 mode="heartbeat", last_step=7,
+                                 steps_total=13)
+        assert exc.key == "0:scan0" and exc.mode == "heartbeat"
+        assert "heartbeat went silent" in str(exc)
+        assert "step 7 of 13" in str(exc)
+        # wall mode keeps the pre-heartbeat message shape
+        wall = LaunchTimeoutError("k", 1, 2.0)
+        assert wall.mode == "wall"
+        assert "heartbeat" not in str(wall)
+
+
+# ---------------------------------------------------------------------------
+# executor progress surfacing
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorProgress:
+    def test_progress_gains_heartbeat_subdict(self, digits):
+        from sklearn.linear_model import LogisticRegression
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+
+        def search(**cfg_kw):
+            cfg_kw.setdefault("max_tasks_per_batch", 16)
+            cfg_kw.update(_OVR)
+            return sst.GridSearchCV(
+                LogisticRegression(max_iter=10), _GRID, cv=2,
+                refit=False, backend="tpu",
+                config=sst.TpuConfig(chunk_loop="scan", **cfg_kw))
+
+        sess = sst.createLocalTpuSession("heartbeat-progress")
+        try:
+            fut_on = sess.submit(search(heartbeat=True), Xs, ys)
+            fut_on.result(timeout=180)
+            pr = fut_on.progress()
+            assert pr["state"] == "done"
+            hb = pr["heartbeat"]
+            assert hb["steps_done"] == hb["steps_total"] > 0
+            assert hb["frac"] == 1.0 and hb["eta_s"] == 0.0
+
+            fut_off = sess.submit(search(), Xs, ys)
+            fut_off.result(timeout=180)
+            assert "heartbeat" not in fut_off.progress()
+        finally:
+            sess.stop()

@@ -304,7 +304,8 @@ class TestTraceDigest:
         ]
         s = summarize(events)
         assert s["compile"] == {
-            "compile_wall_ms": 2000.0, "store_loads": 2,
+            "compile_wall_ms": 2000.0, "compile_ms_per_launch": 0.0,
+            "launch_unit": "launch", "store_loads": 2,
             "store_hits": 1, "store_hit_rate": 0.5,
             "store_bytes_loaded": 1000, "store_bytes_saved": 4000}
         text = format_summary(s)

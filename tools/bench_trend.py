@@ -62,6 +62,11 @@ _WATCHED = (
     # this sits at 1.0 and any creep up means segments are splitting
     # (budget miscounts) or segments are falling back per-chunk
     ("launches_per_group", "up"),
+    # heartbeat beacon host fraction in the chunkloop A/B: the hub's
+    # own measured cost of in-flight beats, contractually <2% of the
+    # scanned wall — a step change up means the beacon (or something
+    # on the callback path) got expensive
+    ("hb_overhead", "up"),
 )
 
 
@@ -112,6 +117,7 @@ def _round_row(path: str) -> Dict[str, Any]:
         "stream_h2d_bytes": ss.get("stream_block_h2d_bytes"),
         "stream_shards": ss.get("stream_n_shards"),
         "launches_per_group": cl.get("scan_launches_per_group"),
+        "hb_overhead": cl.get("hb_overhead_frac"),
         "parsed": bool(det),
     }
 
@@ -141,6 +147,18 @@ def compare_last_two(rows: List[Dict[str, Any]],
     for key, direction in _WATCHED:
         a, b = prev.get(key), last.get(key)
         if a is None or b is None:
+            continue
+        if key == "hb_overhead":
+            # contract gauge, not a throughput ratio: healthy values
+            # sit around 1e-4 where percentage deltas are pure noise.
+            # The flag is a step change THROUGH the <2% overhead
+            # contract (obs/heartbeat.py), recorded in percentage
+            # points
+            deltas[key] = round(100.0 * (b - a), 4)
+            if b > 0.02 and b > a:
+                flags.append({"metric": key, "prev": a, "last": b,
+                              "change_pct": deltas[key],
+                              "direction": direction})
             continue
         if a == 0:
             # absolute counters (serve_shed): the healthy value IS
@@ -185,7 +203,7 @@ def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
            f"{'halving x':>10} {'hit rate':>9} {'shed':>6} "
            f"{'srch/min':>9} {'sp/dn h2d':>10} {'strm h2d':>9} "
-           f"{'shards':>7} {'l/grp':>6}"]
+           f"{'shards':>7} {'l/grp':>6} {'hb ovh':>8}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
@@ -197,7 +215,8 @@ def format_table(digest: Dict[str, Any]) -> str:
             f"{_fmt(r.get('sparse_h2d_ratio'), 4):>10} "
             f"{_fmt(r.get('stream_h2d_bytes'), 0):>9} "
             f"{_fmt(r.get('stream_shards'), 0):>7} "
-            f"{_fmt(r.get('launches_per_group')):>6}"
+            f"{_fmt(r.get('launches_per_group')):>6} "
+            f"{_fmt(r.get('hb_overhead'), 5):>8}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

@@ -157,6 +157,41 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
             line += ("; lanes borrowed/donated: "
                      + "; ".join(x for x in (exchange, donated) if x))
         out.append(line)
+    hb = snap.get("heartbeat") or {}
+    searches = hb.get("searches") or {}
+    if searches:
+        out.append("")
+        out.append(f"  {'search':<20} {'segs':>5} {'steps':>11} "
+                   f"{'progress':>9} {'eta':>8} {'beats':>6}")
+        for handle in sorted(searches):
+            pr = searches[handle] or {}
+            total = int(pr.get("steps_total", 0) or 0)
+            done = int(pr.get("steps_done", 0) or 0)
+            frac = pr.get("frac")
+            eta = pr.get("eta_s")
+            out.append(
+                f"  {str(handle):<20} {pr.get('segments', 0):>5} "
+                f"{f'{done}/{total}':>11} "
+                f"{('-' if frac is None else f'{100 * frac:.1f}%'):>9} "
+                f"{('-' if eta is None else f'{eta:.1f}s'):>8} "
+                f"{pr.get('beats', 0):>6}")
+        out.append(
+            f"heartbeat: {hb.get('beats_total', 0)} beat(s) / "
+            f"{hb.get('chunk_beats_total', 0)} chunk beat(s), "
+            f"cadence p50 {1e3 * hb.get('cadence_p50_s', 0.0):.1f}ms "
+            f"p95 {1e3 * hb.get('cadence_p95_s', 0.0):.1f}ms, "
+            f"staleness max {1e3 * hb.get('staleness_max_s', 0.0):.1f}ms")
+    elif hb.get("beats_total") or hb.get("chunk_beats_total"):
+        out.append(
+            f"heartbeat: {hb.get('beats_total', 0)} beat(s) / "
+            f"{hb.get('chunk_beats_total', 0)} chunk beat(s), "
+            "no live search")
+    else:
+        # heartbeats off (TpuConfig.heartbeat / SST_HEARTBEAT unset):
+        # the column renders `-` rather than vanishing, so a one-shot
+        # reading is unambiguous about why there is no progress row
+        out.append("search progress: -  (heartbeat disabled — set "
+                   "TpuConfig(heartbeat=True) or SST_HEARTBEAT=1)")
     faults = snap.get("faults") or {}
     if faults.get("total"):
         by_cls = ", ".join(f"{k}={v}" for k, v in sorted(

@@ -101,6 +101,12 @@ def _digest_bundle(data: Dict[str, Any], mod) -> Dict[str, Any]:
         "family": ctx.get("family", ""),
         "regression": reg,
     }
+    if ctx.get("watchdog_mode"):
+        out["heartbeat"] = {
+            "watchdog_mode": ctx.get("watchdog_mode"),
+            "last_step": ctx.get("last_step"),
+            "steps_total": ctx.get("steps_total"),
+        }
     if mod is not None:
         spans = mod.spans_from_chrome(data.get("traceEvents") or [])
         compile_s, fault_s, n_compile = mod._span_walls(spans)
@@ -188,6 +194,14 @@ def format_digest(d: Dict[str, Any], mod=None) -> str:
                    + (f", family {d['family']!r}" if d["family"] else ""))
         if d.get("verdict"):
             out.append(f"verdict: {d['verdict']}")
+        hb = d.get("heartbeat") or {}
+        if hb:
+            last = hb.get("last_step")
+            total = hb.get("steps_total")
+            out.append(
+                f"watchdog: {hb.get('watchdog_mode')} — last beat at "
+                f"scan step {'?' if last is None else last}"
+                + (f" of {total}" if total is not None else ""))
         tr = d.get("trace") or {}
         if tr:
             out.append(f"trace: compile {tr['compile_s']:.3f} s over "

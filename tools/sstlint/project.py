@@ -201,6 +201,24 @@ class Project:
                                    "safety_margin", "n_samples",
                                    "n_oom", "_devices", "_groups",
                                    "_compiled")),
+                # faults: injected-stall bookkeeping for the heartbeat
+                # watchdog drill
+                SharedState("parallel/faults.py",
+                            "faults.LaunchSupervisor._lock",
+                            cls="LaunchSupervisor",
+                            attrs=("_hb_stall_keys",)),
+                # obs/heartbeat: the in-flight beacon hub, hit by the
+                # device callback (runtime thread), the dispatch loop's
+                # register/complete hooks, the watchdog's staleness
+                # polls and every progress()/snapshot reader
+                SharedState("obs/heartbeat.py",
+                            "heartbeat.HeartbeatHub._lock",
+                            cls="HeartbeatHub",
+                            attrs=("_ring", "_next_token", "_by_token",
+                                   "_live_by_key", "_done",
+                                   "_beats_total", "_chunk_beats_total",
+                                   "_segments_total",
+                                   "_capped_dropped")),
                 # obs/runlog: the persistent run-history store, hit by
                 # the doctor's end-of-fit append and by any concurrent
                 # session sharing the process-wide active log
@@ -264,6 +282,10 @@ class Project:
                 BlockSpec("chunkloop", "CHUNKLOOP_BLOCK_SCHEMA", (
                     Producer("dict-keys", "search/grid.py",
                              "chunkloop_block"),
+                )),
+                BlockSpec("heartbeat", "HEARTBEAT_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "obs/heartbeat.py",
+                             "heartbeat_block"),
                 )),
             ),
             launch_paths=(

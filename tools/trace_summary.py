@@ -215,12 +215,31 @@ def summarize(events: List[Dict[str, Any]], top: int = 12,
         elif e.get("name") == "dataplane.tile":
             tiled_bytes += int(args.get("bytes", 0) or 0)
     n_launches = asyncs.get("launch", 0)
+    # under chunk_loop="scan" one launch executes a whole segment (13
+    # chunks -> 1 launch), so a raw per-launch average is misleading:
+    # normalize by scanned steps instead, and label which denominator
+    # the digest used so the printed line stays honest either way
+    scan_steps = 0
+    n_scan_spans = 0
+    for e in spans:
+        if e.get("name") == "chunkloop.scan":
+            n_scan_spans += 1
+            scan_steps += int(
+                (e.get("args") or {}).get("n_chunks", 0) or 0)
+    if scan_steps:
+        launch_units = scan_steps + max(0, n_launches - n_scan_spans)
+        launch_unit = "scanned step"
+    else:
+        launch_units = n_launches
+        launch_unit = "launch"
     h2d = {
         "bytes_total": h2d_bytes,
         "n_uploads": h2d_uploads,
         "bytes_tiled_on_device": tiled_bytes,
-        "bytes_per_launch": round(h2d_bytes / n_launches, 1)
-        if n_launches else 0.0,
+        "bytes_per_launch": round(h2d_bytes / launch_units, 1)
+        if launch_units else 0.0,
+        "launch_unit": launch_unit,
+        "n_launch_units": launch_units,
     }
     # compile digest from the AOT spans: the compile wall (sst-compile
     # thread) next to the program store's traffic (programstore.load /
@@ -273,6 +292,9 @@ def summarize(events: List[Dict[str, Any]], top: int = 12,
     }
     compile_digest = {
         "compile_wall_ms": round(compile_ms, 3),
+        "compile_ms_per_launch": round(compile_ms / launch_units, 3)
+        if launch_units else 0.0,
+        "launch_unit": launch_unit,
         "store_loads": store_loads,
         "store_hits": store_hits,
         "store_hit_rate": round(store_hits / store_loads, 4)
@@ -326,11 +348,13 @@ def format_summary(s: Dict[str, Any]) -> str:
         out.append(f"\nasync spans: {counts}")
     h2d = s.get("h2d") or {}
     if h2d.get("n_uploads"):
+        unit = h2d.get("launch_unit", "launch")
         out.append(
             f"\nbytes host->device: "
             f"{h2d['bytes_total'] / 1e6:.3f} MB over "
             f"{h2d['n_uploads']} uploads "
-            f"({h2d['bytes_per_launch'] / 1e6:.3f} MB per launch); "
+            f"({h2d['bytes_per_launch'] / 1e6:.3f} MB per {unit}, "
+            f"over {h2d.get('n_launch_units', 0)} {unit}(s)); "
             f"{h2d['bytes_tiled_on_device'] / 1e6:.3f} MB tiled "
             "on-device (no transfer)")
     mem = s.get("memory") or {}
@@ -368,7 +392,9 @@ def format_summary(s: Dict[str, Any]) -> str:
     comp = s.get("compile") or {}
     if comp.get("compile_wall_ms") or comp.get("store_loads"):
         out.append(
-            f"compile: {comp['compile_wall_ms'] / 1e3:.2f} s wall; "
+            f"compile: {comp['compile_wall_ms'] / 1e3:.2f} s wall "
+            f"({comp.get('compile_ms_per_launch', 0.0):.1f} ms per "
+            f"{comp.get('launch_unit', 'launch')}); "
             f"program store {comp['store_hits']}/{comp['store_loads']} "
             f"hits ({100 * comp['store_hit_rate']:.0f}%), "
             f"{comp['store_bytes_loaded'] / 1e6:.3f} MB loaded, "
