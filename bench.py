@@ -1021,6 +1021,49 @@ def leg_serve_contended(cache_dir=None, n_rows=242, n_candidates=48,
                 blk["searches_per_min"] / off, 4) if off else None
     finally:
         sess_off.stop()
+    # warm-restart cost (serve/journal.py): a journaled non-terminal
+    # submission left behind by a "previous process" (stale dead-owner
+    # lease) is recovered through TpuSession.recover()/resubmit().
+    # time_to_recover_s is the telemetry gauge — journal scan at
+    # session construction to the first successful re-admission — the
+    # bench_trend watched column for restart-latency regressions.
+    import shutil
+    import tempfile
+
+    from spark_sklearn_tpu.serve.journal import (ServiceJournal,
+                                                 data_fingerprint)
+    jdir = tempfile.mkdtemp(prefix="sst-bench-recover-")
+    try:
+        prev = ServiceJournal(jdir, owner="bench-previous")
+        prev.record_submission(
+            "bench/s1", tenant="bench", weight=1.0,
+            family="LogisticRegression", structure_digest="bench",
+            data_fingerprint=data_fingerprint(X, y))
+        handle = prev.qualify("bench/s1")
+        dead = subprocess.Popen([sys.executable, "-c", "pass"])
+        dead.wait()
+        with open(os.path.join(jdir, "service-lease.json"), "w") as f:
+            json.dump({"pid": dead.pid, "owner": "bench-previous",
+                       "ts_unix_s": time.time() - 3600,
+                       "timeout_s": 30.0}, f)
+        rsess = sst.createLocalTpuSession(
+            "bench-serve-recover",
+            config=sst.TpuConfig(service_journal_dir=jdir,
+                                 telemetry_port=0))
+        try:
+            rsess.resubmit(handle, search(tenant="bench"), X,
+                           y).result()
+            rec = tel.get_telemetry().snapshot().get("recovery") or {}
+            out["recovery"] = {
+                "time_to_recover_s": rec.get("time_to_recover_s"),
+                "recovered_total": rec.get("recovered_total"),
+                "lease_takeovers_total": rec.get(
+                    "lease_takeovers_total"),
+            }
+        finally:
+            rsess.stop()
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
     return out
 
 

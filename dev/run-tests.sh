@@ -734,6 +734,16 @@ JAX_PLATFORMS=cpu python tools/sst_soak.py --tenants 2 --searches 3 \
     --plan "transient@1;oom_deep@2;fatal_deep@3;slow@3:0.3;hung@5;submit_storm@0x6" \
     --deadline 120 --max-p95 60
 
+echo "== crash-recovery smoke (journal + kill -9 + lease fence + warm restart) =="
+# the crash-safe service layer (serve/journal.py) end to end: a child
+# process journals a submission and is SIGKILLed once its checkpoint
+# journal holds a durable chunk; the harness then fences the dead
+# owner's lease, dumps the crash-marker bundle, recovers the journaled
+# search through TpuSession.recover()/resubmit(), and asserts the
+# recovered cv_results_ is np.array_equal to the uncrashed baseline
+# with nothing left owed in the journal
+JAX_PLATFORMS=cpu python tools/sst_soak.py --crash-drill
+
 echo "== search-doctor smoke (attribution + cross-run sentinel) =="
 RUNLOG_DIR=$(mktemp -d /tmp/sst_doctor_smoke_XXXX)
 JAX_PLATFORMS=cpu SST_RUNLOG_DIR="$RUNLOG_DIR" python - <<'PY'

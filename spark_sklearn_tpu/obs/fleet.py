@@ -359,6 +359,39 @@ def prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
                help_text="Blended remaining-time estimate per live "
                          "search handle (geometry model prior + "
                          "observed beat cadence).")
+    rec = snap.get("recovery") or {}
+    ln.add("sst_recovery_journal_entries_total",
+           rec.get("journal_entries_total"), mtype="counter",
+           help_text="Verified service-journal WAL records the restart "
+                     "scan read.")
+    ln.add("sst_recovery_nonterminal_found_total",
+           rec.get("nonterminal_found_total"), mtype="counter",
+           help_text="Journaled searches found non-terminal at warm "
+                     "restart.")
+    ln.add("sst_recovery_recovered_total", rec.get("recovered_total"),
+           mtype="counter",
+           help_text="Searches re-admitted through "
+                     "TpuSession.resubmit().")
+    ln.add("sst_recovery_mismatch_total", rec.get("mismatch_total"),
+           mtype="counter",
+           help_text="Resubmissions refused on a data-fingerprint "
+                     "mismatch (RecoveryDataMismatchError).")
+    ln.add("sst_recovery_lease_takeovers_total",
+           rec.get("lease_takeovers_total"), mtype="counter",
+           help_text="Stale service-journal leases fenced and taken "
+                     "over.")
+    ln.add("sst_recovery_lease_conflicts_total",
+           rec.get("lease_conflicts_total"), mtype="counter",
+           help_text="Lease acquisitions refused by a live owner "
+                     "(ServiceLeaseError).")
+    ln.add("sst_recovery_unclean_shutdowns_total",
+           rec.get("unclean_shutdowns_total"), mtype="counter",
+           help_text="Takeovers implying the previous owner died "
+                     "without a clean shutdown.")
+    ln.add("sst_recovery_time_to_recover_seconds",
+           rec.get("time_to_recover_s"),
+           help_text="Seconds from the restart's journal scan to its "
+                     "first successful resubmit.")
     return ln.text()
 
 

@@ -40,6 +40,7 @@ __all__ = [
     "ATTRIBUTION_BLOCK_SCHEMA",
     "PROTECTION_BLOCK_SCHEMA",
     "HEARTBEAT_BLOCK_SCHEMA",
+    "RECOVERY_BLOCK_SCHEMA",
     "TELEMETRY_SNAPSHOT_SCHEMA",
     "search_registry",
     "schema_markdown",
@@ -907,6 +908,44 @@ HEARTBEAT_BLOCK_SCHEMA = (
 )
 
 
+#: pinned keys of the telemetry snapshot's ``recovery`` block — the
+#: crash-safe service's counters (``serve/journal.py``: durable
+#: submission WAL under ``TpuConfig.service_journal_dir`` /
+#: ``SST_SERVICE_JOURNAL_DIR``, lease fencing, warm restart).  The
+#: zeroed shape renders when no journal is configured.
+RECOVERY_BLOCK_SCHEMA = (
+    MetricDef("journal_entries_total", "counter",
+              "Verified WAL records the restart scan read from the "
+              "service journal."),
+    MetricDef("nonterminal_found_total", "counter",
+              "Journaled searches whose last transition was "
+              "non-terminal at restart — what the warm restart owed "
+              "the caller."),
+    MetricDef("recovered_total", "counter",
+              "Searches re-admitted through TpuSession.resubmit() "
+              "(fingerprint-verified, checkpoint journal replayed)."),
+    MetricDef("mismatch_total", "counter",
+              "Resubmissions refused because the re-bound data's "
+              "blake2b fingerprint did not match the journaled one "
+              "(RecoveryDataMismatchError)."),
+    MetricDef("lease_takeovers_total", "counter",
+              "Stale leases fenced: the previous owner was dead (or "
+              "silent past service_lease_timeout_s) and this process "
+              "took the journal directory over."),
+    MetricDef("lease_conflicts_total", "counter",
+              "Lease acquisitions refused because a LIVE owner held a "
+              "fresh stamp (ServiceLeaseError)."),
+    MetricDef("unclean_shutdowns_total", "counter",
+              "Takeovers that implied the previous owner died without "
+              "release_lease — each dumps a crash-marker flight "
+              "bundle."),
+    MetricDef("time_to_recover_s", "gauge",
+              "Seconds from this process's journal scan to its first "
+              "successful resubmit — the operator-facing warm-restart "
+              "latency."),
+)
+
+
 #: top-level keys of ``TpuSession.telemetry_snapshot()`` — the fleet
 #: telemetry service's JSON view (``obs/telemetry.py``), also served
 #: as ``/snapshot.json`` (and rendered to Prometheus text) by the
@@ -974,6 +1013,13 @@ TELEMETRY_SNAPSHOT_SCHEMA = (
               "and the per-tenant lane exchange (lanes borrowed on "
               "peers' launches / donated to peers) — also rendered "
               "as the sst_fusion_* Prometheus family."),
+    MetricDef("recovery", "struct",
+              "Crash-safe service totals (serve/journal.py): WAL "
+              "entries scanned, non-terminal searches found and "
+              "recovered at warm restart, fingerprint mismatches, "
+              "lease fencing verdicts and time-to-recover — keys "
+              "pinned in RECOVERY_BLOCK_SCHEMA, also rendered as the "
+              "sst_recovery_* Prometheus family."),
     MetricDef("flight", "struct",
               "Flight-recorder state: records seen, ring occupancy, "
               "black-box bundles dumped."),
@@ -1251,6 +1297,17 @@ def schema_markdown() -> str:
         "`obs/heartbeat.py`).\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in HEARTBEAT_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### telemetry `recovery` block\n")
+    out.append(
+        "\nThe crash-safe service's counters "
+        "(`spark_sklearn_tpu/serve/journal.py`: durable submission "
+        "WAL under `TpuConfig.service_journal_dir` / "
+        "`SST_SERVICE_JOURNAL_DIR`, lease fencing, warm restart) — "
+        "the `recovery` key of the telemetry snapshot, zeroed when no "
+        "journal is configured.\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in RECOVERY_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `TpuSession.telemetry_snapshot()` / fleet "
                "endpoint schema\n")

@@ -171,6 +171,11 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "One fused launch: same-key chunks from several searches "
             "coalesced into a single wide device program (carries "
             "n_members, lanes, cost)."),
+    # serve/journal.py
+    SpanDef("journal.append", "span", "serve.journal",
+            "One durable service-journal append (checksummed WAL "
+            "record, flushed + fsynced before the submit/transition "
+            "proceeds; carries kind)."),
     # obs/telemetry.py
     SpanDef("telemetry.sample", "span", "obs.telemetry",
             "One fleet-telemetry sampler tick (provider polls)."),
@@ -194,6 +199,9 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
     # utils/session.py
     SpanDef("session.init", "span", "utils.session",
             "TpuSession bootstrap (mesh, caches, fault plan)."),
+    SpanDef("session.recover", "span", "utils.session",
+            "Warm-restart scan: the service journal's non-terminal "
+            "entries folded into a RecoveryReport."),
     # obs/log.py
     SpanDef("log", "instant", "obs.log",
             "A stdout-parity verbose line mirrored onto the timeline."),

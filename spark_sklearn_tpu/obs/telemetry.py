@@ -80,6 +80,7 @@ __all__ = [
     "note_launch",
     "note_programstore",
     "note_protection",
+    "note_recovery",
     "note_sched_busy",
     "percentile",
     "resolve_flight_dir",
@@ -371,6 +372,14 @@ def _zero_regression() -> Dict[str, Any]:
             "last_family": "", "last_flags": []}
 
 
+def _zero_recovery() -> Dict[str, Any]:
+    """The recovery block's zeroed shape (no journal activity yet)."""
+    return {"journal_entries_total": 0, "nonterminal_found_total": 0,
+            "recovered_total": 0, "mismatch_total": 0,
+            "lease_takeovers_total": 0, "lease_conflicts_total": 0,
+            "unclean_shutdowns_total": 0, "time_to_recover_s": 0.0}
+
+
 def _zero_fusion() -> Dict[str, int]:
     """The fusion block's zeroed counters (no fused launches yet)."""
     return {"fused_total": 0, "members_total": 0,
@@ -432,6 +441,11 @@ class TelemetryService:
         self._fusion: Dict[str, int] = _zero_fusion()
         self._fusion_borrowed: Dict[str, int] = {}
         self._fusion_donated: Dict[str, int] = {}
+        #: crash-safe service counters (serve/journal.py): journal
+        #: appends seen, non-terminal entries found at warm restart,
+        #: searches recovered, fingerprint mismatches, lease fencing
+        #: verdicts, and the last restart's time-to-recover
+        self._recovery: Dict[str, Any] = _zero_recovery()
         #: provider name -> STACK of zero-arg callables returning a
         #: JSON-able dict; the newest registration is polled, and
         #: unregistering it restores the previous one — so two
@@ -548,6 +562,7 @@ class TelemetryService:
             self._fusion = _zero_fusion()
             self._fusion_borrowed.clear()
             self._fusion_donated.clear()
+            self._recovery = _zero_recovery()
             self._polls.clear()
             self._n_samples = 0
 
@@ -733,6 +748,26 @@ class TelemetryService:
                 self._fusion_borrowed[name] = \
                     self._fusion_borrowed.get(name, 0) + int(n)
 
+    def note_recovery(self, kind: str, n: int = 1,
+                      time_to_recover_s: Optional[float] = None) -> None:
+        """Crash-recovery feed (serve/journal.py + utils/session.py):
+        "journal_entries" (WAL records seen at restart scan),
+        "nonterminal_found" (searches a restart owed), "recovered"
+        (re-admitted through :meth:`TpuSession.resubmit`), "mismatch"
+        (re-bound data failed fingerprint verification),
+        "lease_takeovers" / "lease_conflicts" / "unclean_shutdowns"
+        (fencing verdicts); ``time_to_recover_s`` stamps the restart's
+        first successful resubmit latency."""
+        if not self.enabled:
+            return
+        with self._lock:
+            key = f"{kind}_total"
+            if key in self._recovery:
+                self._recovery[key] += int(n)
+            if time_to_recover_s is not None:
+                self._recovery["time_to_recover_s"] = round(
+                    float(time_to_recover_s), 6)
+
     def note_regression(self, status: str, family: str,
                         flags: Optional[List[Dict[str, Any]]] = None,
                         ) -> None:
@@ -887,6 +922,20 @@ class TelemetryService:
                     "deadline_hit", 0),
             }
 
+    def _recovery_block(self) -> Dict[str, Any]:
+        with self._lock:
+            r = self._recovery
+            return {
+                "journal_entries_total": r["journal_entries_total"],
+                "nonterminal_found_total": r["nonterminal_found_total"],
+                "recovered_total": r["recovered_total"],
+                "mismatch_total": r["mismatch_total"],
+                "lease_takeovers_total": r["lease_takeovers_total"],
+                "lease_conflicts_total": r["lease_conflicts_total"],
+                "unclean_shutdowns_total": r["unclean_shutdowns_total"],
+                "time_to_recover_s": r["time_to_recover_s"],
+            }
+
     def _fusion_block(self) -> Dict[str, Any]:
         with self._lock:
             block: Dict[str, Any] = dict(self._fusion)
@@ -923,6 +972,7 @@ class TelemetryService:
                 "regression": self._regression_block(),
                 "protection": self._protection_block(),
                 "fusion": self._fusion_block(),
+                "recovery": self._recovery_block(),
                 "flight": _FLIGHT.stats(),
                 "heartbeat": hb_block,
             }
@@ -992,3 +1042,9 @@ def note_admission(decision: str, tenant: str = "",
 def note_protection(kind: str, n: int = 1) -> None:
     if _GLOBAL.enabled:
         _GLOBAL.note_protection(kind, n)
+
+
+def note_recovery(kind: str, n: int = 1,
+                  time_to_recover_s: Optional[float] = None) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.note_recovery(kind, n, time_to_recover_s)

@@ -1105,3 +1105,42 @@ def protection_block(config, *, deadline_hit: bool = False,
         "quarantined": quarantined,
         "verdict": verdict,
     }
+
+
+# ---------------------------------------------------------------------------
+# Crash-marker context (the service journal's unclean-shutdown bundle)
+# ---------------------------------------------------------------------------
+
+
+def crash_marker_context(nonterminal: Dict[str, Dict[str, Any]],
+                         lease_info: Optional[Dict[str, Any]] = None,
+                         ) -> Dict[str, Any]:
+    """The ``context`` block of a crash-marker flight bundle.
+
+    Dumped by a session that fences a stale service-journal lease
+    (serve/journal.py): the previous owner died without a clean
+    shutdown, and the bundle's context names who it was, how stale its
+    heartbeat stamp had grown, and every search it still owed —
+    exactly what the postmortem (and ``tools/sst_doctor.py``) needs
+    before the recovered searches overwrite the scene."""
+    lease_info = dict(lease_info or {})
+    prev = dict(lease_info.get("previous") or {})
+    owed = []
+    for handle in sorted(nonterminal):
+        rec = nonterminal[handle]
+        owed.append({
+            "handle": handle,
+            "tenant": str(rec.get("tenant", "")),
+            "state": str(rec.get("state", "")),
+            "family": str(rec.get("family", "")),
+            "structure_digest": str(rec.get("structure_digest", "")),
+            "checkpoint_dir": str(rec.get("checkpoint_dir", "")),
+        })
+    return {
+        "crash_marker": True,
+        "previous_pid": int(prev.get("pid", 0) or 0),
+        "previous_owner": str(prev.get("owner", "")),
+        "lease_stamp_unix_s": float(prev.get("ts_unix_s", 0.0) or 0.0),
+        "n_nonterminal": len(owed),
+        "nonterminal": owed,
+    }

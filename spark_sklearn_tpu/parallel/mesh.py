@@ -389,6 +389,21 @@ class TpuConfig:
     # planning decision, never OOM trial-and-error.  None defers to
     # SST_STREAM_SHARD_BYTES, then 64 MiB.
     stream_shard_bytes: Optional[int] = None
+    # ---- crash-safe service (serve/journal.py) ----
+    # durable submission journal: every executor submission and state
+    # transition appends a checksummed, fsynced record here, the
+    # lease file fences concurrent owners, and a restarted session
+    # recovers non-terminal searches via TpuSession.recover().  None
+    # defers to SST_SERVICE_JOURNAL_DIR; unset disables the journal
+    # entirely — an exact no-op: zero writes, byte-identical reports
+    # and cv_results_.
+    service_journal_dir: Optional[str] = None
+    # how stale the lease's heartbeat stamp may grow before a restarted
+    # process may fence a silent owner and take the journal over.  A
+    # LIVE owner with a fresh stamp always wins (ServiceLeaseError for
+    # the newcomer).  None defers to SST_SERVICE_LEASE_TIMEOUT_S, then
+    # 30 seconds.
+    service_lease_timeout_s: Optional[float] = None
 
     def resolve_devices(self):
         return list(self.devices) if self.devices is not None else jax.devices()

@@ -29,8 +29,26 @@ from spark_sklearn_tpu.serve.executor import (
     resolve_tenant,
     resolve_weight,
 )
+from spark_sklearn_tpu.serve.journal import (
+    RecoveryDataMismatchError,
+    RecoveryEntry,
+    RecoveryReport,
+    ServiceJournal,
+    ServiceLeaseError,
+    activate_service_journal,
+    data_fingerprint,
+    resolve_service_journal_dir,
+)
 
 __all__ = [
+    "RecoveryDataMismatchError",
+    "RecoveryEntry",
+    "RecoveryReport",
+    "ServiceJournal",
+    "ServiceLeaseError",
+    "activate_service_journal",
+    "data_fingerprint",
+    "resolve_service_journal_dir",
     "DEFAULT_TENANT",
     "AdmissionError",
     "SearchCancelledError",

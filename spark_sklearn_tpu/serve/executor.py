@@ -75,6 +75,7 @@ from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer, set_correlation
 from spark_sklearn_tpu.parallel.pipeline import FusedLaunch, LaunchItem
+from spark_sklearn_tpu.serve.journal import JOURNAL_STATE_BY_HANDLE_STATE
 from spark_sklearn_tpu.utils.locks import named_rlock
 
 logger = get_logger(__name__)
@@ -372,6 +373,12 @@ class SearchFuture:
         self._done.set()
 
     # -- consumer side ---------------------------------------------------
+    @property
+    def handle_id(self) -> str:
+        """The executor's handle id (``tenant/sN``) — what the service
+        journal links a recovered entry's successor to."""
+        return self._handle.id
+
     def done(self) -> bool:
         return self._done.is_set()
 
@@ -469,11 +476,56 @@ class SearchExecutor:
         #: hint from _pop_next to _loop: a fusable head is being held
         #: inside its fusion window — sleep a sliver, don't hot-spin
         self._fuse_defer = False
+        #: durable service journal (serve/journal.py), bound by the
+        #: session via attach_journal.  None (the default) is the
+        #: exact no-op: every _journal_note_* early-outs, zero writes.
+        self._journal = None
+
+    # -- durable journal hooks -------------------------------------------
+    def attach_journal(self, journal) -> None:
+        """Bind the session's :class:`~spark_sklearn_tpu.serve.journal.
+        ServiceJournal`.  All journal notes are called OUTSIDE
+        ``self._lock`` (the journal has its own lock and fsyncs on
+        append — never under the scheduler's lock)."""
+        self._journal = journal
+
+    def _journal_note_submitted(self, handle, search, X, y, cfg,
+                                recovered_from: str = "") -> None:
+        # caller does NOT hold self._lock
+        if self._journal is None:
+            return
+        from spark_sklearn_tpu.serve import journal as _svc_journal
+        try:
+            est = getattr(search, "estimator", None)
+            family = type(est).__name__ if est is not None \
+                else type(search).__name__
+            digest = _svc_journal.submission_digest(search, X, y)
+            fp = _svc_journal.data_fingerprint(X, y)
+        except (TypeError, ValueError) as exc:
+            # non-array data the fingerprint cannot hash: journal the
+            # submission anyway (state tracking still recovers it),
+            # just without a verifiable binding
+            logger.warning("service journal: fingerprint failed for "
+                           "%s (%r)", handle.id, exc, handle=handle.id)
+            family, digest, fp = type(search).__name__, "", ""
+        self._journal.record_submission(
+            handle.id, tenant=handle.tenant, weight=handle.weight,
+            family=family, structure_digest=digest,
+            data_fingerprint=fp,
+            checkpoint_dir=getattr(cfg, "checkpoint_dir", None) or "",
+            config=cfg, recovered_from=recovered_from)
+
+    def _journal_note_state(self, handle, state: str, **extra) -> None:
+        # caller does NOT hold self._lock
+        if self._journal is None:
+            return
+        self._journal.record_transition(handle.id, state, **extra)
 
     # -- submission ------------------------------------------------------
     def submit(self, search, X, y=None, fit_params: Optional[dict] = None,
                tenant: Optional[str] = None,
-               weight: Optional[float] = None) -> SearchFuture:
+               weight: Optional[float] = None,
+               recovered_from: str = "") -> SearchFuture:
         """Run ``search.fit(X, y, **fit_params)`` on a worker thread
         under this executor's fair-share scheduling and return a
         :class:`SearchFuture`.  Tenant identity and weight resolve from
@@ -582,7 +634,17 @@ class SearchExecutor:
             # rejection carries its machine-readable reason
             _telemetry.note_admission("rejected", tenant,
                                       getattr(exc, "reason", "") or "")
+            # the shed submission never got a handle: journal the
+            # refusal itself so the workload record is complete
+            if self._journal is not None:
+                self._journal.record_transition(
+                    f"{tenant}/rejected", "shed", tenant=tenant,
+                    reason=getattr(exc, "reason", "") or "")
             raise
+        # durable WAL entry BEFORE the future is handed back: a crash
+        # after this point leaves a non-terminal record recover() owes
+        self._journal_note_submitted(handle, search, X, y, cfg,
+                                     recovered_from=recovered_from)
         _telemetry.note_admission("queued" if queue_now else "admitted",
                                   tenant)
         return future
@@ -739,6 +801,9 @@ class SearchExecutor:
         cfg = getattr(search, "config", None) or self.config
 
         def run():
+            # durable "running" transition first thing on the worker
+            # thread — outside the executor lock, before any fit work
+            self._journal_note_state(handle, "running")
             _TLS.binding = _Binding(self, handle)
             # tenant/handle correlation: stamped onto every span and
             # structured log record this thread (and the pipeline
@@ -831,6 +896,11 @@ class SearchExecutor:
             logger.info("tenant %s: released %d data-plane byte(s) on "
                         "cancellation", release_tenant, freed,
                         tenant=release_tenant)
+        # terminal transition in the WAL (outside the lock): after this
+        # line a restart owes this search nothing
+        self._journal_note_state(
+            handle, JOURNAL_STATE_BY_HANDLE_STATE.get(handle.state,
+                                                      handle.state))
         logger.info("search %s %s (%d chunk(s) dispatched, %d fastpath)",
                     handle.id, handle.state, handle.n_dispatched,
                     handle.n_fastpath, handle=handle.id,
@@ -1558,6 +1628,10 @@ class SearchExecutor:
         for handle, future, _ in pending:
             handle.cancelled = True
             handle.state = "cancelled"
+            # a queued search cancelled by shutdown is SHED work: the
+            # journal marks it terminal so a restart does not re-admit
+            # something the operator deliberately drained
+            self._journal_note_state(handle, "shed", reason="shutdown")
             future._finish(exc)
         if wait:
             for w in workers:

@@ -1,0 +1,563 @@
+"""Durable service journal + lease-fenced recovery — the crash-safe
+service layer.
+
+The per-search checkpoint journal (``utils/checkpoint.py``) already
+makes one *search* resumable; this module makes the *service* itself
+resumable.  :class:`ServiceJournal` is a write-ahead log in
+``TpuConfig(service_journal_dir)`` / ``SST_SERVICE_JOURNAL_DIR``: the
+executor appends one checksummed record per submission (tenant,
+weight, family, compile-structure digest, X/y content fingerprints,
+checkpoint-journal directory) and per state transition (admitted →
+running → finished/cancelled/failed/shed), each line flushed + fsynced
+before the submit/transition proceeds, so a SIGKILLed process leaves a
+byte-exact account of every search the fleet owed an answer for.
+
+On restart, :meth:`TpuSession.recover` scans the journal for
+non-terminal entries and returns a :class:`RecoveryReport`; the caller
+re-binds data and resubmits through the normal admission path, with
+the journaled blake2b :func:`data_fingerprint` verified first — a
+mismatch is a clean :class:`RecoveryDataMismatchError`, never a
+silently-wrong resume.  Each recovered search then replays its own
+per-search checkpoint journal, so recovered ``cv_results_`` are
+bit-exact vs the uncrashed run.
+
+**Lease fencing**: a heartbeat-stamped ``service-lease.json`` in the
+journal directory names the live owner.  A second live process gets a
+structured :class:`ServiceLeaseError` at session init; a stale lease
+(owner dead, or its stamp older than ``service_lease_timeout_s`` /
+``SST_SERVICE_LEASE_TIMEOUT_S``) is fenced and taken over, and the
+unclean shutdown it implies dumps a crash-marker flight bundle
+(``parallel/faults.crash_marker_context``) for the postmortem.
+
+No journal directory configured is the exact no-op: zero writes, zero
+reads, byte-identical reports and ``cv_results_``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_sklearn_tpu.obs.log import get_logger
+from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.utils.atomic import atomic_write, fsync_dir
+from spark_sklearn_tpu.utils.locks import named_lock
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "DEFAULT_LEASE_TIMEOUT_S",
+    "SERVICE_JOURNAL_FORMAT",
+    "TERMINAL_STATES",
+    "RecoveryDataMismatchError",
+    "RecoveryEntry",
+    "RecoveryReport",
+    "ServiceJournal",
+    "ServiceLeaseError",
+    "activate_service_journal",
+    "data_fingerprint",
+    "resolve_lease_timeout_s",
+    "resolve_service_journal_dir",
+    "submission_digest",
+]
+
+#: on-disk format version: bump when the record layout changes — old
+#: journals become clean empty scans, never parse errors.
+SERVICE_JOURNAL_FORMAT = 1
+
+#: how stale the lease stamp may grow before a successor may fence a
+#: still-registered (but silent) owner.
+DEFAULT_LEASE_TIMEOUT_S = 30.0
+
+#: journal states that owe the caller nothing on restart
+#: ("recovered" marks an entry whose successor submission — linked by
+#: ``recovered_from`` — carries the work from here on).
+TERMINAL_STATES = frozenset({"finished", "cancelled", "failed", "shed",
+                             "recovered"})
+
+#: executor handle states -> journal transition vocabulary.
+JOURNAL_STATE_BY_HANDLE_STATE = {"done": "finished"}
+
+JOURNAL_NAME = "service-journal.jsonl"
+LEASE_NAME = "service-lease.json"
+
+#: the TpuConfig knobs worth replaying to a recovered submission —
+#: scalars only, so the journaled summary is always JSON-able.
+_CONFIG_SUMMARY_FIELDS = (
+    "tenant", "tenant_weight", "checkpoint_dir", "search_deadline_s",
+    "partial_results", "admission_mode", "data_mode", "chunk_loop",
+    "max_tasks_per_batch",
+)
+
+
+class ServiceLeaseError(RuntimeError):
+    """The journal directory is owned by another LIVE process.
+
+    Machine-readable: ``owner_pid`` / ``owner`` / ``age_s`` /
+    ``timeout_s`` name the conflicting lease, so an operator (or a
+    supervisor loop) can decide between waiting the timeout out and
+    killing the owner."""
+
+    def __init__(self, message: str, *, path: str = "",
+                 owner: str = "", owner_pid: int = 0,
+                 age_s: float = 0.0, timeout_s: float = 0.0):
+        super().__init__(message)
+        self.path = path
+        self.owner = owner
+        self.owner_pid = int(owner_pid)
+        self.age_s = float(age_s)
+        self.timeout_s = float(timeout_s)
+
+
+class RecoveryDataMismatchError(ValueError):
+    """Re-bound data does not match the journaled fingerprint.
+
+    Raised by :meth:`TpuSession.resubmit` BEFORE any admission or
+    device work: resuming a checkpoint journal against different data
+    would silently blend two datasets' partial results."""
+
+    def __init__(self, message: str, *, handle: str = "",
+                 expected: str = "", got: str = ""):
+        super().__init__(message)
+        self.handle = handle
+        self.expected = expected
+        self.got = got
+
+
+def data_fingerprint(X, y=None) -> str:
+    """blake2b content fingerprint of a submission's data binding.
+
+    Bounded (first MiB of each buffer) + shape + dtype, like the
+    checkpoint key's sha256 fingerprint but keyed for the SERVICE
+    journal: recovery compares this against the journaled value before
+    any resume.  Sparse (CSR-like) X hashes its component arrays, so
+    the fingerprint never densifies."""
+    h = hashlib.blake2b(digest_size=16)
+    for part in (X, y):
+        if part is None:
+            h.update(b"<none>")
+            continue
+        if hasattr(part, "indptr") and hasattr(part, "indices"):
+            for comp in (part.data, part.indices, part.indptr):
+                arr = np.ascontiguousarray(comp)
+                h.update(arr.tobytes()[:1 << 20])
+            h.update(str(part.shape).encode())
+            h.update(str(getattr(part, "dtype", "")).encode())
+            continue
+        arr = np.ascontiguousarray(np.asarray(part))
+        h.update(arr.tobytes()[:1 << 20])
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+    return h.hexdigest()
+
+
+def submission_digest(search, X, y=None) -> str:
+    """Stable structural digest of a submission (family, grid, cv,
+    data shape/dtype) — display identity for journal/doctor tooling,
+    sharing the RunLog's blake2b spelling."""
+    from spark_sklearn_tpu.obs.runlog import structure_digest
+    est = getattr(search, "estimator", None)
+    family = type(est).__name__ if est is not None \
+        else type(search).__name__
+    grid = getattr(search, "param_grid", None)
+    if not isinstance(grid, dict):
+        grid = getattr(search, "param_distributions", None)
+    grid_repr = repr(sorted(grid.items())) if isinstance(grid, dict) \
+        else ""
+    return structure_digest(
+        family, grid_repr, repr(getattr(search, "cv", None)),
+        tuple(getattr(X, "shape", ()) or ()),
+        str(getattr(X, "dtype", "")),
+        tuple(getattr(y, "shape", ()) or ()))
+
+
+def resolve_service_journal_dir(config) -> Optional[str]:
+    """``TpuConfig.service_journal_dir``, else
+    ``SST_SERVICE_JOURNAL_DIR``, else None (journal off)."""
+    d = getattr(config, "service_journal_dir", None) \
+        if config is not None else None
+    if not d:
+        d = os.environ.get("SST_SERVICE_JOURNAL_DIR", "").strip() or None
+    return d
+
+
+def resolve_lease_timeout_s(config) -> float:
+    """``TpuConfig.service_lease_timeout_s``, else
+    ``SST_SERVICE_LEASE_TIMEOUT_S``, else the 30s default."""
+    t = getattr(config, "service_lease_timeout_s", None) \
+        if config is not None else None
+    if t is None:
+        env = os.environ.get("SST_SERVICE_LEASE_TIMEOUT_S", "").strip()
+        if env:
+            # a typo'd timeout fails loudly at activation, not at the
+            # first fencing decision
+            t = float(env)
+    return DEFAULT_LEASE_TIMEOUT_S if t is None else float(t)
+
+
+def _config_summary(config) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name in _CONFIG_SUMMARY_FIELDS:
+        val = getattr(config, name, None) if config is not None else None
+        if val is not None:
+            out[name] = val if isinstance(
+                val, (str, int, float, bool)) else str(val)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryEntry:
+    """One non-terminal journaled search a restarted session owes."""
+
+    handle: str
+    tenant: str
+    weight: float
+    family: str
+    structure_digest: str
+    data_fingerprint: str
+    checkpoint_dir: str
+    state: str
+    config: Dict[str, Any]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryReport:
+    """What :meth:`TpuSession.recover` found in the journal."""
+
+    entries: Tuple[RecoveryEntry, ...] = ()
+    taken_over: bool = False
+    unclean: bool = False
+    journal_dir: str = ""
+
+    @property
+    def n_nonterminal(self) -> int:
+        return len(self.entries)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "n_nonterminal": self.n_nonterminal,
+            "taken_over": self.taken_over,
+            "unclean": self.unclean,
+            "journal_dir": self.journal_dir,
+            "entries": [e.as_dict() for e in self.entries],
+        }
+
+
+class ServiceJournal:
+    """Append-only checksummed WAL of the service's submissions.
+
+    One JSON line per event, each wrapped in a RunLog-style checksummed
+    document (format key + payload sha256) and flushed + fsynced before
+    the caller proceeds — a torn tail line from a crash is skipped at
+    scan time, never a parse error.  Thread-safe: the executor's
+    dispatch, worker and shutdown paths all append."""
+
+    def __init__(self, directory: str,
+                 lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+                 owner: str = ""):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.path = os.path.join(self.directory, JOURNAL_NAME)
+        self.lease_path = os.path.join(self.directory, LEASE_NAME)
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.owner = owner or f"pid-{os.getpid()}"
+        self.lease_info: Dict[str, Any] = {}
+        self._lock = named_lock("journal.ServiceJournal._lock")
+        self._seq = 0
+        self._counts = {"appends": 0, "corrupt": 0,
+                        "lease_takeovers": 0, "lease_conflicts": 0,
+                        "unclean_shutdowns": 0}
+        self._held = False
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- record IO ---------------------------------------------------------
+    def append(self, kind: str, record: Dict[str, Any]) -> bool:
+        """Durably append one checksummed record.  Returns False on an
+        I/O failure — journaling hardens the service, it must never
+        fail a submit."""
+        payload = json.dumps(record, sort_keys=True, default=str)
+        doc = {
+            "service_journal_format": SERVICE_JOURNAL_FORMAT,
+            "kind": str(kind),
+            "payload_sha256": hashlib.sha256(
+                payload.encode()).hexdigest(),
+            "record": json.loads(payload),
+        }
+        line = json.dumps(doc) + "\n"
+        with get_tracer().span("journal.append", kind=str(kind)):
+            with self._lock:
+                self._seq += 1
+                self._counts["appends"] += 1
+                try:
+                    with open(self.path, "a") as f:
+                        f.write(line)
+                        f.flush()
+                        os.fsync(f.fileno())
+                except OSError as exc:
+                    logger.warning(
+                        "service journal: append failed (%r)", exc)
+                    return False
+        return True
+
+    def qualify(self, handle: str) -> str:
+        """Journal-unique spelling of an executor handle id.
+
+        Executor handles (``tenant/sN``) restart from s1 in every
+        process, so a recovered journal would alias old and new
+        submissions; the pid prefix keeps each process's entries
+        distinct across restarts."""
+        return f"p{os.getpid()}/{handle}"
+
+    def record_submission(self, handle: str, *, tenant: str,
+                          weight: float, family: str,
+                          structure_digest: str,
+                          data_fingerprint: str,
+                          checkpoint_dir: str = "",
+                          config=None,
+                          recovered_from: str = "") -> bool:
+        rec = {
+            "handle": self.qualify(str(handle)),
+            "tenant": str(tenant),
+            "weight": float(weight),
+            "family": str(family),
+            "structure_digest": str(structure_digest),
+            "data_fingerprint": str(data_fingerprint),
+            "checkpoint_dir": str(checkpoint_dir or ""),
+            "config": _config_summary(config),
+            "state": "admitted",
+            "ts_unix_s": time.time(),
+        }
+        if recovered_from:
+            rec["recovered_from"] = str(recovered_from)
+        return self.append("submitted", rec)
+
+    def record_transition(self, handle: str, state: str,
+                          qualify: bool = True, **extra: Any) -> bool:
+        """One state-transition record.  ``qualify=False`` addresses a
+        handle exactly as journaled (e.g. a PREVIOUS process's entry
+        being marked ``recovered`` by its successor)."""
+        hid = self.qualify(str(handle)) if qualify else str(handle)
+        rec = {"handle": hid, "state": str(state),
+               "ts_unix_s": time.time(), **extra}
+        return self.append("state", rec)
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Every verified record document, in append order.  Corrupt
+        lines (torn tail, bit rot, undecodable bytes) are counted and
+        skipped."""
+        out: List[Dict[str, Any]] = []
+        try:
+            if not (os.path.exists(self.path)
+                    and os.path.getsize(self.path) > 0):
+                return out
+        except OSError:
+            return out
+        corrupt = 0
+        # errors="replace": a crash can leave undecodable bytes in the
+        # tail line; the mangled line then fails the checksum and is
+        # skipped like any other torn record
+        with open(self.path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    corrupt += 1
+                    continue
+                if doc.get("service_journal_format") != \
+                        SERVICE_JOURNAL_FORMAT:
+                    corrupt += 1
+                    continue
+                payload = json.dumps(doc.get("record", {}),
+                                     sort_keys=True, default=str)
+                if hashlib.sha256(payload.encode()).hexdigest() != \
+                        doc.get("payload_sha256"):
+                    corrupt += 1
+                    continue
+                out.append(doc)
+        if corrupt:
+            with self._lock:
+                self._counts["corrupt"] += corrupt
+        return out
+
+    def nonterminal(self) -> Dict[str, Dict[str, Any]]:
+        """handle -> merged submission record (latest state folded in)
+        for every journaled search whose last transition is not
+        terminal — exactly what a warm restart owes the caller."""
+        subs: Dict[str, Dict[str, Any]] = {}
+        states: Dict[str, str] = {}
+        for doc in self.entries():
+            rec = doc.get("record") or {}
+            handle = str(rec.get("handle", "") or "")
+            if not handle:
+                continue
+            if doc.get("kind") == "submitted":
+                subs[handle] = rec
+                # the WAL append and a fast worker's first transition
+                # race on file order: a transition always outranks the
+                # submission's initial state, whichever landed first
+                states.setdefault(handle,
+                                  str(rec.get("state", "admitted")))
+            elif doc.get("kind") == "state":
+                states[handle] = str(rec.get("state", ""))
+        return {h: {**sub, "state": states.get(h, "")}
+                for h, sub in subs.items()
+                if states.get(h) not in TERMINAL_STATES}
+
+    # -- lease fencing -----------------------------------------------------
+    def _read_lease(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.lease_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        if pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True      # alive, owned by someone else
+        except OSError:
+            return False
+        return True
+
+    def _stamp_lease(self) -> None:
+        doc = {"pid": os.getpid(), "owner": self.owner,
+               "ts_unix_s": time.time(),
+               "timeout_s": self.lease_timeout_s}
+        atomic_write(self.lease_path, json.dumps(doc).encode())
+
+    def acquire_lease(self) -> Dict[str, Any]:
+        """Take (or fence) the journal directory's lease.
+
+        A LIVE owner with a fresh stamp raises
+        :class:`ServiceLeaseError`; a dead owner, or one whose stamp
+        aged past ``lease_timeout_s``, is fenced and taken over.  A
+        leftover lease is the unclean-shutdown marker: the previous
+        owner died without :meth:`release_lease`.  Starts the
+        heartbeat re-stamp thread on success."""
+        prev = self._read_lease()
+        now = time.time()
+        taken_over = False
+        if prev is not None and int(prev.get("pid", 0)) != os.getpid():
+            pid = int(prev.get("pid", 0))
+            age = max(0.0, now - float(prev.get("ts_unix_s", 0.0)
+                                       or 0.0))
+            if self._pid_alive(pid) and age < self.lease_timeout_s:
+                with self._lock:
+                    self._counts["lease_conflicts"] += 1
+                raise ServiceLeaseError(
+                    f"service journal {self.directory!r} is leased by "
+                    f"live process {pid} ({prev.get('owner', '?')}, "
+                    f"stamped {age:.1f}s ago, timeout "
+                    f"{self.lease_timeout_s:g}s)",
+                    path=self.lease_path,
+                    owner=str(prev.get("owner", "")), owner_pid=pid,
+                    age_s=age, timeout_s=self.lease_timeout_s)
+            taken_over = True
+            with self._lock:
+                self._counts["lease_takeovers"] += 1
+                self._counts["unclean_shutdowns"] += 1
+            logger.warning(
+                "service journal: fencing stale lease of pid %d "
+                "(%s, stamped %.1fs ago)", pid,
+                prev.get("owner", "?"), age)
+        self._stamp_lease()
+        self._held = True
+        self._start_heartbeat()
+        if taken_over:
+            self.append("lease", {
+                "event": "fenced", "owner": self.owner,
+                "previous_pid": int(prev.get("pid", 0)),
+                "previous_owner": str(prev.get("owner", "")),
+                "stale_age_s": round(age, 3),
+                "ts_unix_s": now})
+        self.lease_info = {"taken_over": taken_over,
+                           "unclean": taken_over, "previous": prev}
+        return self.lease_info
+
+    def _start_heartbeat(self) -> None:
+        period = max(0.05, self.lease_timeout_s / 3.0)
+        self._hb_stop.clear()
+        t = threading.Thread(target=self._hb_loop, args=(period,),
+                             name="sst-journal-lease", daemon=True)
+        self._hb_thread = t
+        t.start()
+
+    def _hb_loop(self, period: float) -> None:
+        while not self._hb_stop.wait(period):
+            try:
+                self._stamp_lease()
+            except OSError as exc:
+                # the next stamp retries; losing one heartbeat must
+                # not kill the service the lease protects
+                logger.debug("service lease re-stamp failed: %r", exc)
+
+    def release_lease(self, clean: bool = True) -> None:
+        """Stop the heartbeat and drop the lease.  ``clean=True``
+        journals a shutdown record first, so the next startup knows
+        this owner exited deliberately."""
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._hb_thread = None
+        if not self._held:
+            return
+        if clean:
+            self.append("shutdown", {"owner": self.owner,
+                                     "clean": True,
+                                     "ts_unix_s": time.time()})
+        try:
+            os.remove(self.lease_path)
+            fsync_dir(self.directory)
+        except OSError:
+            pass
+        self._held = False
+
+    # -- stats -------------------------------------------------------------
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def disk_stats(self) -> Dict[str, int]:
+        try:
+            size = os.path.getsize(self.path) \
+                if os.path.exists(self.path) else 0
+        except OSError:
+            size = 0
+        return {"journal_bytes": int(size)}
+
+
+def activate_service_journal(config=None,
+                             owner: str = "") -> Optional[ServiceJournal]:
+    """The service journal a session should use under ``config`` — or
+    None when no directory is configured (the exact no-op).  Acquires
+    the lease (raising :class:`ServiceLeaseError` on a live owner) and
+    leaves the takeover verdict in ``journal.lease_info``."""
+    directory = resolve_service_journal_dir(config)
+    if not directory:
+        return None
+    journal = ServiceJournal(
+        directory, lease_timeout_s=resolve_lease_timeout_s(config),
+        owner=owner)
+    journal.acquire_lease()
+    return journal

@@ -15,7 +15,30 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["atomic_write"]
+__all__ = ["atomic_write", "fsync_dir"]
+
+
+def fsync_dir(directory: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss.
+
+    ``os.replace`` makes the rename atomic against concurrent readers,
+    but the *directory entry* itself is only durable once the parent
+    directory's metadata reaches disk — without this a machine that
+    loses power right after the rename can come back with the old name
+    (or no file at all).  Best-effort: some filesystems/platforms
+    refuse ``open(dir)``/``fsync(dirfd)``, and durability hardening
+    must never turn a successful publish into an error.
+    """
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def atomic_write(path: str, payload: bytes) -> None:
@@ -27,6 +50,7 @@ def atomic_write(path: str, payload: bytes) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)

@@ -46,8 +46,15 @@ class SearchCheckpoint:
         self._done: Dict[str, Dict[str, Any]] = {}
         self._meta: Dict[str, Any] = {}
         self.faults: list = []
-        if os.path.exists(self.path):
-            with open(self.path) as f:
+        # a crash between the journal's open() and its first durable
+        # append can leave a zero-byte file (or a torn, undecodable
+        # tail): both are an EMPTY journal to resume from, never a
+        # corrupt one that aborts the search.  errors="replace" keeps
+        # text-mode iteration from raising UnicodeDecodeError on
+        # garbage bytes — the mangled line then fails json.loads and
+        # is skipped like any other torn tail.
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            with open(self.path, errors="replace") as f:
                 for line in f:
                     try:
                         rec = json.loads(line)

@@ -11,6 +11,7 @@ collectives ride ICI/DCN via XLA — SURVEY §5.8).
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 from spark_sklearn_tpu.obs.log import get_logger
@@ -81,6 +82,19 @@ class TpuSession:
             # search is actually submitted
             from spark_sklearn_tpu.serve import SearchExecutor
             self.executor = SearchExecutor(self.config, appName)
+            # the crash-safe service layer (serve/journal.py): durable
+            # submission WAL + heartbeat lease on the journal dir.
+            # Default OFF — no TpuConfig(service_journal_dir) /
+            # SST_SERVICE_JOURNAL_DIR means no object, zero writes, the
+            # exact no-op.  A second LIVE owner of the directory raises
+            # ServiceLeaseError HERE, at construction, never mid-search
+            from spark_sklearn_tpu.serve import journal as _svc_journal
+            self.journal = _svc_journal.activate_service_journal(
+                self.config, owner=f"{appName}:{os.getpid()}")
+            self._recovery_pending = {}
+            self._restart_t0 = None
+            if self.journal is not None:
+                self.executor.attach_journal(self.journal)
             # fleet telemetry (obs/telemetry.py + obs/fleet.py):
             # default OFF — no thread, no socket, hooks early-out.
             # TpuConfig(telemetry_port) / SST_TELEMETRY_PORT turns on
@@ -92,6 +106,12 @@ class TpuSession:
             self._telemetry_owned = False
             self._telemetry_providers = {}
             self._init_telemetry()
+            # the journal scan runs AFTER telemetry init so its
+            # note_recovery counters (and the crash-marker bundle's
+            # embedded snapshot) land in an enabled service; the lease
+            # itself was already fenced/acquired above
+            if self.journal is not None:
+                self._bootstrap_recovery()
         # structured logging channel (never stdout: the session has no
         # legacy print contract)
         logger.info("TpuSession %r: mesh=%s, cache_dir=%r", appName,
@@ -113,6 +133,15 @@ class TpuSession:
             "run log: %s",
             "disabled" if self.runlog is None else
             f"{self.runlog.directory} (env={self.runlog.env_digest})")
+        logger.info(
+            "service journal: %s",
+            "disabled" if self.journal is None else
+            f"{self.journal.directory} "
+            f"({len(self._recovery_pending)} non-terminal entr"
+            f"{'y' if len(self._recovery_pending) == 1 else 'ies'}, "
+            + ("fenced stale lease"
+               if (self.journal.lease_info or {}).get("taken_over")
+               else "clean lease") + ")")
         from spark_sklearn_tpu.obs import memory as _obs_memory
         from spark_sklearn_tpu.parallel import memledger as _memledger
         self.memledger = _memledger.ledger_for(self.config)
@@ -139,6 +168,37 @@ class TpuSession:
             getattr(self.config, "retry_backoff_s", 0.5),
             getattr(self.config, "launch_timeout_s", None),
             len(self.fault_plan))
+
+    def _bootstrap_recovery(self) -> None:
+        """Scan the journal at startup: count what this restart owes,
+        stamp the time-to-recover clock, and — when the lease was
+        fenced from a dead owner — dump the crash-marker flight bundle
+        BEFORE recovery overwrites the scene."""
+        from spark_sklearn_tpu.obs import telemetry as _telemetry
+        from spark_sklearn_tpu.parallel import faults as _faults
+        journal = self.journal
+        entries = journal.entries()
+        self._recovery_pending = journal.nonterminal()
+        if self._recovery_pending:
+            # the clock resubmit() stops on its first success: the
+            # operator-facing time-to-recover
+            self._restart_t0 = time.monotonic()
+        info = journal.lease_info or {}
+        _telemetry.note_recovery("journal_entries", len(entries))
+        _telemetry.note_recovery("nonterminal_found",
+                                 len(self._recovery_pending))
+        if info.get("taken_over"):
+            _telemetry.note_recovery("lease_takeovers")
+            _telemetry.note_recovery("unclean_shutdowns")
+            # no flight dir configured still gets a marker: the journal
+            # directory itself is the fallback dump target
+            _telemetry.flight_recorder().dump(
+                "crash-marker",
+                flight_dir=_telemetry.resolve_flight_dir(self.config)
+                or journal.directory,
+                config=self.config,
+                context=_faults.crash_marker_context(
+                    self._recovery_pending, info))
 
     def _init_telemetry(self) -> None:
         from spark_sklearn_tpu.obs import fleet as _fleet
@@ -252,6 +312,113 @@ class TpuSession:
         search._sst_session = self
         return search
 
+    # -- crash recovery (serve/journal.py) -------------------------------
+    def recover(self):
+        """What the service journal still owes: a
+        :class:`~spark_sklearn_tpu.serve.RecoveryReport` listing every
+        journaled search whose last transition is non-terminal (a
+        previous process was SIGKILLed mid-flight), plus the lease
+        verdict (fenced takeover vs clean start).  The empty report
+        when no journal is configured.
+
+        Recovery is two-phase by design: the journal records data
+        FINGERPRINTS, not data, so the caller re-binds X/y and passes
+        each entry to :meth:`resubmit`."""
+        from spark_sklearn_tpu.serve import journal as _svc_journal
+        if self.journal is None:
+            return _svc_journal.RecoveryReport()
+        with get_tracer().span("session.recover"):
+            self._recovery_pending = self.journal.nonterminal()
+            info = self.journal.lease_info or {}
+            entries = []
+            for handle in sorted(self._recovery_pending):
+                rec = self._recovery_pending[handle]
+                entries.append(_svc_journal.RecoveryEntry(
+                    handle=handle,
+                    tenant=str(rec.get("tenant", "")),
+                    weight=float(rec.get("weight", 1.0) or 1.0),
+                    family=str(rec.get("family", "")),
+                    structure_digest=str(
+                        rec.get("structure_digest", "")),
+                    data_fingerprint=str(
+                        rec.get("data_fingerprint", "")),
+                    checkpoint_dir=str(rec.get("checkpoint_dir", "")),
+                    state=str(rec.get("state", "")),
+                    config=dict(rec.get("config") or {})))
+            return _svc_journal.RecoveryReport(
+                entries=tuple(entries),
+                taken_over=bool(info.get("taken_over")),
+                unclean=bool(info.get("unclean")),
+                journal_dir=self.journal.directory)
+
+    def resubmit(self, entry, search, X, y=None, **fit_params):
+        """Re-admit one recovered search through the NORMAL admission
+        path and return its
+        :class:`~spark_sklearn_tpu.serve.SearchFuture`.
+
+        ``entry`` is a :class:`~spark_sklearn_tpu.serve.RecoveryEntry`
+        from :meth:`recover` (or its journal handle string).  The
+        re-bound data's blake2b fingerprint is verified against the
+        journaled one FIRST — a mismatch raises
+        :class:`~spark_sklearn_tpu.serve.RecoveryDataMismatchError`
+        before any admission or device work, because resuming a
+        checkpoint journal against different data would silently blend
+        two datasets' partial results.  With the same checkpoint
+        directory the resumed search replays its per-search journal,
+        so the recovered ``cv_results_`` is bit-exact vs the uncrashed
+        run."""
+        from spark_sklearn_tpu.obs import telemetry as _telemetry
+        from spark_sklearn_tpu.serve import journal as _svc_journal
+        if self.journal is None:
+            raise ValueError(
+                "no service journal: construct the session with "
+                "TpuConfig(service_journal_dir=...)")
+        handle = entry if isinstance(entry, str) else entry.handle
+        rec = self._recovery_pending.get(handle)
+        if rec is None:
+            raise KeyError(
+                f"no non-terminal journal entry {handle!r} "
+                "(recover() lists what this session owes)")
+        expected = str(rec.get("data_fingerprint", ""))
+        got = _svc_journal.data_fingerprint(X, y)
+        if expected and got != expected:
+            _telemetry.note_recovery("mismatch")
+            raise _svc_journal.RecoveryDataMismatchError(
+                f"recovered search {handle!r}: re-bound data does not "
+                f"match the journaled fingerprint (expected "
+                f"{expected[:12]}, got {got[:12]})",
+                handle=handle, expected=expected, got=got)
+        ckpt = str(rec.get("checkpoint_dir", "") or "")
+        cfg = getattr(search, "config", None)
+        if ckpt and not getattr(cfg, "checkpoint_dir", None) \
+                and not getattr(self.config, "checkpoint_dir", None):
+            # the recovered search must replay ITS checkpoint journal:
+            # carry the journaled directory onto the resubmission when
+            # neither the search nor the session names one
+            import dataclasses as _dc
+            base = cfg if cfg is not None else self.config
+            try:
+                search.config = _dc.replace(base, checkpoint_dir=ckpt)
+            except TypeError:
+                pass
+        fut = self.executor.submit(search, X, y, fit_params=fit_params,
+                                   recovered_from=handle)
+        # retire the journaled entry, linked to its successor — the
+        # successor's own WAL lifecycle carries the work from here
+        self.journal.record_transition(
+            handle, "recovered", qualify=False,
+            successor=self.journal.qualify(fut.handle_id))
+        self._recovery_pending.pop(handle, None)
+        if self._restart_t0 is not None:
+            # first successful resubmit stops the restart clock
+            _telemetry.note_recovery(
+                "recovered",
+                time_to_recover_s=time.monotonic() - self._restart_t0)
+            self._restart_t0 = None
+        else:
+            _telemetry.note_recovery("recovered")
+        return fut
+
     def executor_stats(self) -> dict:
         """The executor's live state: active/pending search counts and
         per-tenant queue/in-flight/dispatched-cost tallies."""
@@ -323,6 +490,10 @@ class TpuSession:
         waiting line cancels, new submissions raise AdmissionError.
         A session-owned telemetry endpoint and sampler stop too."""
         self.executor.shutdown()
+        if self.journal is not None:
+            # AFTER executor shutdown, so the pending line's "shed"
+            # transitions land before the clean-shutdown record
+            self.journal.release_lease(clean=True)
         if self.fleet_endpoint is not None:
             self.fleet_endpoint.stop()
             self.fleet_endpoint = None

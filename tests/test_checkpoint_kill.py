@@ -125,6 +125,110 @@ def test_mid_group_fault_retry_resume_parity(digits, tmp_path, fam):
                 err_msg=key)
 
 
+#: the family-matrix child for the kill -9 drill: brownout-stretched
+#: launches (bit-exact, just slow) widen the kill window so the SIGKILL
+#: lands genuinely mid-search for every family; launch 0 runs clean so
+#: the first chunk record is durable fast.
+_FAMILY_CHILD = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sklearn.datasets import load_digits
+{est_import}
+import spark_sklearn_tpu as sst
+
+X, y = load_digits(return_X_y=True)
+X = (X / 16.0).astype(np.float32)
+X, y = X[:240], y[:240]
+cfg = sst.TpuConfig(
+    checkpoint_dir={ckpt_dir!r},
+    fault_plan=",".join("slow@%d:0.4" % i for i in range(1, 13)),
+    **{cfg_kw!r})
+gs = sst.GridSearchCV({est_expr}, {grid!r}, cv=2, backend="tpu",
+                      refit=False, config=cfg)
+gs.fit(X, y)
+print("CHILD_FINISHED", flush=True)
+"""
+
+#: per-family child pieces for the subprocess drill (import line +
+#: constructor expression, matching _family_matrix's estimators)
+_FAMILY_CHILD_EST = {
+    "logreg": ("from sklearn.linear_model import LogisticRegression",
+               "LogisticRegression(max_iter=10)"),
+    "gnb": ("from sklearn.naive_bayes import GaussianNB",
+            "GaussianNB()"),
+    "knn": ("from sklearn.neighbors import KNeighborsClassifier",
+            "KNeighborsClassifier()"),
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fam", ["logreg", "gnb", "knn"])
+def test_sigkill_family_matrix_resume_parity(digits, tmp_path, fam):
+    """The family matrix through a REAL ``kill -9`` (not an injected
+    in-process hang): a subprocess search per family is SIGKILLed after
+    its first durable chunk — signal death exercises the
+    unflushed-buffer path the checkpoint WAL must survive — and the
+    resumed search must match an uninterrupted run bit-for-bit."""
+    make_est, grid, cfg_kw, _ = _family_matrix()[fam]
+    est_import, est_expr = _FAMILY_CHILD_EST[fam]
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+    child_src = _FAMILY_CHILD.format(
+        est_import=est_import, est_expr=est_expr,
+        ckpt_dir=ckpt_dir, cfg_kw=cfg_kw, grid=grid)
+    child = subprocess.Popen(
+        [sys.executable, "-c", child_src],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 600
+    try:
+        while time.time() < deadline:
+            n_durable = sum(
+                1 for name in os.listdir(ckpt_dir)
+                if name.endswith(".jsonl")
+                for line in open(os.path.join(ckpt_dir, name))
+                if '"chunk_id"' in line)
+            if n_durable >= 1:
+                break
+            if child.poll() is not None:
+                pytest.fail(
+                    "child exited before the kill window: "
+                    f"rc={child.returncode} "
+                    f"err={child.stderr.read()[-800:]}")
+            time.sleep(0.1)
+        else:
+            pytest.fail("no durable chunk record within the window")
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=60)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert child.returncode == -signal.SIGKILL
+
+    X, y = digits
+    Xs, ys = X[:240], y[:240]
+
+    def run(config):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return sst.GridSearchCV(
+                make_est(), grid, cv=2, refit=False, backend="tpu",
+                config=config).fit(Xs, ys)
+
+    resumed = run(sst.TpuConfig(checkpoint_dir=ckpt_dir, **cfg_kw))
+    assert resumed.search_report["n_chunks_resumed"] >= 1
+    fresh = run(sst.TpuConfig(**cfg_kw))
+    for key, col in fresh.cv_results_.items():
+        if "time" in key:
+            continue   # resumed chunks carry the DEAD run's walls
+        if key == "params":
+            assert col == resumed.cv_results_[key]
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(col), np.asarray(resumed.cv_results_[key]),
+                err_msg=key)
+
+
 @pytest.mark.slow
 def test_sigkill_mid_search_then_resume_matches_uninterrupted(
         digits, tmp_path):

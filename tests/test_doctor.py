@@ -702,6 +702,25 @@ class TestBenchTrend:
         assert digest["rows"][-1]["launches_per_group"] == 3.0
         assert "l/grp" in format_table(digest)
 
+    def test_time_to_recover_regresses_upward(self, tmp_path):
+        from tools.bench_trend import format_table, trend
+
+        # warm-restart latency (serve/journal.py) recorded by the
+        # serve leg: creep up means the recovery path got slower
+        a = _bench_round(1, 50.0, 60.0)
+        a["parsed"]["detail"]["serve_contended"] = {
+            "recovery": {"time_to_recover_s": 0.2}}
+        b = _bench_round(2, 50.0, 60.0)
+        b["parsed"]["detail"]["serve_contended"] = {
+            "recovery": {"time_to_recover_s": 2.5}}
+        self._write(tmp_path, [a, b])
+        digest = trend(str(tmp_path))
+        cmp_ = digest["comparison"]
+        assert [f["metric"] for f in cmp_["flags"]] == \
+            ["time_to_recover_s"]
+        assert digest["rows"][-1]["time_to_recover_s"] == 2.5
+        assert "ttr s" in format_table(digest)
+
     def test_unparsed_rounds_are_skipped(self, tmp_path):
         from tools.bench_trend import trend
 

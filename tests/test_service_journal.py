@@ -1,0 +1,515 @@
+"""The crash-safe service layer (serve/journal.py + session recovery).
+
+Covers the durable submission WAL (checksummed appends, torn-tail
+tolerance, the submit/worker append-order race), lease fencing (live
+conflict, dead-owner takeover, clean release), the two-phase warm
+restart (`TpuSession.recover()` → `resubmit()` with fingerprint
+verification and checkpoint-journal replay), and the two hardening
+satellites that ride with it: `utils/atomic.py` rename durability and
+`utils/checkpoint.py` zero-byte journal tolerance.  The REAL kill -9
+arc lives in `tools/sst_soak.py --crash-drill` (run as a
+`dev/run-tests.sh` leg) and `tests/test_checkpoint_kill.py`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.serve import journal as svc_journal
+from spark_sklearn_tpu.serve.journal import (
+    RecoveryDataMismatchError,
+    ServiceJournal,
+    ServiceLeaseError,
+    data_fingerprint,
+    submission_digest,
+)
+from spark_sklearn_tpu.utils import atomic
+
+rng = np.random.RandomState(3)
+X = rng.randn(96, 6).astype(np.float32)
+y = (X[:, 0] + 0.25 * rng.randn(96) > 0).astype(np.int64)
+
+
+def _dead_pid():
+    """A pid guaranteed dead: a child that already exited."""
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+def _write_lease(journal_dir, pid, age_s=0.0, owner="prev-owner"):
+    os.makedirs(journal_dir, exist_ok=True)
+    with open(os.path.join(journal_dir,
+                           svc_journal.LEASE_NAME), "w") as f:
+        json.dump({"pid": pid, "owner": owner,
+                   "ts_unix_s": time.time() - age_s,
+                   "timeout_s": 30.0}, f)
+
+
+def _search(config=None, n=12):
+    from sklearn.linear_model import LogisticRegression
+    return sst.GridSearchCV(
+        LogisticRegression(max_iter=10),
+        {"C": np.logspace(-2, 1, n).tolist()}, cv=2, refit=False,
+        backend="tpu", config=config)
+
+
+# ---------------------------------------------------------------------------
+# satellite: utils/atomic.py rename durability
+# ---------------------------------------------------------------------------
+class TestAtomicWrite:
+    def test_publish_fsyncs_parent_directory(self, tmp_path,
+                                             monkeypatch):
+        """os.replace alone leaves the directory ENTRY volatile; the
+        publish must fsync the parent dir afterwards."""
+        synced = []
+        real = atomic.fsync_dir
+        monkeypatch.setattr(atomic, "fsync_dir",
+                            lambda d: (synced.append(d), real(d))[1])
+        target = tmp_path / "artifact.json"
+        atomic.atomic_write(str(target), b'{"ok": 1}')
+        assert target.read_bytes() == b'{"ok": 1}'
+        assert synced == [str(tmp_path)]
+
+    def test_torn_rename_preserves_old_content(self, tmp_path,
+                                               monkeypatch):
+        """A rename that dies mid-publish must leave the OLD content
+        intact and no temp debris — never a torn file."""
+        target = tmp_path / "artifact.json"
+        target.write_bytes(b"old")
+
+        def boom(src, dst):
+            raise OSError("simulated crash at rename")
+
+        monkeypatch.setattr(atomic.os, "replace", boom)
+        with pytest.raises(OSError, match="simulated crash"):
+            atomic.atomic_write(str(target), b"new")
+        assert target.read_bytes() == b"old"
+        assert [p.name for p in tmp_path.iterdir()] == ["artifact.json"]
+
+    def test_fsync_dir_is_best_effort(self, tmp_path):
+        # durability hardening must never fail a successful publish
+        atomic.fsync_dir(str(tmp_path / "no-such-dir"))
+        atomic.fsync_dir("")
+
+
+# ---------------------------------------------------------------------------
+# satellite: utils/checkpoint.py crash-debris tolerance
+# ---------------------------------------------------------------------------
+class TestCheckpointCrashDebris:
+    def test_zero_byte_journal_is_empty_not_corrupt(self, tmp_path):
+        """A crash between open() and the first append leaves a
+        zero-byte file: an EMPTY journal to resume from."""
+        from spark_sklearn_tpu.utils.checkpoint import SearchCheckpoint
+        j1 = SearchCheckpoint(str(tmp_path), "k1")
+        open(j1.path, "w").close()
+        assert os.path.getsize(j1.path) == 0
+        j2 = SearchCheckpoint(str(tmp_path), "k1")
+        assert j2.n_done == 0 and j2.faults == []
+        j2.put("c0", {"scores": [1.0]})
+        assert SearchCheckpoint(str(tmp_path), "k1").n_done == 1
+
+    def test_garbage_tail_bytes_skipped(self, tmp_path):
+        """Undecodable bytes in the tail (torn fsync) must not abort
+        the resume — the good prefix survives."""
+        from spark_sklearn_tpu.utils.checkpoint import SearchCheckpoint
+        j1 = SearchCheckpoint(str(tmp_path), "k2")
+        j1.put("c0", {"scores": [0.5]})
+        with open(j1.path, "ab") as f:
+            f.write(b'{"chunk_id": "c1", "scor\xff\xfe\x00')
+        j2 = SearchCheckpoint(str(tmp_path), "k2")
+        assert j2.n_done == 1
+        assert j2.get("c0")["scores"] == [0.5]
+
+
+# ---------------------------------------------------------------------------
+# the WAL itself
+# ---------------------------------------------------------------------------
+class TestServiceJournalWAL:
+    def test_roundtrip_checksummed_records(self, tmp_path):
+        j = ServiceJournal(str(tmp_path))
+        assert j.record_submission(
+            "t/s1", tenant="t", weight=2.0, family="LogisticRegression",
+            structure_digest="deadbeef", data_fingerprint="feedface",
+            checkpoint_dir="/ckpt")
+        assert j.record_transition("t/s1", "running")
+        assert j.record_transition("t/s1", "finished")
+        docs = j.entries()
+        assert [d["kind"] for d in docs] == ["submitted", "state",
+                                             "state"]
+        for d in docs:
+            assert d["service_journal_format"] == 1
+            payload = json.dumps(d["record"], sort_keys=True,
+                                 default=str)
+            import hashlib
+            assert d["payload_sha256"] == hashlib.sha256(
+                payload.encode()).hexdigest()
+        sub = docs[0]["record"]
+        qualified = j.qualify("t/s1")
+        assert sub["handle"] == qualified
+        assert sub["tenant"] == "t" and sub["weight"] == 2.0
+        assert sub["checkpoint_dir"] == "/ckpt"
+        assert j.nonterminal() == {}
+        assert j.counts()["appends"] == 3
+
+    def test_corrupt_and_torn_lines_skipped_and_counted(self,
+                                                        tmp_path):
+        j = ServiceJournal(str(tmp_path))
+        j.record_submission("t/s1", tenant="t", weight=1.0,
+                            family="F", structure_digest="d",
+                            data_fingerprint="f")
+        with open(j.path, "a") as f:
+            f.write("not json at all\n")
+            f.write(json.dumps({"service_journal_format": 99,
+                                "kind": "state", "record": {}}) + "\n")
+            f.write(json.dumps({
+                "service_journal_format": 1, "kind": "state",
+                "payload_sha256": "0" * 64,
+                "record": {"handle": "t/s1",
+                           "state": "finished"}}) + "\n")
+        with open(j.path, "ab") as f:
+            f.write(b'{"torn\xff\xfe')
+        docs = j.entries()
+        assert len(docs) == 1 and docs[0]["kind"] == "submitted"
+        assert j.counts()["corrupt"] == 4
+        # the forged terminal transition failed its checksum, so the
+        # entry is still owed
+        assert list(j.nonterminal()) == [j.qualify("t/s1")]
+
+    def test_zero_byte_service_journal_is_empty(self, tmp_path):
+        j = ServiceJournal(str(tmp_path))
+        open(j.path, "w").close()
+        assert j.entries() == []
+        assert j.nonterminal() == {}
+
+    def test_append_order_race_never_resurrects(self, tmp_path):
+        """A fast worker's 'running'/'finished' transitions can land
+        BEFORE the submit thread's 'submitted' line; the fold must
+        still see the terminal state."""
+        j = ServiceJournal(str(tmp_path))
+        h = j.qualify("t/s1")
+        j.record_transition("t/s1", "running")
+        j.record_transition("t/s1", "finished")
+        j.record_submission("t/s1", tenant="t", weight=1.0,
+                            family="F", structure_digest="d",
+                            data_fingerprint="f")
+        assert j.nonterminal() == {}
+        # ...while a genuinely mid-flight entry IS owed, latest state
+        j.record_transition("t/s2", "running")
+        j.record_submission("t/s2", tenant="t", weight=1.0,
+                            family="F", structure_digest="d",
+                            data_fingerprint="f")
+        owed = j.nonterminal()
+        assert list(owed) == [j.qualify("t/s2")]
+        assert owed[j.qualify("t/s2")]["state"] == "running"
+        assert h not in owed
+
+    def test_fingerprints_and_digest(self):
+        f1 = data_fingerprint(X, y)
+        assert f1 == data_fingerprint(X, y)
+        assert f1 != data_fingerprint(X + 1e-3, y)
+        assert f1 != data_fingerprint(X)          # y participates
+        sp = pytest.importorskip("scipy.sparse")
+        Xs = sp.csr_matrix(X)
+        fs = data_fingerprint(Xs, y)
+        assert fs == data_fingerprint(sp.csr_matrix(X), y)
+        assert fs != f1                            # never densified
+        s1 = _search()
+        s2 = _search()
+        assert submission_digest(s1, X, y) == submission_digest(
+            s2, X, y)
+        assert submission_digest(s1, X, y) != submission_digest(
+            _search(n=8), X, y)
+
+
+# ---------------------------------------------------------------------------
+# lease fencing
+# ---------------------------------------------------------------------------
+class TestLeaseFencing:
+    def test_dead_owner_is_fenced(self, tmp_path):
+        _write_lease(str(tmp_path), _dead_pid(), age_s=1.0)
+        j = ServiceJournal(str(tmp_path), owner="successor")
+        try:
+            info = j.acquire_lease()
+        finally:
+            j.release_lease(clean=False)
+        assert info["taken_over"] and info["unclean"]
+        assert j.counts()["lease_takeovers"] == 1
+        assert j.counts()["unclean_shutdowns"] == 1
+        # the fencing itself is journaled for the postmortem
+        kinds = [d["kind"] for d in j.entries()]
+        assert "lease" in kinds
+
+    def test_stale_stamp_of_live_pid_is_fenced(self, tmp_path):
+        # our OWN pid is alive, but acquire_lease short-circuits on it;
+        # use a live child instead, with a stamp far past the timeout
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            _write_lease(str(tmp_path), child.pid, age_s=500.0)
+            j = ServiceJournal(str(tmp_path), lease_timeout_s=1.0,
+                               owner="successor")
+            try:
+                info = j.acquire_lease()
+            finally:
+                j.release_lease(clean=False)
+            assert info["taken_over"]
+        finally:
+            child.kill()
+            child.wait()
+
+    def test_live_fresh_owner_conflicts(self, tmp_path):
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            _write_lease(str(tmp_path), child.pid, age_s=0.0)
+            j = ServiceJournal(str(tmp_path), owner="intruder")
+            with pytest.raises(ServiceLeaseError) as ei:
+                j.acquire_lease()
+            assert ei.value.owner_pid == child.pid
+            assert ei.value.owner == "prev-owner"
+            assert ei.value.timeout_s == 30.0
+            assert j.counts()["lease_conflicts"] == 1
+        finally:
+            child.kill()
+            child.wait()
+
+    def test_clean_release_removes_lease_and_journals_shutdown(
+            self, tmp_path):
+        j = ServiceJournal(str(tmp_path), owner="me")
+        j.acquire_lease()
+        assert os.path.exists(j.lease_path)
+        j.release_lease(clean=True)
+        assert not os.path.exists(j.lease_path)
+        kinds = [d["kind"] for d in j.entries()]
+        assert kinds[-1] == "shutdown"
+        assert j.entries()[-1]["record"]["clean"] is True
+
+    def test_heartbeat_restamps(self, tmp_path):
+        j = ServiceJournal(str(tmp_path), lease_timeout_s=0.3,
+                           owner="hb")
+        j.acquire_lease()
+        try:
+            with open(j.lease_path) as f:
+                t0 = json.load(f)["ts_unix_s"]
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                with open(j.lease_path) as f:
+                    if json.load(f)["ts_unix_s"] > t0:
+                        break
+                time.sleep(0.05)
+            else:
+                pytest.fail("lease heartbeat never re-stamped")
+        finally:
+            j.release_lease(clean=False)
+
+
+# ---------------------------------------------------------------------------
+# the session: default-off no-op, live lifecycle, warm restart
+# ---------------------------------------------------------------------------
+class TestSessionRecovery:
+    def test_default_off_is_exact_noop(self, tmp_path):
+        """No journal dir configured: no journal object, no files, an
+        empty RecoveryReport, and resubmit refuses cleanly."""
+        sess = sst.createLocalTpuSession(
+            "journal-off", sst.TpuConfig(max_tasks_per_batch=8))
+        try:
+            assert sess.journal is None
+            report = sess.recover()
+            assert report.n_nonterminal == 0
+            assert not report.taken_over and not report.unclean
+            with pytest.raises(ValueError, match="no service journal"):
+                sess.resubmit("p1/t/s1", _search(), X, y)
+        finally:
+            sess.stop()
+        assert not any("journal" in name.lower()
+                       for name in os.listdir(str(tmp_path)))
+
+    def test_journaled_lifecycle_and_clean_shutdown(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        cfg = sst.TpuConfig(service_journal_dir=jdir,
+                            max_tasks_per_batch=8)
+        sess = sst.createLocalTpuSession("journal-live", cfg)
+        try:
+            assert sess.journal is not None
+            search = _search(cfg)
+            fut = sess.submit(search, X, y)
+            fut.result()
+            j = sess.journal
+            kinds = [d["kind"] for d in j.entries()]
+            assert "submitted" in kinds and "state" in kinds
+            states = [d["record"]["state"] for d in j.entries()
+                      if d["kind"] == "state"]
+            assert "finished" in states
+            assert j.nonterminal() == {}
+        finally:
+            sess.stop()
+        # stop() released the lease cleanly and journaled it
+        assert not os.path.exists(
+            os.path.join(jdir, svc_journal.LEASE_NAME))
+        post = ServiceJournal(jdir)
+        assert [d["kind"] for d in post.entries()][-1] == "shutdown"
+
+    def test_second_session_same_dir_after_stop_is_clean(self,
+                                                         tmp_path):
+        jdir = str(tmp_path / "journal")
+        cfg = sst.TpuConfig(service_journal_dir=jdir)
+        s1 = sst.createLocalTpuSession("first", cfg)
+        s1.stop()
+        s2 = sst.createLocalTpuSession("second", cfg)
+        try:
+            report = s2.recover()
+            assert not report.taken_over     # clean handoff, no fence
+            assert report.n_nonterminal == 0
+        finally:
+            s2.stop()
+
+    def test_warm_restart_recover_resubmit_bit_exact(self, tmp_path):
+        """The full warm-restart arc, crash simulated by journal
+        forgery: a 'previous process' leaves a non-terminal submission
+        (with a genuinely half-done checkpoint journal) and a stale
+        dead-pid lease; the new session fences it, reports the debt,
+        refuses mismatched data, and recovers bit-exact by replaying
+        the checkpoint journal."""
+        jdir = str(tmp_path / "journal")
+        ckpt = str(tmp_path / "ckpt")
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            baseline = _search().fit(X, y)
+            base_scores = baseline.cv_results_[
+                "mean_test_score"].copy()
+
+            # the "previous process": dies to an injected hang after
+            # at least one durable chunk (same model as
+            # test_checkpoint_kill's in-process drills)
+            crash_cfg = sst.TpuConfig(checkpoint_dir=ckpt,
+                                      max_tasks_per_batch=4,
+                                      fault_plan="hung@2")
+            with pytest.raises(TimeoutError):
+                _search(crash_cfg).fit(X, y)
+        n_durable = sum(
+            1 for name in os.listdir(ckpt) if name.endswith(".jsonl")
+            for line in open(os.path.join(ckpt, name))
+            if '"chunk_id"' in line)
+        assert n_durable >= 1, "the hang left nothing durable"
+
+        prev = ServiceJournal(jdir, owner="previous")
+        dead = _dead_pid()
+        probe = _search(sst.TpuConfig(checkpoint_dir=ckpt,
+                                      max_tasks_per_batch=4))
+        prev.record_submission(
+            "tenantA/s1", tenant="tenantA", weight=1.0,
+            family="LogisticRegression",
+            structure_digest=submission_digest(probe, X, y),
+            data_fingerprint=data_fingerprint(X, y),
+            checkpoint_dir=ckpt, config=probe.config)
+        prev.record_transition("tenantA/s1", "running")
+        handle = prev.qualify("tenantA/s1")
+        _write_lease(jdir, dead, age_s=120.0, owner="previous")
+
+        cfg = sst.TpuConfig(service_journal_dir=jdir,
+                            max_tasks_per_batch=4)
+        sess = sst.createLocalTpuSession("warm-restart", cfg)
+        try:
+            report = sess.recover()
+            assert report.taken_over and report.unclean
+            assert report.n_nonterminal == 1
+            entry = report.entries[0]
+            assert entry.handle == handle
+            assert entry.state == "running"
+            assert entry.tenant == "tenantA"
+            assert entry.checkpoint_dir == ckpt
+
+            # the fence dumped a crash-marker bundle into the journal
+            # dir (no flight_dir configured — the journal is the
+            # fallback target)
+            markers = [n for n in os.listdir(jdir)
+                       if n.startswith("flight-crash-marker-")]
+            assert markers, "no crash-marker flight bundle"
+            with open(os.path.join(jdir, markers[0])) as f:
+                bundle = json.load(f)
+            assert bundle["context"]["crash_marker"] is True
+            assert bundle["context"]["previous_pid"] == dead
+            assert bundle["context"]["n_nonterminal"] == 1
+
+            # wrong data is refused BEFORE any admission
+            with pytest.raises(RecoveryDataMismatchError) as ei:
+                sess.resubmit(entry, _search(), X + 1.0, y)
+            assert ei.value.handle == handle
+            assert ei.value.expected == data_fingerprint(X, y)
+
+            # right data recovers bit-exact, replaying the dead run's
+            # durable chunks
+            recovered = _search(sst.TpuConfig(checkpoint_dir=ckpt,
+                                              max_tasks_per_batch=4))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                fut = sess.resubmit(entry, recovered, X, y)
+                fut.result()
+            assert recovered.search_report["n_chunks_resumed"] >= 1
+            np.testing.assert_array_equal(
+                recovered.cv_results_["mean_test_score"], base_scores)
+
+            # the debt is retired and linked to its successor
+            j = sess.journal
+            assert j.nonterminal() == {}
+            rec_lines = [d["record"] for d in j.entries()
+                         if d["kind"] == "state"
+                         and d["record"].get("state") == "recovered"]
+            assert rec_lines and rec_lines[0]["handle"] == handle
+            assert rec_lines[0]["successor"].startswith(
+                f"p{os.getpid()}/")
+            # a second resubmit of the same handle has nothing to claim
+            with pytest.raises(KeyError):
+                sess.resubmit(entry, _search(), X, y)
+        finally:
+            sess.stop()
+
+    def test_recovery_telemetry_counters(self, tmp_path):
+        """The recovery block's counters reflect the warm restart:
+        journal entries scanned, non-terminal found, takeover, and the
+        time-to-recover clock stopped by the first resubmit."""
+        from spark_sklearn_tpu.obs import telemetry as tel
+        jdir = str(tmp_path / "journal")
+        prev = ServiceJournal(jdir, owner="previous")
+        prev.record_submission(
+            "t/s1", tenant="t", weight=1.0, family="F",
+            structure_digest="d",
+            data_fingerprint=data_fingerprint(X, y))
+        handle = prev.qualify("t/s1")
+        _write_lease(jdir, _dead_pid(), age_s=120.0)
+
+        svc = tel.get_telemetry()
+        while svc.enabled:          # a leaked enable would skew the
+            if svc.disable():       # exact-equality assertions below
+                break
+        svc.reset()
+        cfg = sst.TpuConfig(service_journal_dir=jdir,
+                            telemetry_port=0, max_tasks_per_batch=8)
+        sess = sst.createLocalTpuSession("telemetry-recovery", cfg)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                sess.resubmit(handle, _search(), X, y).result()
+            block = svc.snapshot()["recovery"]
+            from spark_sklearn_tpu.obs import fleet
+            text = fleet.prometheus_text()
+        finally:
+            sess.stop()
+            svc.reset()
+        assert block["journal_entries_total"] >= 1
+        assert block["nonterminal_found_total"] == 1
+        assert block["recovered_total"] == 1
+        assert block["lease_takeovers_total"] == 1
+        assert block["unclean_shutdowns_total"] == 1
+        assert block["time_to_recover_s"] > 0.0
+        assert "sst_recovery_recovered_total 1" in text
+        assert "sst_recovery_time_to_recover_seconds" in text

@@ -67,6 +67,12 @@ _WATCHED = (
     # scanned wall — a step change up means the beacon (or something
     # on the callback path) got expensive
     ("hb_overhead", "up"),
+    # warm-restart latency in the serve leg (serve/journal.py):
+    # journal scan at session construction to the first successful
+    # re-admission of a journaled non-terminal search — creep up means
+    # the recovery path (journal fold, lease fence, fingerprint
+    # verify, admission) got slower
+    ("time_to_recover_s", "up"),
 )
 
 
@@ -118,6 +124,8 @@ def _round_row(path: str) -> Dict[str, Any]:
         "stream_shards": ss.get("stream_n_shards"),
         "launches_per_group": cl.get("scan_launches_per_group"),
         "hb_overhead": cl.get("hb_overhead_frac"),
+        "time_to_recover_s": (serve.get("recovery")
+                              or {}).get("time_to_recover_s"),
         "parsed": bool(det),
     }
 
@@ -203,7 +211,7 @@ def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
            f"{'halving x':>10} {'hit rate':>9} {'shed':>6} "
            f"{'srch/min':>9} {'sp/dn h2d':>10} {'strm h2d':>9} "
-           f"{'shards':>7} {'l/grp':>6} {'hb ovh':>8}"]
+           f"{'shards':>7} {'l/grp':>6} {'hb ovh':>8} {'ttr s':>7}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
@@ -216,7 +224,8 @@ def format_table(digest: Dict[str, Any]) -> str:
             f"{_fmt(r.get('stream_h2d_bytes'), 0):>9} "
             f"{_fmt(r.get('stream_shards'), 0):>7} "
             f"{_fmt(r.get('launches_per_group')):>6} "
-            f"{_fmt(r.get('hb_overhead'), 5):>8}"
+            f"{_fmt(r.get('hb_overhead'), 5):>8} "
+            f"{_fmt(r.get('time_to_recover_s'), 3):>7}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

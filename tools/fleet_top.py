@@ -192,6 +192,27 @@ def format_snapshot(snap: Dict[str, Any]) -> str:
         # reading is unambiguous about why there is no progress row
         out.append("search progress: -  (heartbeat disabled — set "
                    "TpuConfig(heartbeat=True) or SST_HEARTBEAT=1)")
+    rec = snap.get("recovery") or {}
+    if any(rec.get(k) for k in ("journal_entries_total",
+                                "nonterminal_found_total",
+                                "recovered_total", "mismatch_total",
+                                "lease_takeovers_total",
+                                "lease_conflicts_total",
+                                "unclean_shutdowns_total")):
+        line = (f"recovery: {rec.get('journal_entries_total', 0)} "
+                f"journal entr"
+                f"{'y' if rec.get('journal_entries_total') == 1 else 'ies'}"
+                f" scanned, {rec.get('nonterminal_found_total', 0)} "
+                f"non-terminal found / "
+                f"{rec.get('recovered_total', 0)} recovered, "
+                f"{rec.get('mismatch_total', 0)} mismatch(es), "
+                f"{rec.get('lease_takeovers_total', 0)} lease "
+                f"takeover(s) / {rec.get('lease_conflicts_total', 0)} "
+                f"conflict(s)")
+        ttr = rec.get("time_to_recover_s", 0.0) or 0.0
+        if ttr:
+            line += f"; time to recover {ttr:.2f}s"
+        out.append(line)
     faults = snap.get("faults") or {}
     if faults.get("total"):
         by_cls = ", ".join(f"{k}={v}" for k, v in sorted(

@@ -19,7 +19,14 @@ Three artifact shapes digest here, auto-detected:
     fault-recovery walls distilled from the embedded ``traceEvents``;
   - a run-log record (``obs/runlog.py``; ``runlog_format`` key): the
     archived attribution, provenance and geometry of one historical
-    run.
+    run;
+  - a service journal (``serve/journal.py``; ``.jsonl`` of
+    ``service_journal_format`` records, or one such record): the
+    crash-safe WAL's submission/transition history folded into
+    per-state totals, the non-terminal searches a restart would owe,
+    and any lease fence/shutdown events.  Crash-marker flight bundles
+    (``reason: "crash-marker"``) print the dead owner and what it
+    still owed.
 
 Exit status: 0 healthy, 1 when the artifact carries a flagged
 regression (CI legs assert on this), 2 on an unrecognized file.
@@ -59,13 +66,22 @@ def load_analyzer():
 
 
 def _classify(data: Any) -> str:
-    """Which artifact shape is this? report / bundle / runlog / ?"""
+    """Which artifact shape is this? report / bundle / runlog /
+    journal / ?"""
+    if isinstance(data, list):
+        if data and all(isinstance(d, dict)
+                        and "service_journal_format" in d
+                        for d in data):
+            return "journal"
+        return "?"
     if not isinstance(data, dict):
         return "?"
     if "flight_format" in data:
         return "bundle"
     if "runlog_format" in data:
         return "runlog"
+    if "service_journal_format" in data:
+        return "journal"
     if "attribution" in data or "pipeline" in data:
         return "report"
     return "?"
@@ -101,6 +117,13 @@ def _digest_bundle(data: Dict[str, Any], mod) -> Dict[str, Any]:
         "family": ctx.get("family", ""),
         "regression": reg,
     }
+    if ctx.get("crash_marker"):
+        out["crash_marker"] = {
+            "previous_pid": ctx.get("previous_pid"),
+            "previous_owner": ctx.get("previous_owner", ""),
+            "n_nonterminal": ctx.get("n_nonterminal", 0),
+            "nonterminal": ctx.get("nonterminal") or [],
+        }
     if ctx.get("watchdog_mode"):
         out["heartbeat"] = {
             "watchdog_mode": ctx.get("watchdog_mode"),
@@ -114,6 +137,60 @@ def _digest_bundle(data: Dict[str, Any], mod) -> Dict[str, Any]:
                         "fault_s": round(fault_s, 6),
                         "n_compile_spans": n_compile}
     return out
+
+
+#: mirror of serve/journal.py TERMINAL_STATES (stdlib-only tool: the
+#: digest must not pay the package import)
+_JOURNAL_TERMINAL = frozenset({"finished", "cancelled", "failed",
+                               "shed", "recovered"})
+
+
+def _digest_journal(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a service journal's records the way a warm restart does:
+    per-kind/state totals plus the non-terminal searches a restart
+    would owe (submission states outranked by any later transition,
+    whichever file order the append race produced)."""
+    subs: Dict[str, Dict[str, Any]] = {}
+    states: Dict[str, str] = {}
+    by_kind: Dict[str, int] = {}
+    lease_events: List[Dict[str, Any]] = []
+    clean_shutdowns = 0
+    for doc in docs:
+        kind = str(doc.get("kind", ""))
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        rec = doc.get("record") or {}
+        handle = str(rec.get("handle", "") or "")
+        if kind == "submitted" and handle:
+            subs[handle] = rec
+            states.setdefault(handle, str(rec.get("state", "admitted")))
+        elif kind == "state" and handle:
+            states[handle] = str(rec.get("state", ""))
+        elif kind == "lease":
+            lease_events.append(dict(rec))
+        elif kind == "shutdown" and rec.get("clean"):
+            clean_shutdowns += 1
+    nonterminal = [
+        {"handle": h, "tenant": str(sub.get("tenant", "")),
+         "family": str(sub.get("family", "")),
+         "state": states.get(h, ""),
+         "checkpoint_dir": str(sub.get("checkpoint_dir", ""))}
+        for h, sub in sorted(subs.items())
+        if states.get(h) not in _JOURNAL_TERMINAL]
+    by_state: Dict[str, int] = {}
+    for h in subs:
+        s = states.get(h, "")
+        by_state[s] = by_state.get(s, 0) + 1
+    return {
+        "kind": "journal",
+        "n_records": len(docs),
+        "by_kind": dict(sorted(by_kind.items())),
+        "n_submissions": len(subs),
+        "by_state": dict(sorted(by_state.items())),
+        "nonterminal": nonterminal,
+        "lease_events": lease_events,
+        "clean_shutdowns": clean_shutdowns,
+        "regression": {},
+    }
 
 
 def _digest_runlog(data: Dict[str, Any]) -> Dict[str, Any]:
@@ -140,9 +217,13 @@ def digest(data: Any, mod=None) -> Dict[str, Any]:
         return _digest_bundle(data, mod)
     if kind == "runlog":
         return _digest_runlog(data)
+    if kind == "journal":
+        return _digest_journal(
+            data if isinstance(data, list) else [data])
     return {"kind": "?",
             "error": "unrecognized artifact: expected a search report, "
-                     "flight bundle or run-log record"}
+                     "flight bundle, run-log record or service "
+                     "journal"}
 
 
 def _lane_table(block: Dict[str, Any], lanes) -> List[str]:
@@ -194,6 +275,20 @@ def format_digest(d: Dict[str, Any], mod=None) -> str:
                    + (f", family {d['family']!r}" if d["family"] else ""))
         if d.get("verdict"):
             out.append(f"verdict: {d['verdict']}")
+        cm = d.get("crash_marker") or {}
+        if cm:
+            out.append(
+                f"crash marker: previous owner "
+                f"{cm.get('previous_owner') or '?'} "
+                f"(pid {cm.get('previous_pid') or '?'}) died holding "
+                f"{cm.get('n_nonterminal', 0)} non-terminal "
+                f"search(es)")
+            for e in cm.get("nonterminal") or []:
+                out.append(
+                    f"  {e.get('handle', '?'):<28} "
+                    f"tenant {e.get('tenant', '?'):<12} "
+                    f"state {e.get('state', '?'):<10} "
+                    f"family {e.get('family', '?')}")
         hb = d.get("heartbeat") or {}
         if hb:
             last = hb.get("last_step")
@@ -208,6 +303,34 @@ def format_digest(d: Dict[str, Any], mod=None) -> str:
                        f"{tr['n_compile_spans']} span(s), fault "
                        f"recovery {tr['fault_s']:.3f} s")
         out.extend(_regression_lines(d["regression"]))
+    elif d["kind"] == "journal":
+        out.append(
+            f"service journal: {d['n_records']} record(s) "
+            f"({', '.join(f'{k}={v}' for k, v in d['by_kind'].items())}), "
+            f"{d['n_submissions']} submission(s)")
+        if d["by_state"]:
+            out.append("  states: " + ", ".join(
+                f"{k or '?'}={v}" for k, v in d["by_state"].items()))
+        if d["lease_events"]:
+            for e in d["lease_events"]:
+                out.append(
+                    f"  lease {e.get('event', '?')}: pid "
+                    f"{e.get('previous_pid', '?')} "
+                    f"({e.get('previous_owner') or '?'}) fenced by "
+                    f"{e.get('owner', '?')} after "
+                    f"{e.get('stale_age_s', 0.0)}s")
+        if d["clean_shutdowns"]:
+            out.append(f"  {d['clean_shutdowns']} clean shutdown(s)")
+        nt = d["nonterminal"]
+        if nt:
+            out.append(f"  {len(nt)} NON-TERMINAL search(es) — a warm "
+                       "restart owes these:")
+            for e in nt:
+                out.append(
+                    f"    {e['handle']:<28} tenant {e['tenant']:<12} "
+                    f"state {e['state']:<10} family {e['family']}")
+        else:
+            out.append("  no non-terminal searches — nothing owed")
     elif d["kind"] == "runlog":
         prov = d.get("provenance") or {}
         out.append(f"run-log record: family {d['family']!r}, structure "
@@ -230,8 +353,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the digest as JSON instead of a table")
     args = ap.parse_args(argv)
-    with open(args.artifact) as f:
-        data = json.load(f)
+    with open(args.artifact, errors="replace") as f:
+        text = f.read()
+    try:
+        data: Any = json.loads(text)
+    except ValueError:
+        # jsonl (a service journal): one document per line, torn tail
+        # lines skipped exactly as the journal's own scan skips them
+        docs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                docs.append(json.loads(line))
+            except ValueError:
+                continue
+        data = docs
     mod = load_analyzer()
     d = digest(data, mod)
     try:

@@ -37,6 +37,15 @@ on the harness clock:
     python tools/sst_soak.py --tenants 3 --searches 4 \
         --plan "transient@1;oom_deep@2;hung@1;slow@3:0.3;submit_storm@0x6"
 
+``--crash-drill`` runs the crash-safety arc instead: a child process
+journals a search (``serve/journal.py``) and is ``kill -9``ed
+mid-flight once its checkpoint journal holds at least one chunk; the
+harness then fences the dead owner's lease, recovers the journaled
+search through ``TpuSession.recover()`` / ``resubmit()``, and asserts
+the recovered ``cv_results_`` is bit-exact (``np.array_equal``)
+against the uncrashed baseline, the crash-marker flight bundle
+landed, and the journal owes nothing afterwards.
+
 Exits nonzero when any assertion fails; ``--json`` emits the full
 per-search ledger for CI artifacts.
 """
@@ -58,7 +67,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-__all__ = ["parse_chaos_plan", "run_soak", "main"]
+__all__ = ["parse_chaos_plan", "run_crash_drill", "run_soak", "main"]
 
 #: session-level events: (name, t_s, count)
 _EVENT_RE = re.compile(
@@ -101,6 +110,17 @@ def _make_search(sst, cfg, seed: int):
     return sst.GridSearchCV(
         LogisticRegression(max_iter=10), {"C": c_grid}, cv=2,
         refit=False, backend="tpu", error_score=-999.0, config=cfg)
+
+
+def _drill_data():
+    """The crash drill's dataset — one definition imported by BOTH the
+    to-be-killed child and the recovering harness, so the fingerprint
+    check in ``TpuSession.resubmit()`` compares like with like."""
+    import numpy as np
+    rng = np.random.RandomState(11)
+    X = rng.randn(120, 6).astype(np.float32)
+    y = (X[:, 0] + 0.25 * rng.randn(120) > 0).astype(np.int64)
+    return X, y
 
 
 def _classify(search, fut, baseline, n_cand: int) -> Dict[str, Any]:
@@ -319,6 +339,206 @@ def run_soak(n_tenants: int = 2, n_searches: int = 3,
     return result
 
 
+#: the child half of the crash drill: journal + checkpoint a search
+#: stretched by a brownout plan, then hang on the result until the
+#: harness SIGKILLs the process mid-flight.  Slow launches make the
+#: kill window wide; the scores they produce stay bit-exact.
+_DRILL_CHILD_SRC = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {root!r})
+sys.path.insert(0, {tools!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import spark_sklearn_tpu as sst
+from sst_soak import _drill_data, _make_search
+X, y = _drill_data()
+cfg = sst.TpuConfig(
+    tenant="drill", service_journal_dir={jdir!r},
+    checkpoint_dir={cdir!r}, max_tasks_per_batch=4,
+    telemetry_port=0,
+    fault_plan=",".join("slow@%d:0.4" % i for i in range(1, 9)))
+sess = sst.createLocalTpuSession("crash-drill-child", cfg)
+search = _make_search(sst, cfg, 0)
+fut = sess.submit(search, X, y)
+print("SUBMITTED", flush=True)
+fut.result()
+print("FINISHED", flush=True)
+"""
+
+
+def _count_chunk_records(checkpoint_dir: str) -> int:
+    """Completed-chunk records durably on disk across every search
+    journal in ``checkpoint_dir`` (fault/meta lines don't count)."""
+    import glob
+    n = 0
+    for path in glob.glob(os.path.join(checkpoint_dir,
+                                       "search_*.jsonl")):
+        try:
+            with open(path, errors="replace") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "chunk_id" in rec:
+                        n += 1
+        except OSError:
+            continue
+    return n
+
+
+def run_crash_drill(verbose: bool = True,
+                    kill_timeout_s: float = 90.0) -> Dict[str, Any]:
+    """The crash-safety arc, end to end: a child process journals a
+    search and dies by ``kill -9`` once at least one checkpoint chunk
+    is durable; the harness then fences the dead owner's lease,
+    recovers through :meth:`TpuSession.recover` / ``resubmit()``, and
+    asserts bit-exactness against the uncrashed baseline plus the
+    crash-marker bundle, recovery telemetry, and an empty non-terminal
+    set afterwards."""
+    import glob
+    import signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+    import spark_sklearn_tpu as sst
+    from spark_sklearn_tpu.obs import telemetry as _telemetry
+    from spark_sklearn_tpu.serve.journal import ServiceJournal
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"[crash-drill] {msg}", flush=True)
+
+    failures: List[str] = []
+    workdir = tempfile.mkdtemp(prefix="sst-crash-drill-")
+    jdir = os.path.join(workdir, "journal")
+    cdir = os.path.join(workdir, "ckpt")
+    log_path = os.path.join(workdir, "child.log")
+
+    # 1. the uncrashed baseline: same search, same data, no journal,
+    # no checkpoints, no faults
+    say("uncrashed baseline fit")
+    X, y = _drill_data()
+    solo = _make_search(sst, None, 0)
+    solo.fit(X, y)
+    baseline = solo.cv_results_["mean_test_score"].copy()
+
+    # 2. the victim: journal + checkpoint in a child process, then
+    # SIGKILL it the moment one chunk record is durable — mid-search
+    # by construction (the brownout plan stretches the remainder)
+    say(f"spawning victim child (journal={jdir})")
+    child_src = _DRILL_CHILD_SRC.format(
+        root=_ROOT, tools=os.path.join(_ROOT, "tools"),
+        jdir=jdir, cdir=cdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    with open(log_path, "w") as log:
+        child = subprocess.Popen([sys.executable, "-c", child_src],
+                                 stdout=log,
+                                 stderr=subprocess.STDOUT, env=env)
+        deadline = time.monotonic() + kill_timeout_s
+        n_chunks = 0
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                break
+            n_chunks = _count_chunk_records(cdir)
+            if n_chunks >= 1:
+                break
+            time.sleep(0.05)
+        if child.poll() is not None:
+            with open(log_path, errors="replace") as f:
+                tail = f.read()[-2000:]
+            failures.append(
+                f"victim exited rc={child.returncode} before the "
+                f"kill landed; output tail: {tail!r}")
+        elif n_chunks < 1:
+            child.kill()
+            failures.append(
+                f"no durable chunk record within {kill_timeout_s}s "
+                "— nothing to kill mid-flight")
+        else:
+            say(f"SIGKILL pid={child.pid} after {n_chunks} durable "
+                "chunk record(s)")
+            os.kill(child.pid, signal.SIGKILL)
+        child.wait()
+
+    if failures:
+        return {"failures": failures, "workdir": workdir}
+
+    # 3. the survivor: fence the dead owner's lease, recover the
+    # journaled search, resubmit against the same data
+    say("recovery session: fence + recover + resubmit")
+    rcfg = sst.TpuConfig(tenant="drill", service_journal_dir=jdir,
+                         checkpoint_dir=cdir, max_tasks_per_batch=4,
+                         telemetry_port=0)
+    t_recover0 = time.perf_counter()
+    sess = sst.createLocalTpuSession("crash-drill-recover", rcfg)
+    time_to_recover_s = None
+    try:
+        report = sess.recover()
+        if not report.taken_over:
+            failures.append("dead owner's lease was not fenced "
+                            "(RecoveryReport.taken_over is False)")
+        if report.n_nonterminal != 1:
+            failures.append(
+                f"expected exactly 1 non-terminal journal entry, "
+                f"found {report.n_nonterminal}")
+        else:
+            entry = report.entries[0]
+            say(f"recovering {entry.handle} "
+                f"(state={entry.state}, ckpt={entry.checkpoint_dir})")
+            search2 = _make_search(sst, rcfg, 0)
+            fut = sess.resubmit(entry, search2, X, y)
+            fut.result()
+            time_to_recover_s = time.perf_counter() - t_recover0
+            scores = search2.cv_results_["mean_test_score"]
+            if not np.array_equal(scores, baseline):
+                failures.append(
+                    "recovered cv_results_ diverged from the "
+                    f"uncrashed baseline: {scores.tolist()} vs "
+                    f"{baseline.tolist()}")
+            else:
+                say(f"recovered bit-exact in {time_to_recover_s:.2f}s")
+        markers = glob.glob(os.path.join(jdir,
+                                         "flight-crash-marker-*.json"))
+        if not markers:
+            failures.append("no crash-marker flight bundle landed in "
+                            "the journal directory")
+        snap = _telemetry.get_telemetry().snapshot()
+        rec_block = (snap or {}).get("recovery") or {}
+        if not rec_block.get("recovered_total"):
+            failures.append("telemetry recovery block shows zero "
+                            f"recovered_total: {rec_block}")
+        if not rec_block.get("lease_takeovers_total"):
+            failures.append("telemetry recovery block shows zero "
+                            f"lease_takeovers_total: {rec_block}")
+    finally:
+        sess.stop()
+
+    # 4. the ledger after the dust settles: the journal owes nothing
+    post = ServiceJournal(jdir).nonterminal()
+    if post:
+        failures.append(
+            f"journal still owes {sorted(post)} after recovery")
+
+    result = {
+        "failures": failures,
+        "workdir": workdir,
+        "n_chunks_at_kill": n_chunks,
+        "time_to_recover_s": (round(time_to_recover_s, 3)
+                              if time_to_recover_s is not None
+                              else None),
+    }
+    if failures:
+        for f in failures:
+            say(f"FAILURE: {f}")
+    else:
+        say("CRASH DRILL GREEN: killed mid-search, lease fenced, "
+            "recovered bit-exact, journal owes nothing")
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tenants", type=int, default=2)
@@ -332,9 +552,17 @@ def main(argv=None) -> int:
                     help="queue-wait p95 bound (seconds)")
     ap.add_argument("--quarantine-k", type=int, default=2)
     ap.add_argument("--launch-timeout", type=float, default=20.0)
+    ap.add_argument("--crash-drill", action="store_true",
+                    help="run the kill -9 crash-recovery drill "
+                         "instead of the chaos soak")
     ap.add_argument("--json", action="store_true",
                     help="emit the full soak ledger as JSON")
     args = ap.parse_args(argv)
+    if args.crash_drill:
+        result = run_crash_drill(verbose=not args.json)
+        if args.json:
+            print(json.dumps(result, indent=2, default=str))
+        return 1 if result["failures"] else 0
     if args.tenants < 2:
         ap.error("a soak needs >= 2 tenants")
     result = run_soak(n_tenants=args.tenants,

@@ -180,7 +180,7 @@ class Project:
                                    "_admission", "_admission_reasons",
                                    "_protection",
                                    "_fusion", "_fusion_borrowed",
-                                   "_fusion_donated",
+                                   "_fusion_donated", "_recovery",
                                    "_providers", "_polls",
                                    "_n_samples")),
                 # obs/telemetry: the always-on flight-recorder ring
@@ -225,6 +225,13 @@ class Project:
                 SharedState("obs/runlog.py",
                             "runlog.RunLog._lock",
                             cls="RunLog",
+                            attrs=("_seq", "_counts")),
+                # serve/journal: the durable service WAL, appended by
+                # the submit path, worker threads and the shutdown
+                # drain (always OUTSIDE the executor's lock)
+                SharedState("serve/journal.py",
+                            "journal.ServiceJournal._lock",
+                            cls="ServiceJournal",
                             attrs=("_seq", "_counts")),
             ),
             blocks=(
@@ -286,6 +293,10 @@ class Project:
                 BlockSpec("heartbeat", "HEARTBEAT_BLOCK_SCHEMA", (
                     Producer("dict-keys", "obs/heartbeat.py",
                              "heartbeat_block"),
+                )),
+                BlockSpec("recovery", "RECOVERY_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "obs/telemetry.py",
+                             "TelemetryService._recovery_block"),
                 )),
             ),
             launch_paths=(
