@@ -1309,6 +1309,94 @@ def leg_chunkloop(cache_dir=None, n_rows=484, n_candidates=48,
     }
 
 
+def leg_pipeline_prefix(cache_dir=None, n_rows=484, n_prefixes=4,
+                        n_suffixes=24, folds=2, max_iter=25,
+                        tasks_per_batch=16):
+    """Shared-prefix search graphs (ISSUE 19): the SAME
+    StandardScaler->PCA->LogReg grid — ``n_prefixes`` distinct PCA
+    widths x ``n_suffixes`` C values — run atomic
+    (``prefix_reuse=False``, every candidate recomputes its chain
+    inline) vs shared (each DISTINCT prefix computed once per fold and
+    fanned over the suffixes), WARM walls only, recording the prefix
+    compute collapse (``prefix_saved``; the headline contract is
+    candidates/launches >= 5x at 4x24) and asserting byte-identical
+    ``cv_results_``."""
+    import numpy as np
+    from sklearn.datasets import load_digits
+    from sklearn.decomposition import PCA
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    import spark_sklearn_tpu as sst
+
+    X, y = load_digits(return_X_y=True)
+    X = (X[:n_rows] / 16.0).astype(np.float32)
+    y = y[:n_rows]
+    pipe = Pipeline([("sc", StandardScaler()),
+                     ("pca", PCA(random_state=0)),
+                     ("clf", LogisticRegression(max_iter=max_iter))])
+    comps = np.linspace(8, min(48, X.shape[1]),
+                        n_prefixes).astype(int).tolist()
+    grid = {"pca__n_components": comps,
+            "clf__C": np.logspace(-4, 3, n_suffixes).tolist()}
+
+    def timed(prefix_reuse):
+        def mk():
+            # pinned geometry costs keep BOTH arms on identical
+            # planned widths (the global cost model learns from the
+            # first arm's launches; a width change is a different
+            # reduction shape = a 1-ulp lottery on the byte-identity
+            # assertion)
+            return sst.GridSearchCV(
+                pipe, grid, cv=folds, refit=False, backend="tpu",
+                config=sst.TpuConfig(
+                    compilation_cache_dir=cache_dir,
+                    prefix_reuse=prefix_reuse,
+                    max_tasks_per_batch=tasks_per_batch,
+                    geometry_overhead_s=0.01,
+                    geometry_lane_cost_s=1e-3))
+        mk().fit(X, y)                      # warm the programs
+        t0 = time.perf_counter()
+        gs = mk().fit(X, y)
+        return gs, round(time.perf_counter() - t0, 3)
+
+    atomic, wall_atomic = timed(False)
+    shared, wall_shared = timed(True)
+    px = shared.search_report["prefix"]
+    n_cand = int(px["n_candidates_total"])
+    n_launch = int(px["n_prefix_launches"])
+    n_avoid = n_launch + int(px["n_prefix_reused"]) \
+        + int(px["n_prefix_resumed"])
+    parity = all(
+        np.array_equal(np.asarray(atomic.cv_results_[k]),
+                       np.asarray(shared.cv_results_[k]))
+        for k in atomic.cv_results_ if "time" not in k and k != "params")
+    return {
+        "shape": f"digits[{n_rows}], {len(comps)} pca widths x "
+                 f"{n_suffixes} C x {folds} folds, "
+                 f"{tasks_per_batch} tasks/batch",
+        "atomic_warm_wall_s": wall_atomic,
+        "shared_warm_wall_s": wall_shared,
+        "wall_ratio_atomic_over_shared": round(
+            wall_atomic / wall_shared, 3) if wall_shared else 0.0,
+        "n_candidates": n_cand,
+        "n_prefixes_distinct": int(px["n_prefixes_distinct"]),
+        "n_prefix_launches": n_launch,
+        "n_prefix_reused": int(px["n_prefix_reused"]),
+        "prefix_saved": int(px["recompute_saved"]),
+        # the headline: prefix computations per candidate collapse
+        # from 1.0 to distinct/candidates (>= 5x reduction at 4x24)
+        "prefix_compute_reduction": round(
+            n_cand / n_avoid, 2) if n_avoid else 0.0,
+        "prefix_bytes_cached": int(px["bytes_cached"]),
+        "prefix_wall_s": px["prefix_wall_s"],
+        "prefix_fallbacks": list(px["fallbacks"]),
+        "prefix_cv_results_identical": bool(parity),
+        "memory": _memory_summary(shared.search_report),
+    }
+
+
 #: (detail key, leg fn, kwargs builder) for the breadth legs the TPU
 #: child runs after the headline; each failure is contained per-leg.
 _BREADTH_LEGS = [
@@ -1322,6 +1410,7 @@ _BREADTH_LEGS = [
     ("halving_adaptive", leg_halving, {}),
     ("stream_sparse", leg_stream_sparse, {}),
     ("chunkloop_scan", leg_chunkloop, {}),
+    ("pipeline_prefix", leg_pipeline_prefix, {}),
 ]
 
 #: scaled-down per-leg kwargs for the BENCH_FORCE_BREADTH=1 rehearsal
@@ -1350,6 +1439,8 @@ _BREADTH_TOY_KWARGS = {
                           budget_mib=0.25),
     "chunkloop_scan": dict(n_rows=242, n_candidates=24, folds=2,
                            max_iter=10),
+    "pipeline_prefix": dict(n_rows=242, n_prefixes=4, n_suffixes=24,
+                            folds=2, max_iter=10),
 }
 
 
@@ -1537,6 +1628,21 @@ def run_child(platform):
             detail["chunkloop_scan"] = leg_detail
         except Exception as exc:  # noqa: BLE001 — breadth only
             detail["chunkloop_scan_error"] = repr(exc)[:300]
+        _emit(payload)
+
+        # the shared-prefix A/B (ISSUE 19) must exist in every payload
+        # too: prefix_saved is the trend column that keeps the
+        # O(distinct-prefixes) collapse honest across rounds, and both
+        # arms run WARM at a moderate 4x24 pipeline grid
+        try:
+            leg_detail, leg_trace = _traced(
+                "pipeline_prefix", trace_dir, leg_pipeline_prefix,
+                cache_dir=cache_dir)
+            if leg_trace and isinstance(leg_detail, dict):
+                leg_detail["trace_file"] = leg_trace
+            detail["pipeline_prefix"] = leg_detail
+        except Exception as exc:  # noqa: BLE001 — breadth only
+            detail["pipeline_prefix_error"] = repr(exc)[:300]
         _emit(payload)
 
     return 0

@@ -476,6 +476,56 @@ print("chunk-loop smoke:",
                     "scan": sc.search_report["n_launches"]}})
 PY
 
+echo "== shared-prefix smoke (distinct prefixes computed once, bit-exact) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from sklearn.datasets import load_digits
+from sklearn.decomposition import PCA
+from sklearn.linear_model import LogisticRegression
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import StandardScaler
+import spark_sklearn_tpu as sst
+
+X, y = load_digits(return_X_y=True)
+X = (X[:240] / 16.0).astype(np.float32); y = y[:240]
+pipe = Pipeline([("sc", StandardScaler()), ("pca", PCA(random_state=0)),
+                 ("clf", LogisticRegression(max_iter=10))])
+grid = {"pca__n_components": [8, 16, 24, 32],
+        "clf__C": np.logspace(-2, 1, 6).tolist()}
+geo = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3,
+           max_tasks_per_batch=16)
+
+
+def run(**kw):
+    return sst.GridSearchCV(
+        pipe, grid, cv=2, refit=False, backend="tpu",
+        config=sst.TpuConfig(**geo, **kw)).fit(X, y)
+
+
+shared, atomic = run(), run(prefix_reuse=False)
+px = shared.search_report["prefix"]
+# 24 candidates collapsed to 4 distinct prefix transforms...
+assert px["enabled"] and px["mode"] == "shared", px
+assert px["n_prefixes_distinct"] < px["n_candidates_total"], px
+assert px["recompute_saved"] > 0 and not px["fallbacks"], px
+# ...while staying bit-exact with the atomic escape hatch
+pa = atomic.search_report["prefix"]
+assert pa["mode"] == "atomic" and not pa["enabled"], pa
+for k in shared.cv_results_:
+    if "time" in k or k == "params":
+        continue
+    np.testing.assert_array_equal(np.asarray(shared.cv_results_[k]),
+                                  np.asarray(atomic.cv_results_[k]),
+                                  err_msg=k)
+print("shared-prefix smoke:",
+      {"n_candidates": px["n_candidates_total"],
+       "n_distinct": px["n_prefixes_distinct"],
+       "recompute_saved": px["recompute_saved"],
+       "bytes_cached": px["bytes_cached"]})
+PY
+
 echo "== heartbeat smoke (in-flight beats, watchdog stall, off-parity) =="
 JAX_PLATFORMS=cpu python - <<'PY'
 import numpy as np

@@ -18,6 +18,7 @@ import numpy as np
 
 from spark_sklearn_tpu.models import preprocessing as prep
 from spark_sklearn_tpu.models.base import resolve_family
+from spark_sklearn_tpu.utils.checkpoint import fingerprint
 
 
 class PipelineFamily:
@@ -46,6 +47,7 @@ class PipelineFamily:
             f"{final_name}__{k}": v
             for k, v in final_family.dynamic_params.items()
         }
+        self._suffix_family: Optional["PipelineFamily"] = None
         if not final_family.has_per_task_fit() and \
                 getattr(final_family, "task_batched_accepts_fold_inputs",
                         False):
@@ -94,6 +96,63 @@ class PipelineFamily:
             if sname in per_step:
                 per_step[sname][pname] = v
         return per_step
+
+    # -- shared-prefix search support ------------------------------------
+    def prefix_digest(self, static) -> Optional[str]:
+        """Content digest of the transformer-chain configuration.
+
+        Candidates whose digests match see the identical transformed
+        design matrix: every step's params are static (steps expose no
+        dynamic leaves) and the only other fit input is the fold mask,
+        which the shared-prefix scheduler keys separately.  The final
+        step's params are deliberately EXCLUDED — compile groups that
+        differ only in final-step statics share the digest, so the
+        cached prefix is reused across groups too.  None when the
+        chain is empty (depth 0) or a step opted out of prefix safety.
+        """
+        if not self.steps:
+            return None
+        per_step = self._split_static(static)
+        parts = []
+        for sname, step in self.steps:
+            if not getattr(step, "prefix_safe", False):
+                return None
+            parts.append((sname, getattr(step, "name", step.__name__),
+                          tuple(sorted((k, repr(v)) for k, v in
+                                       per_step[sname].items()))))
+        return fingerprint("prefix-v1", tuple(parts))
+
+    def prefix_transform(self, static, data, fold_w):
+        """Prefix-only compiled transform: fold masks (F, n) -> the
+        stacked per-fold transformed design matrix (F, n, d') with the
+        exact mask-weighted statistics the fused fit computes inline
+        (same ops, same order — the split is bit-exact by
+        construction)."""
+        import jax
+
+        per_step = self._split_static(static)
+
+        def tf(w_f):
+            X = data["X"]
+            for sname, step in self.steps:
+                st = step.fit(per_step[sname], X, w_f)
+                X = step.apply(per_step[sname], st, X)
+            return X
+
+        return jax.vmap(tf)(fold_w)                    # (F, n, d')
+
+    def suffix_family(self) -> "PipelineFamily":
+        """The final-step-only family the shared-prefix scheduler fans
+        over cached prefix matrices.  Cached per parent instance so
+        program-cache keys (which hash family identity) stay stable
+        across chunks/rungs; the name is distinct from the atomic
+        pipeline's so persistent-store artifacts never alias programs
+        traced on untransformed shapes."""
+        if self._suffix_family is None:
+            fam = PipelineFamily([], self.final_name, self.final)
+            fam.name = f"suffix[{self.name}]"
+            self._suffix_family = fam
+        return self._suffix_family
 
     # -- device side -----------------------------------------------------
     def fit(self, dynamic, static, data, train_w, meta):

@@ -26,6 +26,9 @@ EPS = 1e-12
 class StandardScalerStep:
     name = "standard_scaler"
     dynamic_params: dict = {}
+    #: pure function of (static, X, fold mask): safe to hoist into a
+    #: shared-prefix stage and reuse across suffix candidates
+    prefix_safe = True
     #: strictly monotone per-feature map: quantile binning (and therefore
     #: histogram-tree fits) is provably invariant under this step
     monotone_per_feature = True
@@ -54,6 +57,9 @@ class StandardScalerStep:
 class MinMaxScalerStep:
     name = "minmax_scaler"
     dynamic_params: dict = {}
+    #: pure function of (static, X, fold mask): safe to hoist into a
+    #: shared-prefix stage and reuse across suffix candidates
+    prefix_safe = True
     monotone_per_feature = True
 
     @staticmethod
@@ -78,6 +84,9 @@ class MinMaxScalerStep:
 class MaxAbsScalerStep:
     name = "maxabs_scaler"
     dynamic_params: dict = {}
+    #: pure function of (static, X, fold mask): safe to hoist into a
+    #: shared-prefix stage and reuse across suffix candidates
+    prefix_safe = True
     # |x|-scaling by a positive constant: monotone per feature
     monotone_per_feature = True
 
@@ -96,6 +105,9 @@ class NormalizerStep:
 
     name = "normalizer"
     dynamic_params: dict = {}
+    #: pure function of (static, X, fold mask): safe to hoist into a
+    #: shared-prefix stage and reuse across suffix candidates
+    prefix_safe = True
     monotone_per_feature = False   # row-wise, mixes features
 
     @staticmethod
@@ -125,6 +137,9 @@ class PCAStep:
 
     name = "pca"
     dynamic_params: dict = {}
+    #: pure function of (static, X, fold mask): safe to hoist into a
+    #: shared-prefix stage and reuse across suffix candidates
+    prefix_safe = True
     monotone_per_feature = False   # rotation, mixes features
 
     @staticmethod

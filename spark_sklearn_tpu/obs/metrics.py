@@ -35,6 +35,7 @@ __all__ = [
     "SCHEDULER_BLOCK_SCHEMA",
     "HALVING_BLOCK_SCHEMA",
     "CHUNKLOOP_BLOCK_SCHEMA",
+    "PREFIX_BLOCK_SCHEMA",
     "MEMORY_BLOCK_SCHEMA",
     "STREAMING_BLOCK_SCHEMA",
     "ATTRIBUTION_BLOCK_SCHEMA",
@@ -173,6 +174,15 @@ SEARCH_REPORT_SCHEMA = (
         "segments executed and chunks melted into them, launches "
         "saved, fallback reasons, and halving's device-vs-host rung "
         "elimination counts (search/grid.py scan path)."),
+    MetricDef(
+        "prefix", "struct",
+        "The shared-prefix scheduler's per-search view (see the "
+        "prefix-block schema below): whether Pipeline prefixes were "
+        "staged (TpuConfig.prefix_reuse / SST_PREFIX_REUSE), distinct "
+        "prefix digests vs candidates, device launches vs plane/"
+        "journal re-use, recomputations saved and the recorded "
+        "fallback reasons (search/prefix.py + search/grid.py stage-1 "
+        "scheduler)."),
     MetricDef(
         "memory", "struct",
         "The device-memory ledger's per-search view (see the "
@@ -616,6 +626,67 @@ CHUNKLOOP_BLOCK_SCHEMA = (
               "segment wall (score-time columns are 0.0 and the whole "
               "wall lands in fit time); 'calibrated' on the per-chunk "
               "path (warm calibration launch splits fused walls)."),
+)
+
+
+#: sub-keys of ``search_report["prefix"]`` (written by
+#: ``search.prefix.prefix_block``) — the shared-prefix scheduler's
+#: per-search view: how many distinct Pipeline prefixes the candidate
+#: grid collapsed to, how many device transforms actually launched vs
+#: were re-used from the data plane or the checkpoint journal, and why
+#: an eligible-looking search stayed atomic.  Emitted for EVERY search
+#: (atomic searches report the zeroed ``enabled=False`` shape); a
+#: halving search accumulates all rungs into this one block.
+PREFIX_BLOCK_SCHEMA = (
+    MetricDef("mode", "label",
+              "The resolved sharing mode: 'shared' (default; distinct "
+              "prefixes computed once and fanned over suffixes) or "
+              "'atomic' (TpuConfig.prefix_reuse=False / "
+              "SST_PREFIX_REUSE=0 — every candidate recomputes its "
+              "full chain inline, the exact escape hatch)."),
+    MetricDef("enabled", "label",
+              "True when the prefix stage actually ran: mode='shared' "
+              "AND the search passed the eligibility gate (compiled "
+              "Pipeline family, dense unsharded device X, wide score "
+              "path)."),
+    MetricDef("n_candidates_total", "counter",
+              "Pipeline candidates whose prefix the staged schedule "
+              "covered (summed over halving rungs)."),
+    MetricDef("n_prefixes_distinct", "counter",
+              "Distinct prefix digests among those candidates — the "
+              "number of transformed design matrices that exist, vs "
+              "n_candidates_total the atomic path would compute."),
+    MetricDef("n_prefix_launches", "counter",
+              "Prefix transforms actually computed on device (one "
+              "vectorized-over-folds launch each).  The headline "
+              "reduction is n_candidates_total / n_prefix_launches."),
+    MetricDef("n_prefix_reused", "counter",
+              "Prefix stages satisfied by a live DataPlane derived "
+              "buffer (zero device work; e.g. halving rungs that kept "
+              "their fold masks, or a repeated search on resident "
+              "data)."),
+    MetricDef("n_prefix_resumed", "counter",
+              "Prefix stages restored from the checkpoint journal's "
+              "saved payload after a restart (one upload, no "
+              "recompute)."),
+    MetricDef("recompute_saved", "counter",
+              "Per-candidate prefix computations the schedule avoided: "
+              "n_candidates_total - n_prefix_launches."),
+    MetricDef("bytes_cached", "counter",
+              "Bytes of transformed (F, n, d') design matrices held "
+              "as DataPlane derived buffers for this search, charged "
+              "to the owning tenant."),
+    MetricDef("prefix_wall_s", "gauge",
+              "Wall seconds the stage-1 prefix loop spent (compute + "
+              "journal writes), already excluded from per-candidate "
+              "fit walls."),
+    MetricDef("fallbacks", "series",
+              "Why the search (or a rung) stayed atomic: "
+              "'not-a-compiled-pipeline', 'no-prefix-steps', "
+              "'task-batched-final', 'data-sharded', 'no-device-x', "
+              "'sparse-device-data', 'nested-score', "
+              "'dataplane-disabled', 'no-x-fingerprint', "
+              "'undigestable-prefix'."),
 )
 
 
@@ -1256,6 +1327,16 @@ def schema_markdown() -> str:
         "`enabled=False` shape.\n")
     out.append("\n| key | kind | description |\n|---|---|---|\n")
     for d in CHUNKLOOP_BLOCK_SCHEMA:
+        out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
+    out.append("\n### `search_report[\"prefix\"]` block\n")
+    out.append(
+        "\nThe shared-prefix scheduler's per-search view "
+        "(`TpuConfig.prefix_reuse` / `SST_PREFIX_REUSE`, default on; "
+        "`search/prefix.py` + the `search/grid.py` stage-1 "
+        "scheduler).  Always present on compiled-tier searches — "
+        "atomic runs report the zeroed `enabled=False` shape.\n")
+    out.append("\n| key | kind | description |\n|---|---|---|\n")
+    for d in PREFIX_BLOCK_SCHEMA:
         out.append(f"| `{d.name}` | {d.kind} | {d.description} |\n")
     out.append("\n### `search_report[\"memory\"]` block\n")
     out.append(

@@ -100,6 +100,11 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "(carries group, n_chunks, and topk: the on-device rung "
             "elimination's keep count, 0 when the carry is score-"
             "only)."),
+    SpanDef("prefix.stage", "span", "search.grid",
+            "The shared-prefix stage-1 loop: every DISTINCT Pipeline "
+            "prefix digest computed/restored once, vectorized over "
+            "folds, before suffix chunks launch (carries "
+            "n_distinct)."),
     # parallel/taskgrid.py
     SpanDef("build_compile_groups", "span", "parallel.taskgrid",
             "Partitioning candidates into static-signature groups."),
@@ -124,6 +129,10 @@ SPAN_VOCABULARY: Tuple[SpanDef, ...] = (
             "One host->device transfer (carries `bytes`)."),
     SpanDef("dataplane.tile", "span", "parallel.dataplane",
             "On-device fold-mask tiling (no host transfer)."),
+    SpanDef("dataplane.derive", "span", "parallel.dataplane",
+            "One derived-buffer materialization (a cache miss in "
+            "DataPlane.derived — e.g. a shared-prefix transformed "
+            "design matrix; carries `bytes`, `label`)."),
     # parallel/programstore.py
     SpanDef("programstore.load", "span", "parallel.programstore",
             "One AOT-artifact store lookup (carries `bytes`, `hit` and "

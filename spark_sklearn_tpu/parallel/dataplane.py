@@ -190,6 +190,7 @@ class DataPlane:
         self.evictions = 0
         self.bytes_uploaded = 0       # miss uploads through put()/zeros()
         self.bytes_tiled = 0          # device-side tile materializations
+        self.bytes_derived = 0        # device-computed derived buffers
         #: compiled tile programs keyed by (shape, dtype, reps, sharding)
         self._tile_programs: Dict[Any, Any] = {}
         #: multi-tenant accounting (serve/executor.py): per-tenant byte
@@ -436,6 +437,36 @@ class DataPlane:
             self.bytes_tiled += nbytes
             self._insert(key, dev, nbytes, tenant=tenant, label=label)
             return dev
+
+    def derived(self, key_parts: Tuple, maker, nbytes: int,
+                label: str = "derived", tenant: Any = None):
+        """Cached DEVICE-COMPUTED buffer — the resident home of arrays
+        that never cross host->device (e.g. the shared-prefix
+        scheduler's per-fold transformed design matrices).  Returns
+        ``(device_array, hit)``; ``maker()`` runs at most once while
+        the entry survives the budget and its result is charged
+        ``nbytes`` against the tenant's quota like any upload.
+
+        ``key_parts`` IS the provenance: callers key on the content
+        digests of every input the computation consumed (prefix-config
+        digest, source-X fingerprint, fold-mask fingerprint, sharding)
+        so a mutated source yields a fresh key — invalidation by
+        construction, same contract as :meth:`put` (entries are never
+        revalidated on hit).  The whole miss path runs under the plane
+        lock so two searches racing on one digest compute it once."""
+        key = ("derived",) + tuple(key_parts)
+        with self._lock:
+            cached = self._get(key)
+            if cached is not None:
+                return cached, True
+            self.misses += 1
+            nbytes = int(nbytes)
+            with get_tracer().span("dataplane.derive", bytes=nbytes,
+                                   label=label):
+                dev = maker()
+            self.bytes_derived += nbytes
+            self._insert(key, dev, nbytes, tenant=tenant, label=label)
+            return dev, False
 
     # -- introspection ---------------------------------------------------
     @property

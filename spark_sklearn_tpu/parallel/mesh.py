@@ -133,6 +133,22 @@ class TpuConfig:
     # cannot fuse fall back to per_chunk and record the reason in
     # search_report["chunkloop"].  None defers to SST_CHUNK_LOOP.
     chunk_loop: Optional[str] = None
+    # shared-prefix search graphs (search/prefix.py): treat a Pipeline
+    # candidate as a DAG, not an atom — group candidates by a content
+    # digest of their transformer-chain params, compute each DISTINCT
+    # prefix once per fold on device, cache the transformed design
+    # matrix in the DataPlane (normal tenant/byte accounting), and fan
+    # the suffix candidates over the cached matrices through the
+    # existing chunk/scan machinery: an O(candidates) preprocessing
+    # bill becomes O(distinct prefixes).  Bit-exact with the atomic
+    # path by construction (same ops, same order — pinned by test).
+    # False is the exact escape hatch: every candidate runs as one
+    # atomic program, byte-identical to pre-prefix behavior.  Searches
+    # that cannot stage (non-Pipeline families, task-batched finals,
+    # sharded/streamed data) fall back atomically and record the
+    # reason in search_report["prefix"].  None defers to
+    # SST_PREFIX_REUSE (1/0), then True.
+    prefix_reuse: Optional[bool] = None
     # force the nested per-(candidate, fold) score path even when every
     # scorer exposes a task-batched core — the A/B control arm
     # (tools/score_ab.py).  None/False keeps the wide path; the

@@ -500,12 +500,22 @@ class PlanKey:
     #: across modes so the checkpoint journal and the per-chunk OOM
     #: fallback stay chunk-id-compatible.
     chunk_loop: str = "per_chunk"
+    #: per-group shared-prefix digest (None per group when the group
+    #: runs the atomic pipeline path; empty tuple = planner predates
+    #: prefixes / non-pipeline search).  Joins the identity so a plan
+    #: priced for prefix-staged groups — whose stage-2 chunks carry a
+    #: prefix-buffer dependency — never aliases an atomic plan with the
+    #: same sizes, and so the journaled geometry replay
+    #: (``GeometryMismatchError``) catches a resume whose prefix
+    #: grouping drifted from the killed run's.
+    prefix: Tuple[Optional[str], ...] = ()
 
     def to_json(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
         d["sizes"] = list(self.sizes)
         d["sorted_caps"] = list(self.sorted_caps)
         d["width_caps"] = list(self.width_caps)
+        d["prefix"] = list(self.prefix)
         return d
 
     @classmethod
@@ -536,7 +546,9 @@ class PlanKey:
                                    [None] * len(j["sizes"]))),
                 fusion_lane_discount=float(
                     j.get("fusion_lane_discount", 0.0)),
-                chunk_loop=str(j.get("chunk_loop", "per_chunk")))
+                chunk_loop=str(j.get("chunk_loop", "per_chunk")),
+                prefix=tuple(None if p is None else str(p)
+                             for p in j.get("prefix", [])))
         # legacy positional lists, length-gated exactly as the old
         # decoder was: min_width rode in after plans.json shipped (8
         # elements = floor 0), HBM caps later still (= uncapped), the
@@ -574,6 +586,7 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
                   width_caps: Optional[Sequence[Optional[int]]] = None,
                   fusion_lane_discount: float = 0.0,
                   chunk_loop: str = "per_chunk",
+                  prefix: Optional[Sequence[Optional[str]]] = None,
                   ) -> GeometryPlan:
     """Choose every compile group's chunk width.
 
@@ -618,6 +631,15 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
     differently — the carry buffer and the stacked per-segment
     operands — is planned separately by :func:`plan_scan_segments`.
 
+    ``prefix`` names each group's shared-prefix digest (None for
+    atomic groups) when the caller runs a prefix-staged Pipeline
+    search (``search/prefix.py``).  Like ``chunk_loop`` it does not
+    change the chosen widths — suffix chunks cover the same candidate
+    ranges either way — but it joins the :class:`PlanKey` so
+    prefix-staged plans journal, cache and replay separately from
+    atomic plans over the same sizes, and a resume whose prefix
+    grouping drifted trips the journaled-geometry check.
+
     ``min_width`` floors every auto-chosen unsorted width (rounded up
     to the shard multiple, capped by ``max_width``) — the halving
     scheduler's ``TpuConfig.min_rung_width`` guard against
@@ -654,6 +676,13 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
         raise ValueError(
             f"chunk_loop must be one of {CHUNK_LOOP_MODES}, "
             f"got {chunk_loop!r}")
+    prefix_key: Tuple[Optional[str], ...] = ()
+    if prefix is not None:
+        if len(prefix) != len(sizes):
+            raise ValueError(
+                f"prefix digests ({len(prefix)}) must match groups "
+                f"({len(sizes)})")
+        prefix_key = tuple(None if p is None else str(p) for p in prefix)
     cache_key = PlanKey(
         sizes=tuple(sizes), sorted_caps=tuple(sorted_caps),
         n_folds=int(n_folds), n_task_shards=int(n_task_shards),
@@ -662,7 +691,7 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
         lane_cost_override=lane_cost_override,
         min_width=int(min_width), width_caps=tuple(caps),
         fusion_lane_discount=fusion_lane_discount,
-        chunk_loop=str(chunk_loop))
+        chunk_loop=str(chunk_loop), prefix=prefix_key)
     if reuse:
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)

@@ -1755,6 +1755,15 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         score_times=score_times, ckpt=ckpt,
                         fit_failed=fit_failed, candidates=candidates)
                 else:
+                    # content fp of host X for the shared-prefix derived
+                    # cache key — only worth hashing when the family can
+                    # actually stage prefixes (compiled Pipeline with
+                    # transformer steps, dense host X)
+                    data_fp = None
+                    if (hasattr(family, "prefix_digest")
+                            and getattr(family, "steps", None)
+                            and isinstance(data.get("X"), np.ndarray)):
+                        data_fp = _dataplane.fingerprint(data["X"])
                     self._run_groups(
                         groups=groups, base_params=base_params,
                         family=family,
@@ -1776,7 +1785,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         fit_times=fit_times, score_times=score_times,
                         ckpt=ckpt,
                         fit_failed=fit_failed, candidates=candidates,
-                        host_eval=host_eval)
+                        host_eval=host_eval, data_fp=data_fp)
         finally:
             if profiler_cm is not None:
                 profiler_cm.__exit__(None, None, None)
@@ -1905,7 +1914,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     fit_masks, mesh, config, n_task_shards, task_shard,
                     max_cand_per_batch, n_folds, dtype, return_train,
                     test_scores, train_scores, fit_times, score_times, ckpt,
-                    fit_failed, candidates, host_eval=None):
+                    fit_failed, candidates, host_eval=None, data_fp=None):
         """Chunked launch schedule, executed through the pipelined chunk
         executor (parallel/pipeline.py).
 
@@ -2038,6 +2047,35 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             score_attribution="folded" if scan_mode else "calibrated")
         if chunk_loop == "scan" and not fused_mode:
             cl_state["fallbacks"].append("unfused-score-path")
+        # shared-prefix search graphs (search/prefix.py): group the
+        # Pipeline grid's candidates by their transformer-chain digest,
+        # compute each DISTINCT prefix once per fold on device (stage
+        # 1, below, after geometry resolves), and fan the suffix
+        # candidates over the cached matrices through the ordinary
+        # chunk/scan machinery.  Ineligible searches run the atomic
+        # path unchanged and record the reason; prefix_reuse=False is
+        # the byte-identical escape hatch.
+        from spark_sklearn_tpu.search import prefix as _prefix
+        px_on = _prefix.resolve_prefix_reuse(config)
+        px_state = _prefix.prefix_block(
+            metrics.struct("prefix"),
+            mode="shared" if px_on else "atomic", enabled=False)
+        px_reason = None
+        if px_on:
+            px_reason = _prefix.prefix_fallback_reason(
+                family, all_cores=all_cores,
+                n_data_shards=int(config.n_data_shards),
+                x_dev=data_dev.get("X"))
+            if px_reason is None and plane is None:
+                # the derived-buffer cache IS the data plane; without
+                # it there is nowhere resident to fan suffixes over
+                px_reason = "dataplane-disabled"
+            if px_reason is None and data_fp is None:
+                px_reason = "no-x-fingerprint"
+            if px_reason is not None \
+                    and px_reason not in px_state["fallbacks"]:
+                px_state["fallbacks"].append(px_reason)
+        px_stage = px_on and px_reason is None
         if scan_mode:
             from jax import lax
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -2162,6 +2200,20 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 "gi": gi, "group": group, "static": static, "nc": nc,
                 "sorted": sorted_chunks, "sorted_cap": sorted_cap})
 
+        # per-group prefix digests (stage-1 grouping): groups map
+        # many-to-one onto digests — groups differing only in
+        # final-step statics share the digest, and therefore the
+        # cached transformed matrix
+        px_digests = [None] * len(plans)
+        if px_stage:
+            px_digests = _prefix.group_prefix_digests(
+                groups, base_params, family)
+            if all(d is None for d in px_digests):
+                px_stage = False
+                px_state["fallbacks"].append("undigestable-prefix")
+        for plan, dg in zip(plans, px_digests):
+            plan["prefix"] = dg if px_stage else None
+
         # ------------------------------------------------------------------
         # waste-aware launch geometry (parallel/taskgrid.plan_geometry):
         # per-group chunk widths from power-of-two bucketing over the
@@ -2239,7 +2291,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # byte-identical across modes so journals and the per-chunk
             # OOM fallback interoperate); the key field keeps the two
             # modes' plans distinct cache residents all the same
-            chunk_loop=chunk_loop)
+            chunk_loop=chunk_loop,
+            # per-group shared-prefix digests join the PlanKey: a
+            # prefix-staged plan (suffix programs over cached (F, n,
+            # d') matrices) must never alias an atomic plan with the
+            # same sizes in the plan cache or plans.json
+            prefix=[p["prefix"] for p in plans])
         #: per-group structure identity ACROSS rungs: the static params
         #: minus the budgeted resource (survivor groups at rung k+1
         #: carry the same key as the rung-0 group they came from, even
@@ -2328,6 +2385,32 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 geo = _dc.replace(jplan, source="journal")
             else:
                 ckpt.put_meta("geometry_plan", geo.to_dict())
+            # the prefix grouping journals beside the geometry: chunk
+            # results written under a prefix-staged run carry suffix
+            # semantics (same numbers, but per-group programs keyed on
+            # the digest), and a resume whose digests drifted — grid
+            # edited, step params changed, prefix_reuse toggled off —
+            # must fail loudly like any other geometry drift, never
+            # mix.  Atomic searches journal NO prefix meta (their
+            # checkpoint artifacts stay byte-compatible with the
+            # pre-prefix format and the prefix_reuse=False escape
+            # hatch), so an atomic checkpoint may resume under shared
+            # staging: the durable chunks are bit-exact either way and
+            # the meta then records the shared grouping going forward
+            px_cur = [p["prefix"] for p in plans]
+            px_journalled = ckpt.get_meta("prefix_plan")
+            if px_journalled is not None:
+                if list(px_journalled) != list(px_cur):
+                    raise GeometryMismatchError(
+                        "checkpoint was written under a different "
+                        "shared-prefix grouping (journalled per-group "
+                        f"digests = {px_journalled}, current = "
+                        f"{px_cur}); resuming would mix prefix-staged "
+                        "and atomic chunk results.  Delete "
+                        f"{ckpt.path!r} or restore the original grid/"
+                        "prefix_reuse configuration.")
+            elif any(d is not None for d in px_cur):
+                ckpt.put_meta("prefix_plan", px_cur)
         metrics.put("geometry", geo.report_block())
         if rung is not None:
             # rung bookkeeping: remember rung-0 widths (the pin/affinity
@@ -2402,6 +2485,122 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 ledger.note_group(rec)
                 mem_ctx["groups"].append(rec)
 
+        def plan_data(plan):
+            """The launch data dict: prefix-staged plans swap the raw
+            X for their cached per-fold transformed matrices
+            (``data_d["X_folds"]``, (F, n, d')); atomic plans share
+            the search-wide broadcast dict."""
+            return plan.get("data_dev") or data_dev
+
+        # ------------------------------------------------------------------
+        # stage 1 — shared-prefix compute (search/prefix.py): one
+        # launch per DISTINCT transformer-chain digest, vectorized
+        # over folds, with the stacked (F, n, d') matrix cached in the
+        # DataPlane as a derived buffer (tenant-charged, labelled with
+        # the rung namespace so halving's barrier can demote retired
+        # rungs' matrices).  Completion is journaled with a durable
+        # npz payload, so kill-resume re-UPLOADS a finished prefix
+        # instead of recomputing it.  Digests with no live chunks are
+        # skipped entirely — a fully-journaled rung replays without
+        # touching the device.
+        # ------------------------------------------------------------------
+        px_label = (f"prefix.{rung.ns}." if rung is not None
+                    and rung.resource == "n_samples" else "prefix.")
+        if px_stage:
+            from spark_sklearn_tpu.utils import checkpoint as _ckpt_mod
+            t_px0 = time.perf_counter()
+            distinct = {}
+            for plan in plans:
+                if plan["prefix"] is not None and plan["n_live"] > 0:
+                    distinct.setdefault(plan["prefix"],
+                                        []).append(plan)
+            x_sharding = getattr(data_dev["X"], "sharding", None)
+            base_no_x = {k: v for k, v in data_dev.items()
+                         if k != "X"}
+            n_computed = n_resumed = n_reused = 0
+            px_bytes = 0
+            ck_dir = (_os.path.dirname(ckpt.path)
+                      if ckpt is not None else None)
+            with get_tracer().span("prefix.stage",
+                                   n_distinct=len(distinct)):
+                for dg, dplans in distinct.items():
+                    rep = dplans[0]
+
+                    def _build(_s=rep["static"]):
+                        return jax.jit(
+                            lambda data_d, w_f:
+                            family.prefix_transform(_s, data_d, w_f))
+
+                    # keyed on the DIGEST, not the group statics: two
+                    # groups differing only in final-step params share
+                    # one compiled transform
+                    tf_jit = _cached_program(
+                        ("prefix", family, dg, meta, mesh), _build)
+                    aval = jax.eval_shape(tf_jit, data_dev, fit_dev)
+                    nbytes = (int(np.prod(aval.shape))
+                              * np.dtype(aval.dtype).itemsize)
+                    key_parts = (dg, fit_masks_fp(), data_fp,
+                                 _dataplane._sharding_key(x_sharding))
+                    kp_fp = _ckpt_mod.fingerprint(*key_parts)
+                    npz_path = (_os.path.join(ck_dir,
+                                              f"prefix_{kp_fp}")
+                                if ck_dir is not None else None)
+                    ck_meta = (ckpt.get_meta(f"prefix:{kp_fp}")
+                               if ckpt is not None else None)
+                    how = {}
+
+                    def maker(_ckm=ck_meta, _jit=tf_jit,
+                              _path=npz_path, _how=how):
+                        if _ckm is not None and _path is not None:
+                            try:
+                                host = _ckpt_mod.load_pytree(_path)
+                                _how["src"] = "resumed"
+                                return _dataplane.upload(
+                                    np.asarray(host), x_sharding,
+                                    label=px_label + "xt")
+                            # a journal meta whose npz payload is
+                            # missing/torn (killed mid-write) is not
+                            # an error: the recompute below is
+                            # bit-exact with what the payload held
+                            # sstlint: disable=swallowed-exception
+                            except Exception:
+                                _how.pop("src", None)
+                        _how["src"] = "computed"
+                        return _jit(data_dev, fit_dev)
+
+                    xt_dev, cache_hit = plane.derived(
+                        key_parts, maker, nbytes,
+                        label=px_label + "xt", tenant=sched_tenant)
+                    if cache_hit:
+                        n_reused += 1
+                    elif how.get("src") == "resumed":
+                        n_resumed += 1
+                    else:
+                        n_computed += 1
+                        jax.block_until_ready(xt_dev)
+                        if ckpt is not None and npz_path is not None:
+                            _ckpt_mod.save_pytree(
+                                np.asarray(xt_dev), npz_path)
+                            ckpt.put_meta(f"prefix:{kp_fp}",
+                                          {"path": npz_path})
+                    px_bytes += nbytes
+                    for p in dplans:
+                        p["data_dev"] = {**base_no_x,
+                                         "X_folds": xt_dev}
+            px_state["enabled"] = True
+            n_cand_px = sum(p["nc"] for ps in distinct.values()
+                            for p in ps)
+            px_state["n_candidates_total"] += n_cand_px
+            px_state["n_prefixes_distinct"] += len(distinct)
+            px_state["n_prefix_launches"] += n_computed
+            px_state["n_prefix_reused"] += n_reused
+            px_state["n_prefix_resumed"] += n_resumed
+            px_state["recompute_saved"] += max(
+                0, n_cand_px - n_computed)
+            px_state["bytes_cached"] += px_bytes
+            px_state["prefix_wall_s"] += round(
+                time.perf_counter() - t_px0, 6)
+
         def build_programs(plan, width=None):
             """The group's jitted programs (cross-search cached); built
             on first need so fully-resumed groups never trace.  `width`
@@ -2415,6 +2614,17 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 return progs
             static = plan["static"]
             donate_kw = {"donate_argnums": (0,)} if donate else {}
+            # prefix-staged plans fit/score the SUFFIX family over the
+            # cached per-fold matrices (data_d["X_folds"][fold]); the
+            # digest joins every cache/store key below so suffix
+            # programs — traced on transformed shapes — never alias
+            # the atomic pipeline's programs
+            px = plan.get("prefix")
+            suffix_fam = family.suffix_family() if px else None
+
+            def _fold_data(data_d, Xf):
+                return {**{k: v for k, v in data_d.items()
+                           if k != "X_folds"}, "X": Xf}
 
             if task_batched:
                 # flatten (candidate x fold) into one leading task axis and
@@ -2441,6 +2651,18 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
 
             def fit_batch(dyn_arrs, data_d, train_m, static=static):
                 def one_cand(dyn_scalars):
+                    if px:
+                        # suffix fit: fold f consumes its own cached
+                        # transformed matrix — same ops, same order as
+                        # the fused inline transform (bit-exact by
+                        # construction, pinned by test_prefix.py)
+                        def one_fold_px(w, Xf):
+                            return suffix_fam.fit(
+                                dyn_scalars, static,
+                                _fold_data(data_d, Xf), w, meta)
+                        return jax.vmap(one_fold_px)(
+                            train_m, data_d["X_folds"])
+
                     def one_fold(w):
                         return family.fit(dyn_scalars, static, data_d, w,
                                           meta)
@@ -2455,15 +2677,30 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 flat = jax.tree_util.tree_map(
                     lambda l: l.reshape((n_tasks,) + l.shape[2:]), models)
                 views = {}
-                wide = getattr(family, "views_task_batched", None)
-                if wide is not None:
-                    views = dict(wide(flat, static, data_d, meta,
-                                      needed_views))
-                for name in needed_views:
-                    if name not in views:
+                if px:
+                    # suffix views: task t scores on its fold's cached
+                    # matrix — the per-task gather X_folds[t % nf]
+                    # fuses into the view matmul under vmap, so no
+                    # (T, n, d') operand ever materializes
+                    fi_all = jnp.arange(n_tasks, dtype=jnp.int32) % nf
+                    xf = data_d["X_folds"]
+                    for name in needed_views:
                         views[name] = jax.vmap(
-                            lambda m, name=name: build_view(
-                                name, family, m, static, data_d, meta))(flat)
+                            lambda m, fi, name=name: build_view(
+                                name, suffix_fam, m, static,
+                                _fold_data(data_d, xf[fi]), meta))(
+                                    flat, fi_all)
+                else:
+                    wide = getattr(family, "views_task_batched", None)
+                    if wide is not None:
+                        views = dict(wide(flat, static, data_d, meta,
+                                          needed_views))
+                    for name in needed_views:
+                        if name not in views:
+                            views[name] = jax.vmap(
+                                lambda m, name=name: build_view(
+                                    name, family, m, static, data_d,
+                                    meta))(flat)
 
                 y = data_d.get("y")
                 # fold masks are indexed per task (t % n_folds,
@@ -2544,12 +2781,13 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 fused_jit = _cached_program(
                     ("fused", family, static, meta, nc_batch, n_folds,
                      bool(config.bf16_matmul), mesh, score_key,
-                     return_train, sw_blind, donate),
+                     return_train, sw_blind, donate, px),
                     lambda: jax.jit(fused_batch, **donate_kw),
                     store_parts=None if donate else (
                         "fused", family.name, static, meta, nc_batch,
                         n_folds, bool(config.bf16_matmul), mesh_desc,
-                        store_score_names, store_sw_key, return_train),
+                        store_score_names, store_sw_key, return_train,
+                        px),
                     store=search_store)
             # separate fit/score programs: the non-fused path runs them
             # for every chunk; the fused path runs them for each group's
@@ -2560,19 +2798,20 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
             # compiled.
             if not task_batched:
                 fit_jit = _cached_program(
-                    ("fit", family, static, meta, mesh, donate),
+                    ("fit", family, static, meta, mesh, donate, px),
                     lambda: jax.jit(fit_batch, out_shardings=task_shard,
                                     **donate_kw),
                     store_parts=None if donate else (
-                        "fit", family.name, static, meta, mesh_desc),
+                        "fit", family.name, static, meta, mesh_desc,
+                        px),
                     store=search_store)
             score_jit = _cached_program(
                 ("score", family, static, meta, score_key, return_train,
-                 sw_blind, bool(all_cores)),
+                 sw_blind, bool(all_cores), px),
                 lambda: jax.jit(score_batch),
                 store_parts=("score", family.name, static, meta,
                              mesh_desc, store_score_names, store_sw_key,
-                             return_train, bool(all_cores)),
+                             return_train, bool(all_cores), px),
                 store=search_store)
             progs = {"fit": fit_jit, "score": score_jit,
                      "fused": fused_jit,
@@ -2681,7 +2920,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 ("scan", family, plan["static"], meta, plan["nc_batch"],
                  n_folds, int(n_steps), bool(config.bf16_matmul), mesh,
                  score_key, return_train, sw_blind, donate,
-                 int(topk_k), nc, repr(float(errval)))
+                 int(topk_k), nc, repr(float(errval)),
+                 plan.get("prefix"))
                 + (("hb",) if hb else ()),
                 lambda: jax.jit(scan_batch, **donate_kw),
                 store_parts=None)
@@ -2783,7 +3023,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 else:
                     w_spec = fit_dev
                 plan["aot_future"] = pipe.submit_precompile(
-                    progs["fused"], dyn_spec, data_dev, w_spec,
+                    progs["fused"], dyn_spec, plan_data(plan), w_spec,
                     test_dev, train_sc_dev, test_unw_dev, train_unw_dev,
                     label=f"fused group {plan['gi']}")
             # sstlint: disable=launch-except-taxonomy — AOT compile-ahead
@@ -2897,7 +3137,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                              tb_mask_shard, label=tiled_label))
                 else:
                     w = fit_dev
-                out = progs["fused"](dyn, data_dev, w, test_dev,
+                out = progs["fused"](dyn, plan_data(plan), w, test_dev,
                                      train_sc_dev, test_unw_dev,
                                      train_unw_dev)
                 out = sup.wait_ready(out, key=key, group=plan["gi"])
@@ -2997,12 +3237,14 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 bool(sw_blind), str(np.dtype(dtype)),
                 int(n_task_shards), bool(task_batched),
                 tuple(sorted(group.dynamic_params)), fit_masks_fp(),
+                plan.get("prefix"),
                 # device-buffer identities: live refs are held by the
                 # member closures, so ids are stable for the launch's
                 # lifetime, and the plane's dedup makes equal content
-                # mean equal objects across searches
+                # mean equal objects across searches (the prefix-staged
+                # plans pass their own derived per-fold matrices here)
                 tuple(id(leaf) for leaf in
-                      jax.tree_util.tree_leaves(data_dev)),
+                      jax.tree_util.tree_leaves(plan_data(plan))),
                 id(fit_dev), id(test_dev), id(train_sc_dev),
                 id(test_unw_dev), id(train_unw_dev))
 
@@ -3044,7 +3286,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                              tb_mask_shard, label=tiled_label))
                 else:
                     w = fit_dev
-                return progs["fused"](dyn, data_dev, w, test_dev,
+                return progs["fused"](dyn, plan_data(plan), w, test_dev,
                                       train_sc_dev, test_unw_dev,
                                       train_unw_dev)
 
@@ -3373,12 +3615,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                             # scan program is shared across searches
                             return build_scan(
                                 plan, n_steps, seg_topk, hb=True)(
-                                dyn, idx_st, data_dev, w, test_dev,
+                                dyn, idx_st, plan_data(plan), w, test_dev,
                                 train_sc_dev, test_unw_dev,
                                 train_unw_dev,
                                 np.asarray(tok, np.int32))
                         return build_scan(plan, n_steps, seg_topk)(
-                            dyn, idx_st, data_dev, w, test_dev,
+                            dyn, idx_st, plan_data(plan), w, test_dev,
                             train_sc_dev, test_unw_dev, train_unw_dev)
 
                 def gather(out, members=members, seg_topk=seg_topk):
@@ -3571,8 +3813,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         def launch(payload, plan=plan):
                             dyn, w = payload
                             return resolve_fused(plan)(
-                                dyn, data_dev, w, test_dev, train_sc_dev,
-                                test_unw_dev, train_unw_dev)
+                                dyn, plan_data(plan), w, test_dev,
+                                train_sc_dev, test_unw_dev, train_unw_dev)
 
                         def gather(out):
                             te, tr, bad, it_max, it_sum = out
@@ -3626,7 +3868,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     def launch_fit(payload, plan=plan, cstate=cstate):
                         dyn, w = payload
                         models = build_programs(plan)["fit"](
-                            dyn, data_dev, w)
+                            dyn, plan_data(plan), w)
                         cstate["models"] = models
                         bad = _models_health(models)
                         it_arr = None
@@ -3682,7 +3924,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         if "host" in cstate:
                             return None   # chunk recovered on the host
                         return build_programs(plan)["score"](
-                            cstate["models"], data_dev, test_dev,
+                            cstate["models"], plan_data(plan), test_dev,
                             train_sc_dev, test_unw_dev, train_unw_dev)
 
                     def gather_score(out, cstate=cstate):
@@ -3736,7 +3978,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                                 gstate["cal_skip"] = True
                                 return None
                             return build_programs(plan)["score"](
-                                models, data_dev, test_dev,
+                                models, plan_data(plan), test_dev,
                                 train_sc_dev, test_unw_dev,
                                 train_unw_dev)
 
@@ -3894,6 +4136,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 enabled=scan_mode,
                 score_attribution="folded" if scan_mode
                 else "calibrated"))
+            metrics.put("prefix", _prefix.prefix_block(
+                metrics.struct("prefix"),
+                mode="shared" if px_on else "atomic",
+                enabled=bool(px_state.get("enabled"))))
             # feed the measured per-launch overhead / per-lane cost back
             # into the geometry planner's cost model: the NEXT search
             # over a new structure prices its widths from real walls

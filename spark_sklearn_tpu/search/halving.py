@@ -390,6 +390,14 @@ class BaseSuccessiveHalvingTPU(BaseSearchTPU):
                     # entirely.
                     freed = plane.demote(f"mask.r{itr - 1}.",
                                          binding.tenant)
+                    # same barrier for the shared-prefix derived
+                    # matrices: an n_samples rung re-derives them from
+                    # the NEW subsampled masks, so the previous rung's
+                    # (F, n, d') buffers are stale by construction
+                    # (estimator-parameter resources keep their masks
+                    # — and their prefix buffers — across rungs)
+                    freed += plane.demote(f"prefix.r{itr - 1}.",
+                                          binding.tenant)
                     if freed:
                         logger.info(
                             "halving rung %d: demoted %d stale mask "

@@ -1,0 +1,160 @@
+"""Shared-prefix search graphs: plan + report helpers.
+
+spark-sklearn's home-turf workload is ``Pipeline(vectorize → reduce →
+clf)`` grid search, and the compiled :class:`~spark_sklearn_tpu.models.
+pipeline.PipelineFamily` fuses the transformer chain into every
+candidate's fit — which means a 96-candidate grid whose candidates
+share 4 distinct preprocessing configurations recomputes each
+expensive prefix ~24x per fold.  Ousterhout-style overhead analysis of
+distributed ML (arXiv:1612.01437) names exactly this redundant-
+computation/caching axis as the dominant overhead; DrJAX
+(arXiv:2403.07128) is the reference for keeping the reuse on device.
+
+The shared-prefix scheduler (wired through ``search/grid.py``) treats
+a Pipeline candidate as a DAG, not an atom:
+
+1. **group** compile groups by a content digest of their prefix step
+   params (:meth:`PipelineFamily.prefix_digest` — final-step params
+   excluded, so groups differing only in classifier statics share a
+   digest);
+2. **compute** each DISTINCT prefix once, vectorized over folds on
+   device (:meth:`PipelineFamily.prefix_transform` — the exact
+   mask-weighted statistics the fused fit computes inline, so the
+   split is bit-exact by construction);
+3. **cache** the stacked ``(F, n, d')`` transformed design matrix in
+   the :class:`~spark_sklearn_tpu.parallel.dataplane.DataPlane` as a
+   derived buffer keyed on ``(digest, fold-mask fp, X fp, sharding)``
+   with normal tenant/byte accounting, and journal completion in the
+   search checkpoint so kill-resume never recomputes a durable prefix;
+4. **fan** the suffix candidates over the cached matrices through the
+   existing chunk/scan machinery (the suffix family's programs key on
+   the transformed shapes plus the digest, so they never alias atomic
+   programs).
+
+Everything here is host-side bookkeeping: knob resolution
+(``TpuConfig.prefix_reuse`` / ``SST_PREFIX_REUSE``), the eligibility
+gate with its recorded fallback reasons, digest grouping, and the
+pinned ``search_report["prefix"]`` block (schema in
+``obs.metrics.PREFIX_BLOCK_SCHEMA``).  The device work lives in
+``models/pipeline.py``; the stage scheduling in ``search/grid.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "group_prefix_digests",
+    "prefix_block",
+    "prefix_fallback_reason",
+    "resolve_prefix_reuse",
+]
+
+
+def resolve_prefix_reuse(config) -> bool:
+    """The search's shared-prefix knob: ``TpuConfig.prefix_reuse``
+    wins, then the ``SST_PREFIX_REUSE`` env mirror (1/0), then True
+    (sharing on — the bit-exact fast path)."""
+    val = getattr(config, "prefix_reuse", None)
+    if val is not None:
+        return bool(val)
+    env = os.environ.get("SST_PREFIX_REUSE", "").strip().lower()
+    if env in ("", None):
+        return True
+    if env in ("1", "true", "on", "yes"):
+        return True
+    if env in ("0", "false", "off", "no"):
+        return False
+    raise ValueError(
+        f"SST_PREFIX_REUSE={env!r} is not a boolean; expected 1/0")
+
+
+def prefix_fallback_reason(family, *, all_cores: bool,
+                           n_data_shards: int,
+                           x_dev: Any) -> Optional[str]:
+    """Why this search CANNOT stage prefixes (None = eligible).
+
+    The reasons land verbatim in ``search_report["prefix"]
+    ["fallbacks"]`` so a user who expected the speedup can see which
+    contract their search broke.  Every ineligible search runs the
+    atomic path unchanged — fallback is bit-exact by definition.
+    (Streamed searches never reach this gate: they branch off before
+    the chunked executor; unregistered/host-only pipeline steps never
+    build a compiled family at all, so both fall back upstream.)
+    """
+    if not hasattr(family, "prefix_digest"):
+        return "not-a-compiled-pipeline"
+    if not getattr(family, "steps", None):
+        return "no-prefix-steps"
+    if hasattr(family, "fit_task_batched"):
+        # task-batched finals (SVC) already fold the per-fold transform
+        # into ONE fit per chunk — there is no per-candidate prefix
+        # recompute to save, and their decision-cached scoring never
+        # consumes the transformed X
+        return "task-batched-final"
+    if int(n_data_shards) != 1:
+        return "data-sharded"
+    if x_dev is None:
+        return "no-device-x"
+    if type(x_dev).__name__ == "BCOO":
+        # the sparse device tier keeps X as BCOO; the stacked per-fold
+        # transform would densify it wholesale
+        return "sparse-device-data"
+    if not all_cores:
+        # the nested per-(candidate, fold) score path rebuilds views on
+        # the UNtransformed X; only the wide task-batched score path
+        # indexes the cached per-fold matrices
+        return "nested-score"
+    return None
+
+
+def group_prefix_digests(groups, base_params: Dict[str, Any],
+                         family) -> List[Optional[str]]:
+    """Per-compile-group prefix digest (None when the group's chain
+    cannot be digested).  Groups map to digests many-to-one: groups
+    that differ only in final-step statics share the digest — and the
+    cached matrix."""
+    out: List[Optional[str]] = []
+    for group in groups:
+        static = {**base_params, **group.static_params}
+        try:
+            out.append(family.prefix_digest(static))
+        # a None digest is an EXPECTED outcome, not an error: the
+        # group runs atomic and the scheduler records
+        # 'undigestable-prefix' in the report's fallbacks
+        # sstlint: disable=swallowed-exception
+        except Exception:
+            out.append(None)
+    return out
+
+
+def prefix_block(state, *, mode="shared", enabled=False):
+    """Normalize the ``search_report["prefix"]`` block in place
+    (schema pinned in ``obs.metrics.PREFIX_BLOCK_SCHEMA``).
+
+    The state dict is the registry's own ``metrics.struct("prefix")``
+    object, so the stage-1 scheduler (and halving's rung re-use
+    accounting) mutate the same dict this function returns — a halving
+    search's rungs accumulate into one whole-search block.  Emitted
+    for EVERY search: an atomic search reports the zeroed
+    ``enabled=False`` shape, so the report schema never changes.
+    """
+    defaults = {
+        "mode": mode,
+        "enabled": bool(enabled),
+        "n_candidates_total": 0,
+        "n_prefixes_distinct": 0,
+        "n_prefix_launches": 0,
+        "n_prefix_reused": 0,
+        "n_prefix_resumed": 0,
+        "recompute_saved": 0,
+        "bytes_cached": 0,
+        "prefix_wall_s": 0.0,
+        "fallbacks": [],
+    }
+    for k, v in defaults.items():
+        state.setdefault(k, v)
+    state["mode"] = mode
+    state["enabled"] = bool(enabled)
+    return state

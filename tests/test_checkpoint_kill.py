@@ -54,9 +54,17 @@ def _checkpoint_records(ckpt_dir):
 #: launch past the first durable chunk record so run 1 dies genuinely
 #: mid-compile-group.
 def _family_matrix():
+    from sklearn.decomposition import PCA
     from sklearn.linear_model import LogisticRegression
     from sklearn.naive_bayes import GaussianNB
     from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    def _pipe():
+        return Pipeline([("sc", StandardScaler()),
+                         ("pca", PCA(random_state=0)),
+                         ("clf", LogisticRegression(max_iter=10))])
     return {
         # sorted chunking: 5+ chunks in one group; hung@5 = a fused
         # steady-state chunk
@@ -72,10 +80,18 @@ def _family_matrix():
         "knn": (lambda: KNeighborsClassifier(),
                 {"n_neighbors": [3, 5],
                  "weights": ["uniform", "distance"]}, {}, 3),
+        # shared-prefix Pipeline: two compile groups (n_components is
+        # shape-static), each fanned over one cached prefix; hung@3 =
+        # group 2's score launch, with group 1's chunk AND both prefix
+        # npz payloads already durable — the resume must replay the
+        # journalled prefix plan (PlanKey.prefix) without recompute
+        "pipeline": (_pipe,
+                     {"pca__n_components": [8, 16],
+                      "clf__C": [0.1, 1.0, 10.0]}, {}, 3),
     }
 
 
-@pytest.mark.parametrize("fam", ["logreg", "gnb", "knn"])
+@pytest.mark.parametrize("fam", ["logreg", "gnb", "knn", "pipeline"])
 def test_mid_group_fault_retry_resume_parity(digits, tmp_path, fam):
     """Recovery-vs-parity across the family matrix: run 1 dies to an
     injected hang mid-compile-group (earlier chunks durable); run 2
@@ -159,11 +175,18 @@ _FAMILY_CHILD_EST = {
             "GaussianNB()"),
     "knn": ("from sklearn.neighbors import KNeighborsClassifier",
             "KNeighborsClassifier()"),
+    "pipeline": ("from sklearn.pipeline import Pipeline\n"
+                 "from sklearn.preprocessing import StandardScaler\n"
+                 "from sklearn.decomposition import PCA\n"
+                 "from sklearn.linear_model import LogisticRegression",
+                 "Pipeline([('sc', StandardScaler()), "
+                 "('pca', PCA(random_state=0)), "
+                 "('clf', LogisticRegression(max_iter=10))])"),
 }
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("fam", ["logreg", "gnb", "knn"])
+@pytest.mark.parametrize("fam", ["logreg", "gnb", "knn", "pipeline"])
 def test_sigkill_family_matrix_resume_parity(digits, tmp_path, fam):
     """The family matrix through a REAL ``kill -9`` (not an injected
     in-process hang): a subprocess search per family is SIGKILLed after

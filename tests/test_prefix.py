@@ -1,0 +1,213 @@
+"""Shared-prefix search graphs (``TpuConfig(prefix_reuse=...)``).
+
+Contracts under test:
+
+  - **bit-exact parity**: computing each DISTINCT Pipeline prefix once
+    and fanning suffix candidates over the cached per-fold matrices
+    changes the launch schedule, never the numbers — ``cv_results_``
+    is exactly equal to the atomic path (``prefix_reuse=False``, the
+    pinned escape hatch) for exhaustive and halving searches at
+    pipeline depths 0 and 2, dense and sparse input;
+  - **the prefix compute actually collapses**: a 4-distinct-prefix x
+    6-suffix grid launches 4 prefix transforms, not 24 —
+    ``search_report["prefix"]`` books distinct < candidates and
+    ``recompute_saved > 0``, with the block schema pinned to
+    ``PREFIX_BLOCK_SCHEMA``;
+  - **eligibility is observable**: ineligible searches (plain
+    estimators, sparse device tiers) run atomic and record WHY in
+    ``fallbacks``; ``SST_PREFIX_REUSE`` resolves the knob with the
+    explicit config winning;
+  - **kill-resume never recomputes a durable prefix**: the stage-1
+    journal's npz payload re-uploads on resume (``n_prefix_resumed``),
+    and a resume whose prefix grouping drifted (``prefix_reuse``
+    toggled) fails loudly with ``GeometryMismatchError`` instead of
+    mixing prefix-staged and atomic chunk results.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import spark_sklearn_tpu as sst
+from spark_sklearn_tpu.obs.metrics import PREFIX_BLOCK_SCHEMA
+from spark_sklearn_tpu.parallel.taskgrid import GeometryMismatchError
+
+
+def _non_time_results(gs):
+    return {k: v for k, v in gs.cv_results_.items()
+            if "time" not in k and k != "params"}
+
+
+def _assert_exact_equal(ra, rb):
+    assert set(ra) == set(rb)
+    for k in ra:
+        np.testing.assert_array_equal(
+            np.asarray(ra[k]), np.asarray(rb[k]), err_msg=k)
+
+
+def _pipe():
+    from sklearn.decomposition import PCA
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+    return Pipeline([("sc", StandardScaler()),
+                     ("pca", PCA(random_state=0)),
+                     ("clf", LogisticRegression(max_iter=10))])
+
+
+#: 4 distinct prefixes x 6 suffix candidates = 24-candidate grid
+_GRID = {"pca__n_components": [8, 16, 24, 32],
+         "clf__C": np.logspace(-2, 1, 6).tolist()}
+
+#: explicit cost overrides so planned widths are process-order
+#: independent (the global geometry cost model learns across tests)
+_OVR = dict(geometry_overhead_s=0.01, geometry_lane_cost_s=1e-3)
+
+
+def _fit_grid(X, y, grid=None, est=None, **cfg_kw):
+    cfg_kw.setdefault("max_tasks_per_batch", 16)
+    cfg_kw.update(_OVR)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.GridSearchCV(
+            est if est is not None else _pipe(), grid or _GRID, cv=2,
+            refit=False, backend="tpu",
+            config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+def _fit_halving(X, y, **cfg_kw):
+    cfg_kw.setdefault("max_tasks_per_batch", 16)
+    cfg_kw.update(_OVR)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return sst.HalvingGridSearchCV(
+            _pipe(), {"pca__n_components": [8, 16],
+                      "clf__C": np.logspace(-2, 1, 4).tolist()},
+            cv=3, factor=2, random_state=7, backend="tpu",
+            scoring="neg_log_loss",
+            config=sst.TpuConfig(**cfg_kw)).fit(X, y)
+
+
+class TestPrefixParityExhaustive:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_shared_matches_atomic_exact(self, digits, depth):
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        shared = _fit_grid(Xs, ys, pipeline_depth=depth)
+        atomic = _fit_grid(Xs, ys, pipeline_depth=depth,
+                           prefix_reuse=False)
+        _assert_exact_equal(_non_time_results(shared),
+                            _non_time_results(atomic))
+
+        px = shared.search_report["prefix"]
+        assert px["mode"] == "shared" and px["enabled"]
+        assert px["fallbacks"] == []
+        # the collapse: 24 candidates, 4 distinct prefixes, 4 launches
+        assert px["n_candidates_total"] == 24
+        assert px["n_prefixes_distinct"] == 4
+        assert px["n_prefix_launches"] <= 4
+        assert px["n_prefixes_distinct"] < px["n_candidates_total"]
+        assert px["recompute_saved"] >= 20
+        assert px["bytes_cached"] > 0
+        # the escape hatch reports itself atomic and stages nothing
+        pa = atomic.search_report["prefix"]
+        assert pa["mode"] == "atomic" and not pa["enabled"]
+        assert pa["n_prefix_launches"] == 0
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_sparse_input_parity(self, digits, depth):
+        """CSR input through the default device tier: wherever the
+        engine lands it (densified or sparse-atomic), shared and
+        atomic must agree exactly."""
+        import scipy.sparse as sp
+        X, y = digits
+        Xs = sp.csr_matrix(X[:240])
+        shared = _fit_grid(Xs, y[:240], pipeline_depth=depth)
+        atomic = _fit_grid(Xs, y[:240], pipeline_depth=depth,
+                           prefix_reuse=False)
+        _assert_exact_equal(_non_time_results(shared),
+                            _non_time_results(atomic))
+
+    def test_report_block_matches_schema(self, digits):
+        X, y = digits
+        gs = _fit_grid(X[:240], y[:240])
+        px = gs.search_report["prefix"]
+        assert set(px) == {d.name for d in PREFIX_BLOCK_SCHEMA}
+        # a plain (non-pipeline) estimator reports WHY it stayed atomic
+        from sklearn.linear_model import LogisticRegression
+        flat = _fit_grid(X[:240], y[:240],
+                         grid={"C": [0.5, 1.0]},
+                         est=LogisticRegression(max_iter=10))
+        pf = flat.search_report["prefix"]
+        assert not pf["enabled"]
+        assert "not-a-compiled-pipeline" in pf["fallbacks"]
+        assert set(pf) == {d.name for d in PREFIX_BLOCK_SCHEMA}
+
+    def test_env_knob_resolves(self, digits, monkeypatch):
+        X, y = digits
+        monkeypatch.setenv("SST_PREFIX_REUSE", "0")
+        gs = _fit_grid(X[:240], y[:240])
+        assert gs.search_report["prefix"]["mode"] == "atomic"
+        # an explicit config wins over the env
+        gs2 = _fit_grid(X[:240], y[:240], prefix_reuse=True)
+        assert gs2.search_report["prefix"]["enabled"]
+
+
+class TestPrefixHalving:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_halving_parity_and_rung_accounting(self, digits, depth):
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        shared = _fit_halving(Xs, ys, pipeline_depth=depth)
+        atomic = _fit_halving(Xs, ys, pipeline_depth=depth,
+                              prefix_reuse=False)
+        _assert_exact_equal(_non_time_results(shared),
+                            _non_time_results(atomic))
+        assert shared.best_params_ == atomic.best_params_
+
+        # rungs accumulate into ONE whole-search block: the total
+        # covers rung 0's full grid PLUS the survivors' rungs
+        px = shared.search_report["prefix"]
+        assert px["enabled"]
+        assert px["n_candidates_total"] > 8
+        assert px["recompute_saved"] > 0
+
+
+class TestPrefixCheckpoint:
+    def test_kill_mid_search_resume_exact(self, digits, tmp_path):
+        """The fatal lands after stage 1 journals every prefix: the
+        resume re-uploads the durable npz payloads — zero prefix
+        recompute — and replays/re-runs chunks to exact equality."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        full = _fit_grid(Xs, ys)
+        ckpt = str(tmp_path / "ckpt")
+        # each distinct n_components is its own compile group (shape-
+        # static), one chunk each: launches 0-1 are group 0's fit +
+        # score, so fatal@2 leaves exactly one durable chunk
+        with pytest.raises(Exception, match="[Ii]njected"):
+            _fit_grid(Xs, ys, checkpoint_dir=ckpt, fault_plan="fatal@2")
+        resumed = _fit_grid(Xs, ys, checkpoint_dir=ckpt)
+        rep = resumed.search_report
+        assert rep["n_chunks_resumed"] > 0
+        px = rep["prefix"]
+        assert px["enabled"]
+        # every prefix the resume needed came from the journal (or the
+        # live plane) — none recomputed on device
+        assert px["n_prefix_resumed"] + px["n_prefix_reused"] > 0
+        assert px["n_prefix_launches"] == 0
+        _assert_exact_equal(_non_time_results(full),
+                            _non_time_results(resumed))
+
+    def test_prefix_drift_raises_mismatch(self, digits, tmp_path):
+        """A checkpoint written under the shared-prefix grouping must
+        refuse to resume atomic (and vice versa): chunk results carry
+        the grouping they were scheduled under."""
+        X, y = digits
+        Xs, ys = X[:240], y[:240]
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Exception, match="[Ii]njected"):
+            _fit_grid(Xs, ys, checkpoint_dir=ckpt, fault_plan="fatal@1")
+        with pytest.raises(GeometryMismatchError, match="prefix"):
+            _fit_grid(Xs, ys, checkpoint_dir=ckpt, prefix_reuse=False)

@@ -644,3 +644,52 @@ class TestTenantQuota:
         h0 = plane.hits
         plane.put(a, None, tenant="t2")
         assert plane.hits == h0 + 1
+
+    def test_shared_prefix_digest_does_not_cross_charge(self):
+        """Two tenants whose searches share a prefix digest share the
+        derived buffer — but the bytes stay charged to the tenant that
+        materialized it; the second tenant rides for free."""
+        plane = DataPlane(byte_budget=1 << 30)
+        plane.set_tenant_quota("t1", 1 << 20)
+        plane.set_tenant_quota("t2", 1 << 20)
+        made = []
+        key = ("dg-abc", "maskfp", "xfp", "shard0")
+
+        def maker():
+            made.append(1)
+            return self._arr(7)
+
+        dev, hit = plane.derived(key, maker, 64 * 1024,
+                                 label="prefix.xt", tenant="t1")
+        assert not hit and len(made) == 1
+        assert plane.tenant_usage("t1") == 64 * 1024
+        dev2, hit2 = plane.derived(key, maker, 64 * 1024,
+                                   label="prefix.xt", tenant="t2")
+        assert hit2 and dev2 is dev and len(made) == 1
+        assert plane.tenant_usage("t2") == 0
+        assert plane.tenant_usage("t1") == 64 * 1024
+        assert plane.bytes_derived == 64 * 1024
+
+    def test_tenant_pressure_cannot_evict_shared_prefix(self):
+        """Tenant t2 blowing its quota on its OWN derived buffers
+        evicts its own LRU — never the shared digest t1 owns."""
+        plane = DataPlane(byte_budget=1 << 30)
+        plane.set_tenant_quota("t1", 256 * 1024)
+        plane.set_tenant_quota("t2", 160 * 1024)
+        shared_key = ("dg-shared", "maskfp", "xfp", "shard0")
+        plane.derived(shared_key, lambda: self._arr(1), 64 * 1024,
+                      label="prefix.xt", tenant="t1")
+        for seed in range(20, 25):
+            plane.derived(("dg-%d" % seed, "m", "x", "s"),
+                          lambda s=seed: self._arr(s), 64 * 1024,
+                          label="prefix.xt", tenant="t2")
+        assert plane.tenant_usage("t2") <= 160 * 1024
+        assert plane.evictions >= 1
+        # t1's shared matrix is still resident: a hit, zero recompute
+        made = []
+        _, hit = plane.derived(shared_key,
+                               lambda: made.append(1) or self._arr(1),
+                               64 * 1024, label="prefix.xt",
+                               tenant="t2")
+        assert hit and not made
+        assert plane.tenant_usage("t1") == 64 * 1024

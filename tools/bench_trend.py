@@ -73,6 +73,12 @@ _WATCHED = (
     # the recovery path (journal fold, lease fence, fingerprint
     # verify, admission) got slower
     ("time_to_recover_s", "up"),
+    # prefix computations avoided in the pipeline_prefix A/B: the
+    # shared-prefix scheduler's whole point is computing each distinct
+    # transformer chain once — this sits at candidates-minus-distinct
+    # for the fixed 4x24 shape, and any drop means candidates started
+    # recomputing their chains (digest grouping or eligibility broke)
+    ("prefix_saved", "down"),
 )
 
 
@@ -110,6 +116,7 @@ def _round_row(path: str) -> Dict[str, Any]:
             spm = serve[key]["searches_per_min"]
     ss = det.get("stream_sparse") or {}
     cl = det.get("chunkloop_scan") or {}
+    px = det.get("pipeline_prefix") or {}
     return {
         "round": n,
         "rc": payload.get("rc"),
@@ -124,6 +131,7 @@ def _round_row(path: str) -> Dict[str, Any]:
         "stream_shards": ss.get("stream_n_shards"),
         "launches_per_group": cl.get("scan_launches_per_group"),
         "hb_overhead": cl.get("hb_overhead_frac"),
+        "prefix_saved": px.get("prefix_saved"),
         "time_to_recover_s": (serve.get("recovery")
                               or {}).get("time_to_recover_s"),
         "parsed": bool(det),
@@ -211,7 +219,8 @@ def format_table(digest: Dict[str, Any]) -> str:
     out = [f"  {'round':>5} {'rc':>4} {'cold s':>9} {'warm s':>9} "
            f"{'halving x':>10} {'hit rate':>9} {'shed':>6} "
            f"{'srch/min':>9} {'sp/dn h2d':>10} {'strm h2d':>9} "
-           f"{'shards':>7} {'l/grp':>6} {'hb ovh':>8} {'ttr s':>7}"]
+           f"{'shards':>7} {'l/grp':>6} {'hb ovh':>8} {'ttr s':>7} "
+           f"{'px svd':>7}"]
     for r in digest["rows"]:
         out.append(
             f"  {r['round']:>5} {str(r['rc']):>4} "
@@ -225,7 +234,8 @@ def format_table(digest: Dict[str, Any]) -> str:
             f"{_fmt(r.get('stream_shards'), 0):>7} "
             f"{_fmt(r.get('launches_per_group')):>6} "
             f"{_fmt(r.get('hb_overhead'), 5):>8} "
-            f"{_fmt(r.get('time_to_recover_s'), 3):>7}"
+            f"{_fmt(r.get('time_to_recover_s'), 3):>7} "
+            f"{_fmt(r.get('prefix_saved'), 0):>7}"
             + ("" if r["parsed"] else "   (no parsed detail)"))
     cmp_ = digest["comparison"]
     out.append(f"comparison: {cmp_['status']} "

@@ -111,7 +111,7 @@ class Project:
                             attrs=("_entries", "_bytes", "_tile_programs",
                                    "hits", "misses", "evictions",
                                    "bytes_uploaded", "bytes_tiled",
-                                   "byte_budget")),
+                                   "bytes_derived", "byte_budget")),
                 SharedState("parallel/dataplane.py",
                             "dataplane.StagingRing._lock",
                             cls="StagingRing", attrs=("_rings",)),
@@ -289,6 +289,10 @@ class Project:
                 BlockSpec("chunkloop", "CHUNKLOOP_BLOCK_SCHEMA", (
                     Producer("dict-keys", "search/grid.py",
                              "chunkloop_block"),
+                )),
+                BlockSpec("prefix", "PREFIX_BLOCK_SCHEMA", (
+                    Producer("dict-keys", "search/prefix.py",
+                             "prefix_block"),
                 )),
                 BlockSpec("heartbeat", "HEARTBEAT_BLOCK_SCHEMA", (
                     Producer("dict-keys", "obs/heartbeat.py",
