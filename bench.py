@@ -362,9 +362,16 @@ def leg_sstlint():
     from tools.sstlint import run_lint
 
     res = run_lint(root=os.path.dirname(os.path.abspath(__file__)))
+    # the declared-registry sizes ride along: a surface or record kind
+    # silently dropping out of the registries shows up in the trend
+    from spark_sklearn_tpu.utils import journalspec, keycheck
     return {"n_rules": res["n_rules"],
             "n_findings": res["n_findings"],
             "n_baselined": res["n_baselined"],
+            "n_key_surfaces": len(keycheck.KEY_SURFACES),
+            "n_journal_kinds": (len(journalspec.CHECKPOINT_RECORD_KINDS)
+                                + len(journalspec.CHECKPOINT_META_KINDS)
+                                + len(journalspec.SERVICE_RECORD_KINDS)),
             "duration_s": res["duration_s"]}
 
 
@@ -1378,6 +1385,10 @@ def leg_pipeline_prefix(cache_dir=None, n_rows=484, n_prefixes=4,
                  f"{tasks_per_batch} tasks/batch",
         "atomic_warm_wall_s": wall_atomic,
         "shared_warm_wall_s": wall_shared,
+        # the rehearsal gate's throughput figure (every breadth leg
+        # must produce one): fits/sec of the shared warm arm
+        "fits_per_sec": round(n_cand * folds / wall_shared, 2)
+        if wall_shared else 0.0,
         "wall_ratio_atomic_over_shared": round(
             wall_atomic / wall_shared, 3) if wall_shared else 0.0,
         "n_candidates": n_cand,

@@ -19,8 +19,24 @@ if [[ "${1:-}" == "--fast" ]]; then
 fi
 
 echo "== sstlint (static analysis gate) =="
-# new (non-baselined) findings exit nonzero and fail the gate
-python -m tools.sstlint spark_sklearn_tpu/
+# new (non-baselined) findings exit nonzero and fail the gate — and the
+# rule count is ASSERTED, so a rule module silently failing to import
+# (which would lint "clean" with fewer rules) also fails the gate
+python - <<'PY'
+import json
+import subprocess
+import sys
+
+proc = subprocess.run(
+    [sys.executable, "-m", "tools.sstlint", "spark_sklearn_tpu/",
+     "--format", "json"], capture_output=True, text=True)
+rep = json.loads(proc.stdout)
+print(f"sstlint: {rep['n_rules']} rules, {rep['n_findings']} new "
+      f"finding(s), {rep['n_baselined']} baselined")
+assert proc.returncode == 0, (proc.returncode, rep.get("findings"))
+assert rep["n_rules"] >= 30, rep["n_rules"]
+assert rep["n_findings"] == 0, rep["findings"]
+PY
 
 echo "== own tests (${1:---full}) =="
 python -m pytest tests/ -q "${MARK[@]}"
@@ -34,6 +50,15 @@ SST_LOCKCHECK=1 python -m pytest tests/test_dataplane.py \
     tests/test_halving.py tests/test_memory.py tests/test_sstlint.py \
     tests/test_doctor.py tests/test_protection.py \
     tests/test_fusion.py tests/test_heartbeat.py -q
+
+echo "== key-flow recorder shard (SST_KEYCHECK=1) =="
+# re-run the key-surface-heavy tests with every cache-key construction
+# recorded: the conftest hook fails the shard if two distinct traced
+# artifacts ever collide on one cache key
+SST_KEYCHECK=1 python -m pytest tests/test_search_basic.py \
+    tests/test_components.py tests/test_fusion.py \
+    tests/test_prefix.py tests/test_programstore.py \
+    tests/test_chunkloop.py -q
 
 echo "== obs smoke (traced CPU grid -> Chrome trace -> summary) =="
 OBS_TRACE=$(mktemp -u /tmp/sst_obs_smoke_XXXX.json)

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 
 from spark_sklearn_tpu.obs import telemetry as _telemetry
 from spark_sklearn_tpu.obs.trace import get_tracer
+from spark_sklearn_tpu.utils import keycheck as _keycheck
 from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
 
 __all__ = [
@@ -455,6 +456,11 @@ class DataPlane:
         revalidated on hit).  The whole miss path runs under the plane
         lock so two searches racing on one digest compute it once."""
         key = ("derived",) + tuple(key_parts)
+        # equal keys must mean equal bytes: one key observed with two
+        # different nbytes is content drift the digests failed to
+        # capture — surfaced as a key collision under SST_KEYCHECK=1
+        _keycheck.note("dataplane", key,
+                       fields={"nbytes": int(nbytes)}, detail=label)
         with self._lock:
             cached = self._get(key)
             if cached is not None:

@@ -74,6 +74,7 @@ from spark_sklearn_tpu.obs.trace import get_tracer
 # crash-safe publish (tmp + fsync + os.replace): the one hardened
 # write path every store file (artifacts, plans.json, manifests) goes
 # through — shared with the flight recorder via utils/atomic.py
+from spark_sklearn_tpu.utils import keycheck as _keycheck
 from spark_sklearn_tpu.utils.atomic import atomic_write as _atomic_write
 from spark_sklearn_tpu.utils.locks import named_lock
 
@@ -753,6 +754,10 @@ def maybe_wrap(jit_fn, store: Optional[ProgramStore], parts,
         return jit_fn
     if not _stable(frozen):
         return jit_fn
+    # record-only (fields=None): the store key IS the digest of every
+    # structural part, so the SST_KEYCHECK log tracks which parts
+    # tuples a run minted without asserting an effective-input set
+    _keycheck.note("program_store", frozen, detail=str(parts[0]))
     return StoredProgram(
         jit_fn, store, kind=str(parts[0]), family=str(parts[1]),
         parts_digest=_digest(frozen), on_trace=on_trace, meta=meta)

@@ -26,6 +26,7 @@ import numpy as np
 
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.parallel.mesh import pad_to_multiple as _pad_up
+from spark_sklearn_tpu.utils import keycheck as _keycheck
 from spark_sklearn_tpu.utils.locks import named_lock
 
 
@@ -692,6 +693,10 @@ def plan_geometry(sizes: Sequence[int], sorted_caps: Sequence[Optional[int]],
         min_width=int(min_width), width_caps=tuple(caps),
         fusion_lane_discount=fusion_lane_discount,
         chunk_loop=str(chunk_loop), prefix=prefix_key)
+    # record-only: PlanKey's named fields ARE the declared planner
+    # inputs, so the SST_KEYCHECK log just tracks which plans a run
+    # keyed (the toggle-a-knob tests diff these sets across configs)
+    _keycheck.note("plan_key", cache_key, detail=mode)
     if reuse:
         with _PLAN_CACHE_LOCK:
             hit = _PLAN_CACHE.get(cache_key)

@@ -64,6 +64,7 @@ from spark_sklearn_tpu.search.scorers import (
     build_view,
     resolve_scoring,
 )
+from spark_sklearn_tpu.utils import keycheck as _keycheck
 from spark_sklearn_tpu.utils.locks import named_lock, named_rlock
 from spark_sklearn_tpu.utils.native import fold_masks
 from spark_sklearn_tpu.obs import telemetry as _telemetry
@@ -122,7 +123,8 @@ def _cache_evict(fam=None):
 _SORTED_LAUNCHES = 8
 
 
-def _cached_program(key, build, store_parts=None, store=None):
+def _cached_program(key, build, store_parts=None, store=None,
+                    check_fields=None):
     """Cross-search cache of jitted callables.
 
     The fit/score programs are built from per-search closures, so without
@@ -136,6 +138,13 @@ def _cached_program(key, build, store_parts=None, store=None):
     Eviction is LRU with per-family program accounting (keys are
     ("fit"|"score"|..., family, ...) tuples): a family at its cap evicts
     its own LRU entry, the global cap evicts the overall LRU entry.
+
+    ``check_fields`` names the call site's EFFECTIVE trace inputs (the
+    config-derived values that alter what ``build`` traces) for the
+    ``SST_KEYCHECK=1`` runtime recorder (utils/keycheck.py): each must
+    flow into ``key``, so two calls agreeing on the key but disagreeing
+    on a field are two distinct traced artifacts aliasing one cache
+    slot — reported as a key collision by the conftest hook.
 
     ``store_parts`` (a deterministic ``(kind, family_name, *structure)``
     tuple) additionally routes the program through ``store`` — THIS
@@ -159,6 +168,9 @@ def _cached_program(key, build, store_parts=None, store=None):
         # a later store-less search must not consult the store through
         # a stale proxy (nor the reverse)
         k = (k, "__programstore__", store.directory)
+    _keycheck.note(
+        "program_cache", k, fields=check_fields,
+        detail=str(key[0]) if isinstance(key, tuple) and key else "")
     with _PROGRAM_CACHE_LOCK:
         hit = _PROGRAM_CACHE.get(k)
         if hit is not None:
@@ -1571,6 +1583,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                 # records addressed by the stream geometry — never let a
                 # device-mode resume read (or extend) it
                 *(("stream",) if data_mode == "stream" else ()))
+            _keycheck.note(
+                "checkpoint", key,
+                fields={"bf16_matmul": bool(config.bf16_matmul),
+                        "dtype": str(config.dtype)},
+                detail=type(self.estimator).__name__)
             ckpt = SearchCheckpoint(config.checkpoint_dir, key)
 
         profiler_cm = None
@@ -2535,7 +2552,8 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     # groups differing only in final-step params share
                     # one compiled transform
                     tf_jit = _cached_program(
-                        ("prefix", family, dg, meta, mesh), _build)
+                        ("prefix", family, dg, meta, mesh), _build,
+                        check_fields={"prefix_digest": dg})
                     aval = jax.eval_shape(tf_jit, data_dev, fit_dev)
                     nbytes = (int(np.prod(aval.shape))
                               * np.dtype(aval.dtype).itemsize)
@@ -2580,7 +2598,7 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         jax.block_until_ready(xt_dev)
                         if ckpt is not None and npz_path is not None:
                             _ckpt_mod.save_pytree(
-                                np.asarray(xt_dev), npz_path)
+                                npz_path, np.asarray(xt_dev))
                             ckpt.put_meta(f"prefix:{kp_fp}",
                                           {"path": npz_path})
                     px_bytes += nbytes
@@ -2640,14 +2658,24 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         lambda l: l.reshape(
                             (nc_batch, n_folds) + l.shape[1:]), model)
 
+                # the mesh joins the in-memory key exactly as
+                # mesh_desc joins the store key (declared-vs-actual
+                # drift from the pre-store key path: every other
+                # program key already carries it, and a same-shape
+                # search on a re-built mesh must not reuse a program
+                # whose store proxy was keyed to the old one)
                 fit_jit = _cached_program(
                     ("fit_tb", family, static, meta, nc_batch, n_folds,
-                     bool(config.bf16_matmul), donate),
+                     bool(config.bf16_matmul), donate, mesh),
                     lambda: jax.jit(fit_batch_tb, **donate_kw),
                     store_parts=None if donate else (
                         "fit_tb", family.name, static, meta, nc_batch,
                         n_folds, bool(config.bf16_matmul), mesh_desc),
-                    store=search_store)
+                    store=search_store,
+                    check_fields={
+                        "bf16_matmul": bool(config.bf16_matmul),
+                        "donate_chunk_buffers": donate,
+                        "mesh": mesh_desc})
 
             def fit_batch(dyn_arrs, data_d, train_m, static=static):
                 def one_cand(dyn_scalars):
@@ -2788,7 +2816,11 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                         n_folds, bool(config.bf16_matmul), mesh_desc,
                         store_score_names, store_sw_key, return_train,
                         px),
-                    store=search_store)
+                    store=search_store,
+                    check_fields={
+                        "bf16_matmul": bool(config.bf16_matmul),
+                        "donate_chunk_buffers": donate,
+                        "mesh": mesh_desc})
             # separate fit/score programs: the non-fused path runs them
             # for every chunk; the fused path runs them for each group's
             # first live chunk to calibrate the score share that splits
@@ -2804,15 +2836,22 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                     store_parts=None if donate else (
                         "fit", family.name, static, meta, mesh_desc,
                         px),
-                    store=search_store)
+                    store=search_store,
+                    check_fields={
+                        "donate_chunk_buffers": donate,
+                        "mesh": mesh_desc})
+            # mesh in the in-memory key for the same reason as fit_tb
+            # above: the store key always carried mesh_desc, the
+            # pre-store in-memory key never did
             score_jit = _cached_program(
                 ("score", family, static, meta, score_key, return_train,
-                 sw_blind, bool(all_cores), px),
+                 sw_blind, bool(all_cores), px, mesh),
                 lambda: jax.jit(score_batch),
                 store_parts=("score", family.name, static, meta,
                              mesh_desc, store_score_names, store_sw_key,
                              return_train, bool(all_cores), px),
-                store=search_store)
+                store=search_store,
+                check_fields={"mesh": mesh_desc})
             progs = {"fit": fit_jit, "score": score_jit,
                      "fused": fused_jit,
                      # the raw (un-jitted) fused body: the scan program
@@ -2924,7 +2963,12 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                  plan.get("prefix"))
                 + (("hb",) if hb else ()),
                 lambda: jax.jit(scan_batch, **donate_kw),
-                store_parts=None)
+                store_parts=None,
+                check_fields={
+                    "bf16_matmul": bool(config.bf16_matmul),
+                    "donate_chunk_buffers": donate,
+                    "heartbeat": bool(hb),
+                    "mesh": mesh_desc})
             cache[ck] = scan_jit
             return scan_jit
 
@@ -3247,6 +3291,10 @@ class BaseSearchTPU(CallbackSupportMixin, MetaEstimatorMixin, BaseEstimator):
                       jax.tree_util.tree_leaves(plan_data(plan))),
                 id(fit_dev), id(test_dev), id(train_sc_dev),
                 id(test_unw_dev), id(train_unw_dev))
+            _keycheck.note(
+                "fuse_spec", fkey,
+                fields={"bf16_matmul": bool(config.bf16_matmul)},
+                detail=family.name)
 
             def rows(group=group, lo=lo, hi=hi):
                 return {k: np.asarray(arr[lo:hi])

@@ -48,6 +48,8 @@ import numpy as np
 from spark_sklearn_tpu.obs.log import get_logger
 from spark_sklearn_tpu.obs.trace import get_tracer
 from spark_sklearn_tpu.utils.atomic import atomic_write, fsync_dir
+from spark_sklearn_tpu.utils.journalspec import (SERVICE_JOURNAL_FORMAT,
+                                                 SERVICE_RECORD_KINDS)
 from spark_sklearn_tpu.utils.locks import named_lock
 
 logger = get_logger(__name__)
@@ -68,9 +70,11 @@ __all__ = [
     "submission_digest",
 ]
 
-#: on-disk format version: bump when the record layout changes — old
-#: journals become clean empty scans, never parse errors.
-SERVICE_JOURNAL_FORMAT = 1
+#: on-disk format version: declared (with the record-kind vocabulary)
+#: in utils/journalspec.py, the one versioned registry of every
+#: durable journal record kind; re-exported here for callers.  Bumping
+#: it turns old journals into clean empty scans, never parse errors.
+assert SERVICE_JOURNAL_FORMAT == 1, "bump requires a migration plan"
 
 #: how stale the lease stamp may grow before a successor may fence a
 #: still-registered (but silent) owner.
@@ -285,7 +289,15 @@ class ServiceJournal:
     def append(self, kind: str, record: Dict[str, Any]) -> bool:
         """Durably append one checksummed record.  Returns False on an
         I/O failure — journaling hardens the service, it must never
-        fail a submit."""
+        fail a submit.  ``kind`` must be declared in the journalspec
+        registry: an undeclared kind is a programming error (format
+        drift a future reader has no decoder for), not an I/O hazard,
+        so it raises."""
+        if str(kind) not in SERVICE_RECORD_KINDS:
+            raise ValueError(
+                f"undeclared service-journal record kind {kind!r}: "
+                "declare it (with a decoder) in "
+                "spark_sklearn_tpu/utils/journalspec.py")
         payload = json.dumps(record, sort_keys=True, default=str)
         doc = {
             "service_journal_format": SERVICE_JOURNAL_FORMAT,

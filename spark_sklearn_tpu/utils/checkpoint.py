@@ -21,6 +21,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from spark_sklearn_tpu.utils import journalspec as _jspec
+
 
 def fingerprint(*parts) -> str:
     h = hashlib.sha256()
@@ -58,16 +60,22 @@ class SearchCheckpoint:
                 for line in f:
                     try:
                         rec = json.loads(line)
-                        if "fault_chunk_id" in rec:
+                        # line shapes and their precedence are declared
+                        # once, in utils/journalspec.py — classification
+                        # is key-presence exact with every shipped
+                        # loader, so old journals replay identically
+                        kind, key, value = \
+                            _jspec.classify_checkpoint_record(rec)
+                        if kind == "fault":
                             self.faults.append(rec)
                             continue
-                        if "meta" in rec and "chunk_id" not in rec:
+                        if kind == "meta":
                             # journal metadata (e.g. the pinned launch-
                             # geometry plan): last record wins; loaders
                             # predating meta lines skip them on KeyError
-                            self._meta[rec["meta"]] = rec.get("value")
+                            self._meta[key] = value
                             continue
-                        self._done[rec["chunk_id"]] = rec
+                        self._done[key] = rec
                     except (json.JSONDecodeError, KeyError):
                         continue  # torn tail line from a crash
 
@@ -152,6 +160,10 @@ def load_pytree(path: str, like=None):
     """Load a pytree saved by save_pytree; `like` (same structure) restores
     the exact container types, otherwise a {keystr: array} dict returns."""
     import jax
+    # mirror save_pytree's ".npz" normalization so an extension-less
+    # journal pointer round-trips to the file save actually wrote
+    if not str(path).endswith(".npz"):
+        path = f"{path}.npz"
     with np.load(path, allow_pickle=False) as z:
         n = len([k for k in z.files if k.startswith("leaf_")])
         leaves = [z[f"leaf_{i}"] for i in range(n)]

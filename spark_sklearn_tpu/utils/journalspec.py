@@ -1,0 +1,313 @@
+"""The versioned registry of every durable journal record kind.
+
+Two journals make the engine crash-safe: the per-search checkpoint
+journal (``utils/checkpoint.py``, an append-only jsonl of chunk
+results, fault lines and pinned-plan meta records) and the service
+write-ahead log (``serve/journal.py``, checksummed submission/state
+documents).  Both are *formats a dead process left behind for a future
+one*, so drift is a resume-time surprise by construction — unless the
+vocabulary lives in exactly one place.  This module is that place:
+
+  - every checkpoint line shape and every ``put_meta`` kind is
+    declared in :data:`CHECKPOINT_RECORD_KINDS` /
+    :data:`CHECKPOINT_META_KINDS`, each with a format version and a
+    back-compat ``decode`` normalizer;
+  - every service-journal ``kind`` is declared in
+    :data:`SERVICE_RECORD_KINDS`, and ``SERVICE_JOURNAL_FORMAT`` lives
+    here (``serve/journal.py`` re-exports it);
+  - ``tools/sstlint``'s ``journal-format`` rule loads this module
+    import-light and fails any ``put_meta``/``append`` call site whose
+    record kind is not declared here, and ``journal-decoder-missing``
+    fails any declared kind without a decoder — format drift becomes
+    a lint finding instead of a resume-time surprise.
+
+Runtime readers stay permissive (an UNKNOWN kind in an on-disk journal
+is skipped/stored exactly as before — old processes must keep reading
+new journals' extra records); the registry constrains *writers*, at
+lint time.  Stdlib-only: the linter executes this module without
+paying the jax import.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+__all__ = [
+    "CHECKPOINT_JOURNAL_FORMAT",
+    "CHECKPOINT_META_KINDS",
+    "CHECKPOINT_RECORD_KINDS",
+    "SERVICE_JOURNAL_FORMAT",
+    "SERVICE_RECORD_KINDS",
+    "classify_checkpoint_record",
+    "decode_meta",
+    "meta_kind_spec",
+    "registry_markdown",
+]
+
+#: checkpoint jsonl format version — the line shapes below.  Bump only
+#: with a new discriminator scheme; the per-kind versions cover value
+#: layout changes.
+CHECKPOINT_JOURNAL_FORMAT = 1
+
+#: on-disk service WAL format version (``serve/journal.py`` wraps every
+#: record in ``{"service_journal_format": ..., "kind": ...,
+#: "payload_sha256": ..., "record": ...}`` and skips other versions as
+#: corrupt — old journals become clean empty scans, never parse
+#: errors).
+SERVICE_JOURNAL_FORMAT = 1
+
+
+def _decode_geometry_plan(value: Any) -> Dict[str, Any]:
+    """v1: ``GeometryPlan.to_dict()``.  Per-group plan keys inside are
+    decoded by ``taskgrid.PlanKey.from_json``, which accepts both the
+    named-dict form and the legacy positional 8/9/10/11-element
+    lists older journals hold."""
+    return dict(value)
+
+
+def _decode_prefix_plan(value: Any) -> list:
+    """v1: the per-group prefix digest list (``None`` for atomic
+    groups), order-aligned with the geometry plan's groups."""
+    return list(value)
+
+
+def _decode_prefix_payload(value: Any) -> Dict[str, Any]:
+    """v1: ``{"path": <npz path>}`` — where the journaled prefix
+    matrix payload lives.  A missing/torn payload is NOT an error at
+    read time (the recompute is bit-exact); extra keys pass through."""
+    out = dict(value)
+    out["path"] = str(out.get("path", ""))
+    return out
+
+
+def _decode_stream_plan(value: Any) -> Dict[str, Any]:
+    """v1: ``StreamPlan.to_dict()`` — the pinned stream-shard geometry
+    per-shard accumulator records are addressed under."""
+    return dict(value)
+
+
+def _decode_submitted(value: Any) -> Dict[str, Any]:
+    """v1: the submission record.  ``state`` defaults to "admitted"
+    (the WAL append and a fast worker's first transition race on file
+    order; recovery treats a state-less submission as just admitted)."""
+    out = dict(value)
+    out.setdefault("state", "admitted")
+    return out
+
+
+def _decode_state(value: Any) -> Dict[str, Any]:
+    """v1: a state transition — ``handle`` + ``state`` (one of the
+    executor vocabulary; terminal states are
+    ``serve.journal.TERMINAL_STATES``)."""
+    out = dict(value)
+    out["state"] = str(out.get("state", ""))
+    return out
+
+
+def _decode_lease(value: Any) -> Dict[str, Any]:
+    """v1: a lease fencing event — the new owner, the fenced pid/owner
+    and how stale its last stamp was.  Recovery treats its presence as
+    evidence of an unclean predecessor."""
+    out = dict(value)
+    out.setdefault("event", "fenced")
+    return out
+
+
+def _decode_shutdown(value: Any) -> Dict[str, Any]:
+    """v1: a deliberate clean shutdown by ``owner`` — the next startup
+    distinguishes it from a crash (no shutdown record = unclean)."""
+    out = dict(value)
+    out["clean"] = bool(out.get("clean", True))
+    return out
+
+
+#: checkpoint jsonl line shapes, discriminated by key presence — the
+#: EXACT precedence ``SearchCheckpoint`` scans with (fault first, then
+#: meta, then chunk result; anything else is a torn/foreign line and
+#: is skipped).
+CHECKPOINT_RECORD_KINDS: Dict[str, Dict[str, Any]] = {
+    "fault": {
+        "version": 1,
+        "discriminator": "fault_chunk_id",
+        "description": (
+            "launch-supervisor recovery event, journaled durably "
+            "BEFORE each retry; never mistaken for a result (even by "
+            "pre-fault-journal loaders, which skip it on KeyError)"),
+        "decode": dict,
+    },
+    "meta": {
+        "version": 1,
+        "discriminator": "meta",
+        "description": (
+            "journal metadata {\"meta\": name, \"value\": ...}; kinds "
+            "declared in CHECKPOINT_META_KINDS, last record wins"),
+        "decode": dict,
+    },
+    "chunk_result": {
+        "version": 1,
+        "discriminator": "chunk_id",
+        "description": (
+            "one completed chunk's per-candidate rows (streamed runs "
+            "journal per-shard accumulator records under the same "
+            "shape, addressed by the pinned stream geometry)"),
+        "decode": dict,
+    },
+}
+
+#: every ``put_meta`` kind any module may write.  ``prefix`` entries
+#: are written per fingerprint as ``prefix:<fp>`` — declared here by
+#: the ``"prefix:"`` name prefix (``prefix_match=True``).
+CHECKPOINT_META_KINDS: Dict[str, Dict[str, Any]] = {
+    "geometry_plan": {
+        "version": 1,
+        "writer": "search/grid.py",
+        "prefix_match": False,
+        "description": (
+            "the pinned launch-geometry plan a resumed search must "
+            "replay (chunk ids — and therefore resume hits — only "
+            "match under the widths that wrote them)"),
+        "decode": _decode_geometry_plan,
+    },
+    "prefix_plan": {
+        "version": 1,
+        "writer": "search/grid.py",
+        "prefix_match": False,
+        "description": (
+            "the shared-prefix per-group digest list; a resume whose "
+            "digests drifted fails loudly instead of mixing prefix-"
+            "staged and atomic chunk results"),
+        "decode": _decode_prefix_plan,
+    },
+    "prefix:": {
+        "version": 1,
+        "writer": "search/grid.py",
+        "prefix_match": True,
+        "description": (
+            "one computed prefix matrix's durable npz payload "
+            "pointer, keyed by the prefix content fingerprint — "
+            "kill-resume re-uploads instead of recomputing"),
+        "decode": _decode_prefix_payload,
+    },
+    "stream_plan": {
+        "version": 1,
+        "writer": "search/stream.py",
+        "prefix_match": False,
+        "description": (
+            "the pinned stream-shard geometry; per-shard accumulator "
+            "records are only addressable under the geometry that "
+            "wrote them"),
+        "decode": _decode_stream_plan,
+    },
+}
+
+#: every service-WAL record kind (``ServiceJournal.append``'s ``kind``
+#: argument).
+SERVICE_RECORD_KINDS: Dict[str, Dict[str, Any]] = {
+    "submitted": {
+        "version": 1,
+        "writer": "serve/journal.py",
+        "description": (
+            "one admission: tenant/weight/family/compile-structure "
+            "digest/data fingerprints/checkpoint dir — everything a "
+            "successor needs to re-own the search"),
+        "decode": _decode_submitted,
+    },
+    "state": {
+        "version": 1,
+        "writer": "serve/journal.py",
+        "description": (
+            "one state transition (admitted → running → finished/"
+            "cancelled/failed/shed/recovered) for a journaled handle"),
+        "decode": _decode_state,
+    },
+    # these two were WRITTEN but undeclared until the journal-format
+    # rule landed — exactly the drift class this registry exists for
+    "lease": {
+        "version": 1,
+        "writer": "serve/journal.py",
+        "description": (
+            "a lease fencing event: a new owner took over a stale "
+            "lease (fenced pid/owner + staleness); evidence of an "
+            "unclean predecessor"),
+        "decode": _decode_lease,
+    },
+    "shutdown": {
+        "version": 1,
+        "writer": "serve/journal.py",
+        "description": (
+            "a deliberate clean shutdown by the journal owner; its "
+            "absence at next startup means the previous process "
+            "crashed or was fenced"),
+        "decode": _decode_shutdown,
+    },
+}
+
+
+def classify_checkpoint_record(
+        rec: Dict[str, Any]) -> Tuple[str, Any, Any]:
+    """Classify one parsed checkpoint-journal line.
+
+    Returns ``(kind, key, value)``: ``("fault", chunk_id, rec)``,
+    ``("meta", name, value)``, or ``("chunk_result", chunk_id, rec)``
+    — the exact key-presence precedence every shipped loader has used,
+    so old journals classify identically.  Raises ``KeyError`` for a
+    line matching no declared shape (callers skip it as a torn tail,
+    exactly as before)."""
+    if "fault_chunk_id" in rec:
+        return "fault", rec["fault_chunk_id"], rec
+    if "meta" in rec and "chunk_id" not in rec:
+        return "meta", rec["meta"], rec.get("value")
+    return "chunk_result", rec["chunk_id"], rec
+
+
+def meta_kind_spec(name: str) -> Dict[str, Any]:
+    """The registry entry declaring meta kind ``name`` (exact match,
+    then declared prefixes).  Raises ``KeyError`` if undeclared."""
+    spec = CHECKPOINT_META_KINDS.get(name)
+    if spec is not None and not spec["prefix_match"]:
+        return spec
+    for kind, s in CHECKPOINT_META_KINDS.items():
+        if s["prefix_match"] and name.startswith(kind):
+            return s
+    raise KeyError(name)
+
+
+def decode_meta(name: str, value: Any) -> Any:
+    """Normalize one meta value through its declared back-compat
+    decoder (``KeyError`` for undeclared kinds — runtime readers that
+    must stay permissive catch it and keep the raw value)."""
+    decode: Callable[[Any], Any] = meta_kind_spec(name)["decode"]
+    return decode(value)
+
+
+def registry_markdown() -> str:
+    """The journal-record registry tables ``dev/build_api_docs.py``
+    renders into ``docs/API.md``."""
+    out = [
+        "## Journal record registry (`utils/journalspec.py`)\n",
+        "\nEvery durable journal record kind, versioned in one place "
+        "— held to the write sites by the `journal-format` / "
+        "`journal-decoder-missing` rules in `tools/sstlint`.\n",
+        f"\nCheckpoint jsonl (format v{CHECKPOINT_JOURNAL_FORMAT}, "
+        "discriminated by key presence):\n",
+        "\n| kind | v | discriminator | what it holds |\n"
+        "|---|---|---|---|\n",
+    ]
+    for kind, s in CHECKPOINT_RECORD_KINDS.items():
+        out.append(f"| `{kind}` | {s['version']} | "
+                   f"`{s['discriminator']}` | {s['description']} |\n")
+    out.append("\nCheckpoint `put_meta` kinds:\n")
+    out.append("\n| kind | v | writer | what it holds |\n"
+               "|---|---|---|---|\n")
+    for kind, s in CHECKPOINT_META_KINDS.items():
+        shown = f"{kind}<fp>" if s["prefix_match"] else kind
+        out.append(f"| `{shown}` | {s['version']} | "
+                   f"`{s['writer']}` | {s['description']} |\n")
+    out.append(f"\nService WAL (format v{SERVICE_JOURNAL_FORMAT}, "
+               "checksummed documents):\n")
+    out.append("\n| kind | v | writer | what it holds |\n"
+               "|---|---|---|---|\n")
+    for kind, s in SERVICE_RECORD_KINDS.items():
+        out.append(f"| `{kind}` | {s['version']} | "
+                   f"`{s['writer']}` | {s['description']} |\n")
+    return "".join(out)

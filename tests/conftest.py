@@ -148,36 +148,64 @@ def _lockcheck_recorder():
     return locks.get_recorder() if locks.lockcheck_enabled() else None
 
 
+def _keycheck_recorder():
+    from spark_sklearn_tpu.utils import keycheck
+    return keycheck.get_recorder() if keycheck.keycheck_enabled() \
+        else None
+
+
 def pytest_terminal_summary(terminalreporter):
     rec = _lockcheck_recorder()
-    if rec is None:
-        return
-    rep = rec.report()
-    terminalreporter.write_line(
-        f"lockcheck: {rep['n_edges']} acquisition-order edge(s), "
-        f"{len(rep['inversions'])} inversion(s), "
-        f"{len(rep['long_holds'])} long hold(s)")
-    for edge in rep["edges"]:
-        terminalreporter.write_line(f"  order: {edge[0]} -> {edge[1]}")
-    for lh in rep["long_holds"][:10]:
+    if rec is not None:
+        rep = rec.report()
         terminalreporter.write_line(
-            f"  long hold: {lh['lock']} held {lh['held_s']}s "
-            f"on {lh['thread']}")
-    for inv in rep["inversions"]:
-        a, b = inv["locks"]
+            f"lockcheck: {rep['n_edges']} acquisition-order edge(s), "
+            f"{len(rep['inversions'])} inversion(s), "
+            f"{len(rep['long_holds'])} long hold(s)")
+        for edge in rep["edges"]:
+            terminalreporter.write_line(
+                f"  order: {edge[0]} -> {edge[1]}")
+        for lh in rep["long_holds"][:10]:
+            terminalreporter.write_line(
+                f"  long hold: {lh['lock']} held {lh['held_s']}s "
+                f"on {lh['thread']}")
+        for inv in rep["inversions"]:
+            a, b = inv["locks"]
+            terminalreporter.write_line(
+                f"  INVERSION: {a} <-> {b} "
+                f"({inv['thread_a']} vs {inv['thread_b']})")
+    krec = _keycheck_recorder()
+    if krec is not None:
+        rep = krec.report()
+        per_surface = ", ".join(
+            f"{s}={n}" for s, n in rep["keys_by_surface"].items()) \
+            or "none"
         terminalreporter.write_line(
-            f"  INVERSION: {a} <-> {b} "
-            f"({inv['thread_a']} vs {inv['thread_b']})")
+            f"keycheck: {rep['n_notes']} key construction(s), "
+            f"{rep['n_keys']} distinct key(s) [{per_surface}], "
+            f"{len(rep['collisions'])} collision(s)")
+        for col in rep["collisions"]:
+            terminalreporter.write_line(
+                f"  COLLISION on {col['surface']} key "
+                f"{col['key_digest']}: {col['fields_a']} "
+                f"({col['detail_a']}) vs {col['fields_b']} "
+                f"({col['detail_b']})")
 
 
 def pytest_sessionfinish(session, exitstatus):
     rec = _lockcheck_recorder()
-    if rec is None:
-        return
-    if rec.report()["inversions"] and exitstatus == 0:
+    if rec is not None and rec.report()["inversions"] \
+            and exitstatus == 0:
         # a green suite that recorded a lock-order inversion is NOT
         # green: two threads interleaving those paths can deadlock.
         # 1 == ExitCode.TESTS_FAILED (3 would read as INTERNAL_ERROR)
+        session.exitstatus = 1
+    krec = _keycheck_recorder()
+    if krec is not None and krec.report()["collisions"] \
+            and exitstatus == 0:
+        # same principle as the lockcheck hook: two distinct traced
+        # artifacts aliasing one cache key is the silent-wrong-results
+        # precondition, however green the assertions were
         session.exitstatus = 1
 
 

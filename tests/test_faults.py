@@ -356,9 +356,14 @@ class TestCheckpointAtomicity:
         assert os.listdir(tmp_path) == ["m.npz"]
         back = load_pytree(p, like=self._tree())
         np.testing.assert_allclose(back["coef"], self._tree()["coef"])
-        # extension-less path keeps numpy's ".npz" append behavior
+        # extension-less path keeps numpy's ".npz" append behavior —
+        # and load mirrors the normalization, so a journal pointer
+        # saved without the extension (the prefix payload path)
+        # round-trips to the file save actually wrote
         save_pytree(str(tmp_path / "bare"), self._tree())
         assert (tmp_path / "bare.npz").exists()
+        back = load_pytree(str(tmp_path / "bare"), like=self._tree())
+        np.testing.assert_allclose(back["coef"], self._tree()["coef"])
 
     def test_truncated_npz_fails_loud_and_resaves_clean(self, tmp_path):
         """A crash mid-save must never poison the next resume: the

@@ -674,3 +674,597 @@ class TestLockcheckRuntime:
                              cwd=str(REPO), timeout=300)
         assert out.returncode == 0, out.stderr[-2000:]
         assert "EDGES" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# key-flow analysis (keyflow rules)
+# ---------------------------------------------------------------------------
+
+
+KEYCHECK_FIXTURE = (
+    "KEY_SURFACES = {\n"
+    "    'cache': {\n"
+    "        'relpath': 'a.py',\n"
+    "        'anchor': '_cached_program',\n"
+    "        'config_fields': ('alpha',),\n"
+    "        'key_tokens': {},\n"
+    "        'aliases': {'mesh_desc': 'mesh'},\n"
+    "        'dataflow': True,\n"
+    "    },\n"
+    "}\n")
+
+
+def keyflow_project(root, **kw):
+    kc = write(root, "pkg/utils/keycheck.py", KEYCHECK_FIXTURE)
+    return make_project(root, keycheck_path=kc, **kw)
+
+
+class TestKeyflowRules:
+    def test_declared_field_missing_and_fixed(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config, mesh):\n"
+            "    return _cached_program(('fit', mesh),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["key-part-missing"])
+        hits = rule_hits(r, "key-part-missing")
+        assert any(f["key"].endswith("cache:alpha") for f in hits), hits
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config, mesh):\n"
+            "    return _cached_program(('fit', config.alpha, mesh),\n"
+            "                           lambda: jit(fn))\n"))
+        r2 = lint(proj, ["key-part-missing"])
+        assert not rule_hits(r2, "key-part-missing")
+
+    def test_closure_read_must_flow_into_key(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    def fn(x, beta=config.beta):\n"
+            "        return x * beta\n"
+            "    return _cached_program(('fit', config.alpha),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["key-part-missing"])
+        hits = rule_hits(r, "key-part-missing")
+        assert any("config.beta" in f["message"] for f in hits), hits
+        # keyed -> clean
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    def fn(x, beta=config.beta):\n"
+            "        return x * beta\n"
+            "    return _cached_program(\n"
+            "        ('fit', config.alpha, config.beta),\n"
+            "        lambda: jit(fn))\n"))
+        r2 = lint(proj, ["key-part-missing"])
+        assert not rule_hits(r2, "key-part-missing")
+
+    def test_closure_resolution_is_scope_aware(self, tmp_path):
+        # two builders reuse the helper name `step`; only builder b's
+        # own `step` reads config.beta — builder a must stay clean
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def builder_a(config):\n"
+            "    def step(x):\n"
+            "        return x\n"
+            "    def fn(x):\n"
+            "        return step(x)\n"
+            "    return _cached_program(('a', config.alpha),\n"
+            "                           lambda: jit(fn))\n"
+            "def builder_b(config):\n"
+            "    def step(x, beta=config.beta):\n"
+            "        return x * beta\n"
+            "    def fn(x):\n"
+            "        return step(x)\n"
+            "    return _cached_program(('b', config.alpha),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["key-part-missing"])
+        hits = rule_hits(r, "key-part-missing")
+        assert len(hits) == 1, hits
+        assert "builder_b" in hits[0]["key"]
+
+    def test_store_parts_drift_detected_via_alias(self, tmp_path):
+        # the exact shape of the mesh drift the real tree carried: the
+        # store key names mesh_desc, the in-memory key has no mesh
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config, mesh, mesh_desc):\n"
+            "    return _cached_program(\n"
+            "        ('fit', config.alpha),\n"
+            "        lambda: jit(fn),\n"
+            "        store_parts=('fit', mesh_desc))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["key-part-missing"])
+        hits = rule_hits(r, "key-part-missing")
+        assert any("mesh_desc" in f["message"] for f in hits), hits
+        # the alias map accepts the in-memory twin name
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config, mesh, mesh_desc):\n"
+            "    return _cached_program(\n"
+            "        ('fit', config.alpha, mesh),\n"
+            "        lambda: jit(fn),\n"
+            "        store_parts=('fit', mesh_desc))\n"))
+        r2 = lint(proj, ["key-part-missing"])
+        assert not rule_hits(r2, "key-part-missing")
+
+    def test_key_part_dead(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(\n"
+            "        ('fit', config.alpha, config.gamma),\n"
+            "        lambda: jit(fn))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["key-part-dead"])
+        hits = rule_hits(r, "key-part-dead")
+        assert any(f["key"].endswith("cache:gamma") for f in hits)
+        assert not any(f["key"].endswith("cache:alpha") for f in hits)
+
+    def test_registry_hygiene(self, tmp_path):
+        write(tmp_path, "pkg/utils/keycheck.py", (
+            "KEY_SURFACES = {\n"
+            "    'ghost': {'relpath': 'gone.py', 'anchor': 'nope',\n"
+            "              'config_fields': ('bogus',)},\n"
+            "}\n"))
+        write(tmp_path, "pkg/a.py", (
+            "class TpuConfig:\n"
+            "    alpha: int = 0\n"
+            "def _cached_program(key, build):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(('k', config.alpha),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = make_project(
+            tmp_path,
+            keycheck_path=tmp_path / "pkg/utils/keycheck.py")
+        r = lint(proj, ["key-surface-unregistered"])
+        hits = rule_hits(r, "key-surface-unregistered")
+        # stale relpath + uncovered _cached_program call site
+        assert any(f["key"].endswith("ghost:relpath") for f in hits)
+        assert any("callsite:" in f["key"] for f in hits)
+
+    def test_unknown_config_field_flagged(self, tmp_path):
+        write(tmp_path, "pkg/utils/keycheck.py", (
+            "KEY_SURFACES = {\n"
+            "    'cache': {'relpath': 'a.py',\n"
+            "              'anchor': '_cached_program',\n"
+            "              'config_fields': ('bogus',),\n"
+            "              'dataflow': True},\n"
+            "}\n"))
+        write(tmp_path, "pkg/a.py", (
+            "class TpuConfig:\n"
+            "    alpha: int = 0\n"
+            "def _cached_program(key, build):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(('k', config.bogus),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = make_project(
+            tmp_path,
+            keycheck_path=tmp_path / "pkg/utils/keycheck.py")
+        r = lint(proj, ["key-surface-unregistered"])
+        assert any(f["key"].endswith("cache:field:bogus")
+                   for f in rule_hits(r, "key-surface-unregistered"))
+
+    def test_note_missing_and_present(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(('fit', config.alpha),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["keycheck-note-missing"])
+        assert rule_hits(r, "keycheck-note-missing")
+        write(tmp_path, "pkg/a.py", (
+            "from pkg.utils import keycheck\n"
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    keycheck.note('cache', key)\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(('fit', config.alpha),\n"
+            "                           lambda: jit(fn))\n"))
+        r2 = lint(proj, ["keycheck-note-missing"])
+        assert not rule_hits(r2, "keycheck-note-missing")
+
+    def test_suppression_honored(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build, store_parts=None):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    # the key is completed downstream (see helper)\n"
+            "    # sstlint: disable=key-part-missing\n"
+            "    return _cached_program(('fit',),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = keyflow_project(tmp_path)
+        r = lint(proj, ["key-part-missing"])
+        assert not rule_hits(r, "key-part-missing")
+
+    def test_rules_skip_without_registry(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def _cached_program(key, build):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(('k', config.alpha),\n"
+            "                           lambda: jit(fn))\n"))
+        proj = make_project(tmp_path)      # no keycheck_path
+        r = lint(proj, ["key-part-missing", "key-part-dead",
+                        "key-surface-unregistered",
+                        "keycheck-note-missing"])
+        assert r["n_findings"] == 0
+
+    def test_cli_seeded_key_part_missing_fails(self, tmp_path):
+        """The acceptance fixture: a spark_sklearn_tpu/-shaped tree
+        with a declared key-feeding field that never reaches its key
+        must fail the CLI (exit 1) with a key-part-missing finding."""
+        write(tmp_path, "spark_sklearn_tpu/utils/keycheck.py", (
+            "KEY_SURFACES = {\n"
+            "    'cache': {'relpath': 'a.py',\n"
+            "              'anchor': '_cached_program',\n"
+            "              'config_fields': ('alpha',),\n"
+            "              'dataflow': True},\n"
+            "}\n"))
+        write(tmp_path, "spark_sklearn_tpu/a.py", (
+            "def _cached_program(key, build):\n"
+            "    return build()\n"
+            "def use(config):\n"
+            "    return _cached_program(('fit',), lambda: jit(fn))\n"))
+        write(tmp_path, ".gitignore", "__pycache__/\n*.pyc\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.sstlint", "--format", "json",
+             str(tmp_path / "spark_sklearn_tpu")],
+            capture_output=True, text=True, cwd=str(REPO), timeout=180)
+        assert out.returncode == 1, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert any(f["rule"] == "key-part-missing"
+                   for f in payload["findings"]), payload["findings"]
+
+
+# ---------------------------------------------------------------------------
+# journal-format registry rules
+# ---------------------------------------------------------------------------
+
+
+JOURNALSPEC_FIXTURE = (
+    "def _d(v):\n"
+    "    return v\n"
+    "CHECKPOINT_RECORD_KINDS = {\n"
+    "    'fault': {'version': 1, 'discriminator': 'fault_chunk_id',\n"
+    "              'decode': _d},\n"
+    "}\n"
+    "CHECKPOINT_META_KINDS = {\n"
+    "    'plan': {'version': 1, 'prefix_match': False, 'decode': _d},\n"
+    "    'px:': {'version': 1, 'prefix_match': True, 'decode': _d},\n"
+    "}\n"
+    "SERVICE_RECORD_KINDS = {\n"
+    "    'submitted': {'version': 1, 'decode': _d},\n"
+    "}\n")
+
+
+def journal_project(root, **kw):
+    js = write(root, "pkg/utils/journalspec.py", JOURNALSPEC_FIXTURE)
+    return make_project(root, journalspec_path=js, **kw)
+
+
+class TestJournalRules:
+    def test_undeclared_kinds_flagged(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def save(ckpt, j, fp):\n"
+            "    ckpt.put_meta('plan', 1)\n"
+            "    ckpt.put_meta(f'px:{fp}', 2)\n"
+            "    ckpt.put_meta('rogue', 3)\n"
+            "    j.append('submitted', {})\n"
+            "    j.append('rogue_kind', {})\n"
+            "    xs = []\n"
+            "    xs.append('plain_list_item')\n"))
+        proj = journal_project(tmp_path)
+        r = lint(proj, ["journal-format"])
+        hits = rule_hits(r, "journal-format")
+        keys = {f["key"] for f in hits}
+        assert any(k.endswith("meta:rogue") for k in keys), keys
+        assert any(k.endswith("service:rogue_kind") for k in keys)
+        # declared kinds + 1-arg list.append stay clean
+        assert len(hits) == 2, hits
+
+    def test_fstring_prefix_requires_prefix_entry(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def save(ckpt, fp):\n"
+            "    ckpt.put_meta(f'plan{fp}', 1)\n"))
+        proj = journal_project(tmp_path)
+        r = lint(proj, ["journal-format"])
+        # 'plan' is declared exact-only: its f-string variants are
+        # undeclared dynamic kinds
+        assert rule_hits(r, "journal-format")
+
+    def test_suppression_honored(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def save(ckpt):\n"
+            "    # migration shim writes the legacy kind on purpose\n"
+            "    # sstlint: disable=journal-format\n"
+            "    ckpt.put_meta('legacy', 1)\n"))
+        proj = journal_project(tmp_path)
+        r = lint(proj, ["journal-format"])
+        assert not rule_hits(r, "journal-format")
+
+    def test_decoder_and_dead_entry_checks(self, tmp_path):
+        write(tmp_path, "pkg/utils/journalspec.py", (
+            "def _d(v):\n"
+            "    return v\n"
+            "CHECKPOINT_RECORD_KINDS = {\n"
+            "    'fault': {'version': 1, 'decode': _d},\n"
+            "    'broken': {'version': 'one'},\n"
+            "}\n"
+            "CHECKPOINT_META_KINDS = {\n"
+            "    'plan': {'version': 1, 'prefix_match': False,\n"
+            "             'decode': _d},\n"
+            "    'never_written': {'version': 1,\n"
+            "                      'prefix_match': False,\n"
+            "                      'decode': _d},\n"
+            "}\n"
+            "SERVICE_RECORD_KINDS = {\n"
+            "    'submitted': {'version': 1, 'decode': _d},\n"
+            "    'ghost': {'version': 1, 'decode': _d},\n"
+            "}\n"))
+        write(tmp_path, "pkg/a.py", (
+            "def save(ckpt, j):\n"
+            "    ckpt.put_meta('plan', 1)\n"
+            "    j.append('submitted', {})\n"))
+        proj = make_project(
+            tmp_path,
+            journalspec_path=tmp_path / "pkg/utils/journalspec.py")
+        r = lint(proj, ["journal-decoder-missing"])
+        keys = {f["key"] for f in rule_hits(r, "journal-decoder-missing")}
+        assert any("broken:version" in k for k in keys), keys
+        assert any("broken:decode" in k for k in keys)
+        assert any("meta-dead:never_written" in k for k in keys)
+        assert any("service-dead:ghost" in k for k in keys)
+        assert not any(":plan:" in k or "meta-dead:plan" in k
+                       for k in keys)
+
+    def test_rules_skip_without_registry(self, tmp_path):
+        write(tmp_path, "pkg/a.py", (
+            "def save(ckpt):\n"
+            "    ckpt.put_meta('anything_goes', 1)\n"))
+        proj = make_project(tmp_path)
+        r = lint(proj, ["journal-format", "journal-decoder-missing"])
+        assert r["n_findings"] == 0
+
+    def test_real_registry_declares_every_write_site(self):
+        """Every put_meta/append kind the real tree writes is declared
+        (the rule found two undeclared service kinds — lease and
+        shutdown — when it first ran; they are registered now)."""
+        from spark_sklearn_tpu.utils import journalspec
+        assert "lease" in journalspec.SERVICE_RECORD_KINDS
+        assert "shutdown" in journalspec.SERVICE_RECORD_KINDS
+        r = run_lint(root=REPO, rules=["journal-format",
+                                       "journal-decoder-missing"])
+        assert r["n_findings"] == 0, r["findings"]
+
+
+# ---------------------------------------------------------------------------
+# escape-hatch audit rules
+# ---------------------------------------------------------------------------
+
+
+CONFIG_FIXTURE = (
+    "class TpuConfig:\n"
+    "    alpha: int = 0\n"
+    "    fusion: bool = True\n")
+
+
+class TestHatchRules:
+    def test_unregistered_claim_flagged(self, tmp_path):
+        from tools.sstlint.project import EscapeHatch
+        write(tmp_path, "pkg/config.py", CONFIG_FIXTURE)
+        readme = write(tmp_path, "README.md", (
+            "# pkg\n"
+            "`fusion` off is a byte-identical escape hatch.\n"))
+        proj = make_project(tmp_path, readme=readme)
+        r = lint(proj, ["escape-hatch-unregistered"])
+        hits = rule_hits(r, "escape-hatch-unregistered")
+        assert any("fusion" in f["key"] for f in hits), hits
+        # registering it (with a resolving test) clears the finding
+        write(tmp_path, "tests/test_f.py",
+              "def test_parity():\n    pass\n")
+        proj2 = make_project(
+            tmp_path, readme=readme,
+            escape_hatches=(EscapeHatch(
+                "fusion", "fusion", "tests/test_f.py::test_parity"),))
+        r2 = lint(proj2, ["escape-hatch-unregistered",
+                          "escape-hatch-untested"])
+        assert r2["n_findings"] == 0, r2["findings"]
+
+    def test_docstring_claims_audited(self, tmp_path):
+        write(tmp_path, "pkg/config.py", CONFIG_FIXTURE)
+        write(tmp_path, "pkg/a.py", (
+            '"""Module.\n'
+            "\n"
+            "``fusion`` off is an exact no-op.\n"
+            '"""\n'))
+        proj = make_project(tmp_path)
+        r = lint(proj, ["escape-hatch-unregistered"])
+        assert rule_hits(r, "escape-hatch-unregistered")
+
+    def test_unanchored_prose_skipped(self, tmp_path):
+        write(tmp_path, "pkg/config.py", CONFIG_FIXTURE)
+        readme = write(tmp_path, "README.md", (
+            "Results are byte-identical across restarts by design.\n"))
+        proj = make_project(tmp_path, readme=readme)
+        r = lint(proj, ["escape-hatch-unregistered"])
+        assert not rule_hits(r, "escape-hatch-unregistered")
+
+    def test_dangling_pointer_and_bad_knob(self, tmp_path):
+        from tools.sstlint.project import EscapeHatch
+        write(tmp_path, "pkg/config.py", CONFIG_FIXTURE)
+        write(tmp_path, "tests/test_f.py",
+              "def test_other():\n    pass\n")
+        proj = make_project(tmp_path, escape_hatches=(
+            EscapeHatch("a", "fusion", "tests/test_f.py::test_gone"),
+            EscapeHatch("b", "fusion", "tests/test_missing.py::test_x"),
+            EscapeHatch("c", "not_a_knob", "tests/test_f.py::test_other"),
+        ))
+        r = lint(proj, ["escape-hatch-untested"])
+        keys = {f["key"] for f in rule_hits(r, "escape-hatch-untested")}
+        assert any("a:test" in k for k in keys), keys
+        assert any("b:file" in k for k in keys)
+        assert any("c:knob" in k for k in keys)
+
+    def test_real_tree_hatches_resolve(self):
+        """Every registered hatch in the real project map points at a
+        parity test that exists (including the two the audit itself
+        surfaced: geometry_fixed and runlog_dir)."""
+        proj = Project.default(REPO)
+        names = {h.name for h in proj.escape_hatches}
+        assert {"fusion", "prefix_reuse", "chunk_loop",
+                "geometry_fixed", "runlog_dir"} <= names
+        r = run_lint(root=REPO, rules=["escape-hatch-untested",
+                                       "escape-hatch-unregistered"])
+        assert r["n_findings"] == 0, r["findings"]
+
+
+# ---------------------------------------------------------------------------
+# runtime key-flow recorder (SST_KEYCHECK)
+# ---------------------------------------------------------------------------
+
+
+class TestKeycheckRuntime:
+    def _recorder(self):
+        from spark_sklearn_tpu.utils.keycheck import KeyFlowRecorder
+        return KeyFlowRecorder()
+
+    def test_collision_detected_once_per_signature(self):
+        rec = self._recorder()
+        rec.note("s", ("a",), fields={"x": 1}, detail="first")
+        rec.note("s", ("a",), fields={"x": 2}, detail="second")
+        rec.note("s", ("a",), fields={"x": 2}, detail="repeat")
+        rep = rec.report()
+        assert len(rep["collisions"]) == 1, rep["collisions"]
+        col = rep["collisions"][0]
+        assert col["fields_a"] == {"x": 1}
+        assert col["fields_b"] == {"x": 2}
+
+    def test_same_fields_never_collide(self):
+        rec = self._recorder()
+        for _ in range(5):
+            rec.note("s", ("a",), fields={"x": 1})
+        assert not rec.report()["collisions"]
+        assert rec.report()["n_notes"] == 5
+        assert rec.keys("s") and len(rec.keys("s")) == 1
+
+    def test_fieldless_notes_record_without_collisions(self):
+        rec = self._recorder()
+        rec.note("s", ("a",))
+        rec.note("s", ("a",))
+        rec.note("s", ("b",))
+        rep = rec.report()
+        assert not rep["collisions"]
+        assert len(rec.keys("s")) == 2
+
+    def test_distinct_keys_no_collision_and_reset(self):
+        rec = self._recorder()
+        rec.note("s", ("a",), fields={"x": 1})
+        rec.note("s", ("b",), fields={"x": 2})
+        assert not rec.report()["collisions"]
+        rec.reset()
+        rep = rec.report()
+        assert rep["n_notes"] == 0 and rep["n_keys"] == 0
+
+    def test_note_is_env_gated(self, monkeypatch):
+        from spark_sklearn_tpu.utils import keycheck
+        rec = keycheck.get_recorder()
+        rec.reset()
+        monkeypatch.delenv("SST_KEYCHECK", raising=False)
+        keycheck.note("s", ("off",), fields={"x": 1})
+        assert rec.report()["n_notes"] == 0
+        monkeypatch.setenv("SST_KEYCHECK", "1")
+        keycheck.note("s", ("on",), fields={"x": 1})
+        assert rec.report()["n_notes"] == 1
+        rec.reset()
+
+    def test_seeded_collision_fails_pytest_session(self, tmp_path):
+        """The conftest hook: a green test that recorded a key
+        collision under SST_KEYCHECK=1 must flip the session red."""
+        import uuid
+        seed = REPO / "tests" / \
+            f"test_keycheck_seed_{uuid.uuid4().hex[:8]}.py"
+        seed.write_text(
+            "from spark_sklearn_tpu.utils import keycheck\n"
+            "def test_seeded_collision():\n"
+            "    keycheck.note('program_cache', ('k',),\n"
+            "                  fields={'bf16': False})\n"
+            "    keycheck.note('program_cache', ('k',),\n"
+            "                  fields={'bf16': True})\n")
+        env = dict(__import__("os").environ, SST_KEYCHECK="1",
+                   JAX_PLATFORMS="cpu")
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "pytest", str(seed), "-q",
+                 "-p", "no:cacheprovider"],
+                capture_output=True, text=True, env=env,
+                cwd=str(REPO), timeout=300)
+        finally:
+            seed.unlink()
+        assert out.returncode == 1, out.stdout[-2000:] + out.stderr[-500:]
+        assert "COLLISION" in out.stdout, out.stdout[-2000:]
+        assert "1 passed" in out.stdout, out.stdout[-2000:]
+
+    def test_engine_keys_clean_and_knob_toggles_key(self):
+        """End-to-end: two real compiled searches under SST_KEYCHECK=1
+        — zero collisions, every expected surface reports, and
+        toggling a declared key-feeding knob (bf16_matmul) changes the
+        recorded program-cache AND checkpoint key sets."""
+        code = (
+            "import os\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from sklearn.linear_model import LogisticRegression\n"
+            "import spark_sklearn_tpu as sst\n"
+            "from spark_sklearn_tpu.utils import keycheck\n"
+            "X = np.random.RandomState(0).randn(64, 4)"
+            ".astype(np.float32)\n"
+            "y = (X[:, 0] > 0).astype(np.int64)\n"
+            "rec = keycheck.get_recorder()\n"
+            "keysets = {}\n"
+            "for bf16 in (False, True):\n"
+            "    rec.reset()\n"
+            "    cfg = sst.TpuConfig(bf16_matmul=bf16,\n"
+            "        checkpoint_dir=f'/tmp/kc_ckpt_{os.getpid()}_"
+            "{int(bf16)}')\n"
+            "    sst.GridSearchCV(LogisticRegression(max_iter=5),\n"
+            "        {'C': [0.1, 1.0]}, cv=2, refit=False,\n"
+            "        backend='tpu', config=cfg).fit(X, y)\n"
+            "    rep = rec.report()\n"
+            "    assert not rep['collisions'], rep['collisions']\n"
+            "    assert rep['n_notes'] > 0\n"
+            "    keysets[bf16] = {\n"
+            "        s: rec.keys(s) for s in ('program_cache',\n"
+            "                                 'checkpoint',\n"
+            "                                 'plan_key')}\n"
+            "for s in ('program_cache', 'checkpoint', 'plan_key'):\n"
+            "    assert keysets[False][s], s + ' recorded no keys'\n"
+            "for s in ('program_cache', 'checkpoint'):\n"
+            "    assert keysets[False][s] != keysets[True][s], (\n"
+            "        s + ' key set identical across bf16 toggle')\n"
+            "print('SURFACES',\n"
+            "      sorted(k for k, v in keysets[False].items() if v))\n")
+        env = dict(__import__("os").environ,
+                   SST_KEYCHECK="1", JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, env=env,
+                             cwd=str(REPO), timeout=540)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert "SURFACES" in out.stdout

@@ -16,6 +16,14 @@ the rule docstrings by ``dev/build_api_docs.py``):
     every ``SST_*`` env var config-backed + in the README knob table;
   - **jit purity**: no clocks, host RNG, uploads, or in-place host
     mutation inside traced functions;
+  - **key flow**: every traced input provably reaches its cache key
+    (declared surfaces in ``utils/keycheck.py``; closure dataflow,
+    store-vs-memory key consistency, dead key parts), paired with the
+    ``SST_KEYCHECK=1`` runtime key recorder;
+  - **journal formats**: every durable checkpoint/WAL record kind
+    declared + versioned + decodable in ``utils/journalspec.py``;
+  - **escape hatches**: every byte-parity claim registered with a
+    resolving parity test;
   - **repo hygiene**: no committed bytecode, ``.gitignore`` coverage.
 
 Usage::
@@ -44,6 +52,9 @@ from tools.sstlint.project import Project
 
 # rule modules register themselves on import
 from tools.sstlint import excepts as _excepts          # noqa: F401
+from tools.sstlint import hatches as _hatches          # noqa: F401
+from tools.sstlint import journalrules as _journal     # noqa: F401
+from tools.sstlint import keyflow as _keyflow          # noqa: F401
 from tools.sstlint import knobs as _knobs              # noqa: F401
 from tools.sstlint import lockorder as _lockorder      # noqa: F401
 from tools.sstlint import purity as _purity            # noqa: F401

@@ -1,0 +1,134 @@
+"""Escape-hatch audit — every byte-parity claim names its pinning test.
+
+The engine's README and docstrings make strong promises: fusion off
+"reproduces the pre-fusion engine exactly", heartbeat off is an "exact
+no-op", pipeline depth 0 is "bit-for-bit" the synchronous order.  A
+parity claim without a parity test is marketing, and a claim whose
+test was renamed away is worse — it *looks* pinned.  The project map
+registers every such hatch (:class:`~tools.sstlint.project.EscapeHatch`)
+with its ``tests/...::test_name`` pointer; these rules audit both
+directions: claims without a registration, and registrations whose
+knob or test no longer resolves.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from tools.sstlint.core import Context, Finding, rule
+
+#: a documentation line makes a byte-parity claim when it uses the
+#: project's parity vocabulary
+_CLAIM_RE = re.compile(
+    r"exact no-?op|byte-?identical|bit-?exact|bit-?for-?bit"
+    r"|escape hatch", re.IGNORECASE)
+
+#: backticked knob tokens on a claim line: `fusion`, `pipeline_depth=0`
+_TOKEN_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_]*)(?:=[^`]*)?`")
+
+
+def _config_field_names(ctx: Context) -> Optional[Set[str]]:
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name == "TpuConfig":
+                return {n.target.id for n in node.body
+                        if isinstance(n, ast.AnnAssign)
+                        and isinstance(n.target, ast.Name)}
+    return None
+
+
+def _claim_lines(ctx: Context) -> List[Tuple[str, int, str]]:
+    """(path, lineno, text) of every parity-claim line in the README
+    and in module docstrings — the two places the engine documents its
+    escape hatches."""
+    out: List[Tuple[str, int, str]] = []
+    readme = getattr(ctx.project, "readme", None)
+    if readme and readme.is_file():
+        for i, line in enumerate(
+                readme.read_text().splitlines(), start=1):
+            if _CLAIM_RE.search(line):
+                out.append((readme.name, i, line))
+    for mod in ctx.modules:
+        doc_node = None
+        if mod.tree.body and isinstance(mod.tree.body[0], ast.Expr) \
+                and isinstance(mod.tree.body[0].value, ast.Constant) \
+                and isinstance(mod.tree.body[0].value.value, str):
+            doc_node = mod.tree.body[0].value
+        if doc_node is None:
+            continue
+        for off, line in enumerate(doc_node.value.splitlines()):
+            if _CLAIM_RE.search(line):
+                out.append((mod.relpath, doc_node.lineno + off, line))
+    return out
+
+
+@rule("escape-hatch-unregistered")
+def check_escape_hatch_claims(ctx: Context) -> Iterable[Finding]:
+    """Every README/docstring line claiming a knob is an "exact
+    no-op"/"byte-identical" escape hatch must name a knob registered in
+    the project map's ``escape_hatches`` — a registration carries the
+    parity-test pointer that makes the claim checkable, so an
+    unregistered claim is a promise nothing pins."""
+    fields = _config_field_names(ctx)
+    if fields is None:
+        return
+    registered = {h.knob for h in
+                  getattr(ctx.project, "escape_hatches", ())}
+    for path, lineno, text in _claim_lines(ctx):
+        mod = ctx.module(path)
+        if mod is not None and mod.suppressed(
+                "escape-hatch-unregistered", lineno):
+            continue
+        # only claim lines ANCHORED to a real config knob are audited;
+        # prose about the general philosophy has no knob to register
+        knobs = {t for t in _TOKEN_RE.findall(text) if t in fields}
+        for knob in sorted(knobs - registered):
+            yield Finding(
+                "escape-hatch-unregistered", path, lineno,
+                f"parity claim about `{knob}` is not registered in "
+                "the project map's escape_hatches — register it with "
+                "its pinning parity test",
+                symbol=f"{knob}:{path}")
+
+
+@rule("escape-hatch-untested")
+def check_escape_hatch_tests(ctx: Context) -> Iterable[Finding]:
+    """Every registered escape hatch must point at a parity test that
+    still resolves (file exists, test function defined) and at a real
+    ``TpuConfig`` knob — a dangling pointer means the byte-parity
+    promise is no longer pinned by anything that runs."""
+    hatches = getattr(ctx.project, "escape_hatches", ())
+    if not hatches:
+        return
+    fields = _config_field_names(ctx)
+    root = ctx.project.root
+    for hatch in hatches:
+        if fields is not None and hatch.knob not in fields:
+            yield Finding(
+                "escape-hatch-untested", "tools/sstlint/project.py", 1,
+                f"escape hatch {hatch.name!r} registers knob "
+                f"{hatch.knob!r}, which is not a TpuConfig field",
+                symbol=f"{hatch.name}:knob")
+            continue
+        pointer = hatch.parity_test
+        relfile, sep, test_name = pointer.partition("::")
+        test_path = root / relfile
+        if not sep or not test_name or not test_path.is_file():
+            yield Finding(
+                "escape-hatch-untested", relfile or pointer, 1,
+                f"escape hatch {hatch.name!r} points at parity test "
+                f"{pointer!r}, whose file does not resolve",
+                symbol=f"{hatch.name}:file")
+            continue
+        if not re.search(
+                rf"^\s*def {re.escape(test_name)}\(",
+                test_path.read_text(), re.MULTILINE):
+            yield Finding(
+                "escape-hatch-untested", relfile, 1,
+                f"escape hatch {hatch.name!r} points at parity test "
+                f"{pointer!r}, but {relfile} defines no such test — "
+                "the byte-parity claim is unpinned",
+                symbol=f"{hatch.name}:test")

@@ -1,0 +1,182 @@
+"""Journal-format rules — every durable record kind is declared once.
+
+The checkpoint journal and the service WAL are formats a dead process
+leaves behind for a future one, so an undeclared record kind is a
+resume-time surprise waiting in a file nobody can re-run.  The
+vocabulary lives in ``utils/journalspec.py`` (loaded import-light
+here); these rules hold the write sites to it in both directions:
+
+  - ``journal-format``: a ``put_meta``/``ServiceJournal.append`` call
+    whose kind literal the registry does not declare;
+  - ``journal-decoder-missing``: a declared kind without a versioned
+    back-compat decoder, or a declared kind no write site produces
+    (dead registry entries rot into wrong documentation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from tools.sstlint import astutil
+from tools.sstlint.core import Context, Finding, ModuleInfo, rule
+
+
+def _load_spec(ctx: Context):
+    path = getattr(ctx.project, "journalspec_path", None)
+    if not path or not path.is_file():
+        return None
+    return astutil.load_module_by_path(path, "sstlint_journalspec")
+
+
+def _kind_literal(arg: ast.AST) -> Tuple[Optional[str], bool]:
+    """The statically-known record kind of a write call's first arg:
+    ``(kind, is_prefix)``.  A plain literal is exact; an f-string
+    contributes its leading constant prefix (``f"prefix:{fp}"`` ->
+    ``("prefix:", True)``); anything else is unresolvable ``(None,
+    False)`` — runtime validation covers dynamic kinds."""
+    lit = astutil.literal_str(arg)
+    if lit is not None:
+        return lit, False
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and \
+                isinstance(head.value, str) and head.value:
+            return head.value, True
+    return None, False
+
+
+def _meta_write_calls(
+        mod: ModuleInfo) -> List[Tuple[ast.Call, str, bool]]:
+    """Every ``put_meta(kind, ...)`` call with a resolvable kind."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (astutil.call_name(node) or "").split(".")[-1]
+        if name != "put_meta" or not node.args:
+            continue
+        kind, is_prefix = _kind_literal(node.args[0])
+        if kind is not None:
+            out.append((node, kind, is_prefix))
+    return out
+
+
+def _service_append_calls(
+        mod: ModuleInfo) -> List[Tuple[ast.Call, str]]:
+    """Every two-argument ``<journal>.append("<kind>", record)`` call —
+    the arity plus the literal first argument distinguish the WAL's
+    append from ``list.append``."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (astutil.call_name(node) or "").split(".")[-1]
+        if name != "append" or len(node.args) != 2:
+            continue
+        kind = astutil.literal_str(node.args[0])
+        if kind is not None:
+            out.append((node, kind))
+    return out
+
+
+def _meta_declared(spec, kind: str, is_prefix: bool) -> bool:
+    kinds: Dict[str, Dict[str, Any]] = spec.CHECKPOINT_META_KINDS
+    entry = kinds.get(kind)
+    if entry is not None:
+        # an f-string head that exactly names a non-prefix kind still
+        # produces dynamic variants the registry does not declare
+        return entry["prefix_match"] or not is_prefix
+    return any(s["prefix_match"] and kind.startswith(k)
+               for k, s in kinds.items())
+
+
+@rule("journal-format")
+def check_journal_format(ctx: Context) -> Iterable[Finding]:
+    """Every checkpoint ``put_meta`` kind and every service-WAL
+    ``append`` kind must be declared in ``utils/journalspec.py`` — an
+    undeclared kind is a durable record with no owner, no version and
+    no decoder, i.e. format drift that surfaces as a resume-time
+    surprise instead of a lint finding."""
+    spec = _load_spec(ctx)
+    if spec is None:
+        return
+    for mod in ctx.modules:
+        for call, kind, is_prefix in _meta_write_calls(mod):
+            if _meta_declared(spec, kind, is_prefix):
+                continue
+            if mod.suppressed("journal-format", call.lineno):
+                continue
+            shown = f"{kind}<...>" if is_prefix else kind
+            yield Finding(
+                "journal-format", mod.relpath, call.lineno,
+                f"put_meta kind {shown!r} is not declared in "
+                "CHECKPOINT_META_KINDS (utils/journalspec.py) — add a "
+                "versioned entry with a back-compat decoder",
+                symbol=f"meta:{kind}")
+        for call, kind in _service_append_calls(mod):
+            if kind in spec.SERVICE_RECORD_KINDS:
+                continue
+            if mod.suppressed("journal-format", call.lineno):
+                continue
+            yield Finding(
+                "journal-format", mod.relpath, call.lineno,
+                f"service-journal record kind {kind!r} is not declared "
+                "in SERVICE_RECORD_KINDS (utils/journalspec.py)",
+                symbol=f"service:{kind}")
+
+
+@rule("journal-decoder-missing")
+def check_journal_decoders(ctx: Context) -> Iterable[Finding]:
+    """Every declared journal record kind needs an int format version
+    and a callable back-compat decoder — and a write site that actually
+    produces it: a version-less kind cannot evolve safely, and a dead
+    registry entry documents a record no journal contains."""
+    spec = _load_spec(ctx)
+    if spec is None:
+        return
+    rel = "utils/journalspec.py"
+    tables = (
+        ("CHECKPOINT_RECORD_KINDS", spec.CHECKPOINT_RECORD_KINDS),
+        ("CHECKPOINT_META_KINDS", spec.CHECKPOINT_META_KINDS),
+        ("SERVICE_RECORD_KINDS", spec.SERVICE_RECORD_KINDS),
+    )
+    for table_name, table in tables:
+        for kind, entry in table.items():
+            if not isinstance(entry.get("version"), int):
+                yield Finding(
+                    "journal-decoder-missing", rel, 1,
+                    f"{table_name}[{kind!r}] has no int format "
+                    "version",
+                    symbol=f"{table_name}:{kind}:version")
+            if not callable(entry.get("decode")):
+                yield Finding(
+                    "journal-decoder-missing", rel, 1,
+                    f"{table_name}[{kind!r}] has no callable "
+                    "back-compat decoder",
+                    symbol=f"{table_name}:{kind}:decode")
+    meta_written: List[Tuple[str, bool]] = []
+    service_written: List[str] = []
+    for mod in ctx.modules:
+        meta_written.extend(
+            (k, p) for _, k, p in _meta_write_calls(mod))
+        service_written.extend(k for _, k in _service_append_calls(mod))
+    for kind, entry in spec.CHECKPOINT_META_KINDS.items():
+        if entry["prefix_match"]:
+            produced = any(w.startswith(kind) for w, _ in meta_written)
+        else:
+            produced = any(w == kind and not p
+                           for w, p in meta_written)
+        if not produced:
+            yield Finding(
+                "journal-decoder-missing", rel, 1,
+                f"declared meta kind {kind!r} has no put_meta write "
+                "site in the tree — dead registry entry",
+                symbol=f"meta-dead:{kind}")
+    for kind in spec.SERVICE_RECORD_KINDS:
+        if kind not in service_written:
+            yield Finding(
+                "journal-decoder-missing", rel, 1,
+                f"declared service record kind {kind!r} has no append "
+                "write site in the tree — dead registry entry",
+                symbol=f"service-dead:{kind}")

@@ -1,0 +1,461 @@
+"""Key-flow analysis — prove every traced input reaches its cache key.
+
+The engine's bit-exactness contract rests on one invariant nobody
+checked mechanically before these rules: *everything that influences a
+traced program must join the key that caches it*.  The cache-key
+surfaces are declared once, in ``utils/keycheck.py``'s
+``KEY_SURFACES`` (loaded import-light here); each rule holds the code
+to that registry from a different direction:
+
+  - ``key-part-missing``: a declared key-feeding field absent from the
+    surface's key expressions; a config read reachable from a traced
+    closure that does not flow into the paired key; or a store-key
+    identifier with no in-memory-key counterpart;
+  - ``key-part-dead``: a ``config.*`` key part the registry does not
+    declare — dead weight or an undocumented dependency, both worth a
+    finding;
+  - ``key-surface-unregistered``: registry hygiene (stale relpaths/
+    anchors/fields) and cache-key construction sites outside any
+    registered surface;
+  - ``keycheck-note-missing``: every surface must report to the
+    ``SST_KEYCHECK=1`` runtime recorder, or the static pass has no
+    runtime twin to catch what it cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.sstlint import astutil
+from tools.sstlint.core import Context, Finding, ModuleInfo, rule
+
+#: resolved-closure BFS bound: deep enough for grid's
+#: build -> jit(fn) -> helper chains, finite under name cycles
+_CLOSURE_DEPTH = 5
+
+
+def _load_surfaces(ctx: Context) -> Optional[Dict[str, Dict[str, Any]]]:
+    """The project's KEY_SURFACES registry, or None when the project
+    declares no keycheck module (fixture trees opt in by path)."""
+    path = getattr(ctx.project, "keycheck_path", None)
+    if not path or not path.is_file():
+        return None
+    mod = astutil.load_module_by_path(path, "sstlint_keycheck_registry")
+    surfaces = getattr(mod, "KEY_SURFACES", None)
+    if not isinstance(surfaces, dict):
+        return None
+    return surfaces
+
+
+def _config_field_names(ctx: Context) -> Optional[Set[str]]:
+    """TpuConfig's field names, or None when no config class is in the
+    tree (fixture packages without one skip the field-existence
+    checks)."""
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name == "TpuConfig":
+                return {n.target.id for n in node.body
+                        if isinstance(n, ast.AnnAssign)
+                        and isinstance(n.target, ast.Name)}
+    return None
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _scope_of(mod: ModuleInfo, node: ast.AST) -> Optional[ast.AST]:
+    """The function/lambda scope enclosing ``node`` (None = module)."""
+    cur = mod.parents.get(node)
+    while cur is not None and not isinstance(cur, _SCOPE_NODES):
+        cur = mod.parents.get(cur)
+    return cur
+
+
+def _scope_chain(mod: ModuleInfo, node: ast.AST) -> List[Any]:
+    """Scopes visible from ``node``, innermost first, module (None)
+    last — names must resolve lexically or closures nested in
+    different builders that reuse helper names (``one_task``,
+    ``one_fold``) contaminate each other's dataflow."""
+    chain: List[Any] = []
+    s = node if isinstance(node, _SCOPE_NODES) else _scope_of(mod, node)
+    while s is not None:
+        chain.append(s)
+        s = _scope_of(mod, s)
+    chain.append(None)
+    return chain
+
+
+def _scope_index(mod: ModuleInfo) -> Tuple[Dict, Dict]:
+    """Per-scope name bindings: function defs and single-target
+    assignments (``score_batch = wide if all_cores else nested``),
+    keyed by ``id(scope)`` (module scope is ``None``)."""
+    defs: Dict[int, Dict[str, List[ast.AST]]] = {}
+    aliases: Dict[int, Dict[str, ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            s = _scope_of(mod, node)
+            defs.setdefault(id(s), {}).setdefault(
+                node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            s = _scope_of(mod, node)
+            aliases.setdefault(id(s), {})[node.targets[0].id] = \
+                node.value
+    return defs, aliases
+
+
+def _resolve(index: Tuple[Dict, Dict], name: str,
+             chain: List[Any], depth: int = 0) -> List[ast.AST]:
+    """The def(s) ``name`` can lexically refer to from ``chain`` —
+    the innermost binding shadows outer ones; alias assignments
+    resolve their referenced names from the binding scope outward."""
+    if depth > 3:
+        return []
+    defs, aliases = index
+    for i, s in enumerate(chain):
+        bound_defs = defs.get(id(s), {}).get(name)
+        alias = aliases.get(id(s), {}).get(name)
+        if bound_defs is None and alias is None:
+            continue
+        out: List[ast.AST] = list(bound_defs or ())
+        if alias is not None:
+            for ref in ast.walk(alias):
+                if isinstance(ref, ast.Name) and ref.id != name:
+                    out.extend(_resolve(index, ref.id, chain[i:],
+                                        depth + 1))
+        return out
+    return []
+
+
+def _config_reads(node: ast.AST) -> Set[str]:
+    """``config.<field>`` attribute reads inside ``node`` (the
+    conventional config receiver name; fixture packages follow it)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and \
+                n.value.id == "config":
+            out.add(n.attr)
+    return out
+
+
+def _closure_config_reads(mod: ModuleInfo, build: ast.AST,
+                          index: Tuple[Dict, Dict]) -> Set[str]:
+    """Config reads reachable from a build callable: resolve
+    ``lambda: jax.jit(<fn>, ...)`` / bare function references to their
+    defs (lexically, via :func:`_resolve`) and BFS same-module callees
+    collecting ``config.*`` reads from bodies AND default arguments
+    (where grid threads ``__bf16__`` into the traced statics).
+    Unresolvable references — e.g. a ``fused_body`` pulled out of
+    another program dict — are skipped: the runtime twin covers what
+    static resolution cannot see."""
+    frontier: List[Tuple[ast.AST, int]] = []
+    if isinstance(build, ast.Lambda):
+        frontier.append((build, 0))
+    elif isinstance(build, ast.Name):
+        for d in _resolve(index, build.id, _scope_chain(mod, build)):
+            frontier.append((d, 0))
+    reads: Set[str] = set()
+    seen: Set[int] = set()
+    while frontier:
+        node, depth = frontier.pop()
+        if id(node) in seen or depth > _CLOSURE_DEPTH:
+            continue
+        seen.add(id(node))
+        reads |= _config_reads(node)
+        chain = _scope_chain(mod, node)
+        for ref in ast.walk(node):
+            if isinstance(ref, ast.Name):
+                for d in _resolve(index, ref.id, chain):
+                    if id(d) not in seen:
+                        frontier.append((d, depth + 1))
+    return reads
+
+
+def _names_in(node: ast.AST, skip_call_funcs: bool = True) -> Set[str]:
+    """Identifier tokens of a key/store-parts expression: bare Names
+    plus the base Name of attribute chains (``family.name`` counts as
+    ``family``), excluding names used purely as call targets
+    (``bool``, ``repr``, ...)."""
+    func_heads: Set[int] = set()
+    if skip_call_funcs:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                head = n.func
+                while isinstance(head, ast.Attribute):
+                    head = head.value
+                func_heads.add(id(head))
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and id(n) not in func_heads:
+            out.add(n.id)
+    return out
+
+
+def _is_none(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _cached_program_calls(mod: ModuleInfo) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node) or ""
+            if name.split(".")[-1] == "_cached_program":
+                out.append(node)
+    return out
+
+
+def _call_kwarg(call: ast.Call, name: str,
+                pos: Optional[int] = None) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _anchor_scopes(mod: ModuleInfo, surface: Dict[str, Any],
+                   name: str) -> List[ast.AST]:
+    """The AST regions a surface's key parts live in: for the
+    program-cache surface, the key argument of every
+    ``_cached_program`` call; otherwise the args of every call to the
+    anchor plus the body of a same-named def (whichever exist)."""
+    anchor = surface["anchor"]
+    scopes: List[ast.AST] = []
+    if name == "program_cache" or (surface.get("dataflow")
+                                   and anchor == "_cached_program"):
+        for call in _cached_program_calls(mod):
+            if call.args:
+                scopes.append(call.args[0])
+        return scopes
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            cname = astutil.call_name(node) or ""
+            if cname.split(".")[-1] == anchor:
+                scopes.extend(node.args)
+                scopes.extend(kw.value for kw in node.keywords)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == anchor:
+            scopes.append(node)
+    return scopes
+
+
+def _field_present(scopes: List[ast.AST], field: str,
+                   token: str) -> bool:
+    """Does the declared field reach the key expressions — as a
+    ``<x>.<field>`` attribute, its local carrier token, or a same-named
+    keyword argument?"""
+    for scope in scopes:
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Attribute) and n.attr == field:
+                return True
+            if isinstance(n, ast.Name) and n.id == token:
+                return True
+            if isinstance(n, ast.keyword) and n.arg == field:
+                return True
+    return False
+
+
+@rule("key-part-missing")
+def check_key_part_missing(ctx: Context) -> Iterable[Finding]:
+    """Every input that can alter a traced/cached artifact must flow
+    into the key that caches it: a declared key-feeding field absent
+    from its surface's key expressions, a ``config.*`` read reachable
+    from a program-cache build closure that the paired key omits, or a
+    store-key identifier with no in-memory-key counterpart is exactly
+    the aliasing bug class PRs 15/17/19 each fixed by hand."""
+    surfaces = _load_surfaces(ctx)
+    if not surfaces:
+        return
+    for name, spec in surfaces.items():
+        mod = ctx.module(spec["relpath"])
+        if mod is None:
+            continue            # key-surface-unregistered reports this
+        tokens = spec.get("key_tokens", {})
+        scopes = _anchor_scopes(mod, spec, name)
+        if not scopes:
+            continue
+        anchor_line = scopes[0].lineno if hasattr(
+            scopes[0], "lineno") else 1
+        for field in spec.get("config_fields", ()):
+            if _field_present(scopes, field, tokens.get(field, field)):
+                continue
+            if mod.suppressed("key-part-missing", anchor_line):
+                continue
+            yield Finding(
+                "key-part-missing", mod.relpath, anchor_line,
+                f"declared key-feeding field {field!r} of surface "
+                f"{name!r} does not reach any key expression at its "
+                f"anchor {spec['anchor']!r}",
+                symbol=f"{name}:{field}")
+        if not spec.get("dataflow"):
+            continue
+        index = _scope_index(mod)
+        aliases = spec.get("aliases", {})
+        for call in _cached_program_calls(mod):
+            if not call.args:
+                continue
+            key_expr = call.args[0]
+            key_names = _names_in(key_expr)
+            key_attr_reads = _config_reads(key_expr)
+            # (a) closure reads must be keyed
+            build = call.args[1] if len(call.args) > 1 else None
+            if build is not None:
+                for field in sorted(
+                        _closure_config_reads(mod, build, index)):
+                    tok = tokens.get(field, field)
+                    if field in key_attr_reads or tok in key_names:
+                        continue
+                    if mod.suppressed("key-part-missing", call.lineno):
+                        continue
+                    yield Finding(
+                        "key-part-missing", mod.relpath, call.lineno,
+                        f"config.{field} is read by the traced closure "
+                        f"of the {name!r} call at line {call.lineno} "
+                        "but does not flow into its cache key",
+                        symbol=f"{name}:closure:{field}:"
+                               f"{mod.qualname(call) or 'module'}")
+            # (b) store-parts identifiers must have in-memory twins
+            store_parts = _call_kwarg(call, "store_parts", pos=2)
+            if store_parts is None or _is_none(store_parts):
+                continue
+            for ident in sorted(_names_in(store_parts)):
+                twin = aliases.get(ident, ident)
+                if ident in key_names or twin in key_names:
+                    continue
+                if mod.suppressed("key-part-missing", call.lineno):
+                    continue
+                yield Finding(
+                    "key-part-missing", mod.relpath, call.lineno,
+                    f"store key part {ident!r} of the {name!r} call at "
+                    f"line {call.lineno} has no in-memory-key "
+                    "counterpart — the persistent and in-memory keys "
+                    "have drifted",
+                    symbol=f"{name}:store:{ident}:"
+                           f"{mod.qualname(call) or 'module'}")
+
+
+@rule("key-part-dead")
+def check_key_part_dead(ctx: Context) -> Iterable[Finding]:
+    """Every ``config.*`` token inside a key expression must be
+    declared in the surface's ``config_fields`` — the registry is the
+    single source of truth, so an undeclared key part is either dead
+    weight no traced path reads or a real dependency the declaration
+    (and its docs/runtime-twin coverage) silently omits."""
+    surfaces = _load_surfaces(ctx)
+    if not surfaces:
+        return
+    for name, spec in surfaces.items():
+        mod = ctx.module(spec["relpath"])
+        if mod is None:
+            continue
+        declared = set(spec.get("config_fields", ()))
+        for scope in _anchor_scopes(mod, spec, name):
+            for field in sorted(_config_reads(scope)):
+                if field in declared:
+                    continue
+                line = getattr(scope, "lineno", 1)
+                if mod.suppressed("key-part-dead", line):
+                    continue
+                yield Finding(
+                    "key-part-dead", mod.relpath, line,
+                    f"config.{field} joins a key expression of surface "
+                    f"{name!r} but is not declared in its "
+                    "config_fields — declare it (documenting the "
+                    "dependency) or drop the dead key part",
+                    symbol=f"{name}:{field}")
+
+
+@rule("key-surface-unregistered")
+def check_key_surface_registry(ctx: Context) -> Iterable[Finding]:
+    """The key-surface registry must match the tree: every registered
+    surface's module and anchor must exist, every declared field must
+    be a real ``TpuConfig`` field, and every ``_cached_program`` call
+    site must live in a module some registered surface covers — a new
+    cache-key construction site outside the registry would silently
+    escape the whole key-flow analysis."""
+    surfaces = _load_surfaces(ctx)
+    if surfaces is None:
+        return
+    config_fields = _config_field_names(ctx)
+    covered: Set[str] = set()
+    for name, spec in surfaces.items():
+        rel = spec["relpath"]
+        covered.add(rel)
+        mod = ctx.module(rel)
+        if mod is None:
+            yield Finding(
+                "key-surface-unregistered", rel, 1,
+                f"surface {name!r} is registered at {rel!r} but that "
+                "module is not in the linted tree",
+                symbol=f"{name}:relpath")
+            continue
+        anchor = spec["anchor"]
+        present = any(
+            (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and n.name == anchor)
+            or (isinstance(n, ast.Call)
+                and (astutil.call_name(n) or "").split(".")[-1]
+                == anchor)
+            for n in ast.walk(mod.tree))
+        if not present:
+            yield Finding(
+                "key-surface-unregistered", rel, 1,
+                f"surface {name!r} anchors on {anchor!r}, which {rel!r}"
+                " neither defines nor calls — the registry is stale",
+                symbol=f"{name}:anchor")
+        if config_fields is not None:
+            for field in spec.get("config_fields", ()):
+                if field not in config_fields:
+                    yield Finding(
+                        "key-surface-unregistered", rel, 1,
+                        f"surface {name!r} declares key-feeding field "
+                        f"{field!r}, which is not a TpuConfig field",
+                        symbol=f"{name}:field:{field}")
+    for mod in ctx.modules:
+        if mod.relpath in covered:
+            continue
+        for call in _cached_program_calls(mod):
+            if mod.suppressed("key-surface-unregistered", call.lineno):
+                continue
+            yield Finding(
+                "key-surface-unregistered", mod.relpath, call.lineno,
+                f"_cached_program call at line {call.lineno} is not "
+                "covered by any registered key surface — register the "
+                "module in KEY_SURFACES so key-flow analysis sees it",
+                symbol=f"callsite:{mod.qualname(call) or 'module'}:"
+                       f"{call.lineno}")
+
+
+@rule("keycheck-note-missing")
+def check_keycheck_notes(ctx: Context) -> Iterable[Finding]:
+    """Every registered key surface must call
+    ``keycheck.note("<surface>", ...)`` in its module — the
+    ``SST_KEYCHECK=1`` runtime recorder is the static pass's twin, and
+    a surface that never reports gives the collision/coverage checks a
+    blind spot exactly where the declared map claims coverage."""
+    surfaces = _load_surfaces(ctx)
+    if not surfaces:
+        return
+    for name, spec in surfaces.items():
+        mod = ctx.module(spec["relpath"])
+        if mod is None:
+            continue
+        noted = any(
+            isinstance(n, ast.Call)
+            and (astutil.call_name(n) or "").split(".")[-1] == "note"
+            and n.args
+            and astutil.literal_str(n.args[0]) == name
+            for n in ast.walk(mod.tree))
+        if noted:
+            continue
+        yield Finding(
+            "keycheck-note-missing", spec["relpath"], 1,
+            f"surface {name!r} never reports to the runtime key "
+            f"recorder: add keycheck.note({name!r}, <key>, ...) at its "
+            "key construction site",
+            symbol=name)

@@ -16,7 +16,8 @@ import dataclasses
 from pathlib import Path
 from typing import Dict, Optional, Sequence, Tuple
 
-__all__ = ["BlockSpec", "Producer", "Project", "SharedState"]
+__all__ = ["BlockSpec", "EscapeHatch", "Producer", "Project",
+           "SharedState"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +63,23 @@ class BlockSpec:
     producers: Tuple[Producer, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class EscapeHatch:
+    """One documented byte-parity escape hatch: a knob whose off/
+    default state is CLAIMED (README/docstrings) to reproduce the
+    pre-feature engine exactly.  The claim is only as good as the
+    parity test that pins it, so every registered hatch names one:
+    ``parity_test`` is ``"tests/test_x.py::test_name"`` and the
+    ``escape-hatch-untested`` rule fails when it stops resolving.
+    Claim lines in README/docstrings naming an unregistered knob are
+    ``escape-hatch-unregistered`` findings."""
+
+    name: str              # short registry name ("fusion", ...)
+    knob: str              # TpuConfig field the claim is about
+    parity_test: str       # "tests/test_x.py::test_name"
+    claim: str = ""        # what "off" is claimed to reproduce
+
+
 @dataclasses.dataclass
 class Project:
     """Paths + contract map for one lintable tree."""
@@ -72,6 +90,15 @@ class Project:
     docs_api: Optional[Path] = None
     metrics_path: Optional[Path] = None   # obs/metrics.py (import-light)
     spans_path: Optional[Path] = None     # obs/spans.py (import-light)
+    #: utils/keycheck.py — the cache-key surface registry + runtime
+    #: recorder the keyflow rules load import-light
+    keycheck_path: Optional[Path] = None
+    #: utils/journalspec.py — the versioned journal record registry
+    journalspec_path: Optional[Path] = None
+    #: tests/ dir escape-hatch parity-test pointers resolve against
+    tests_dir: Optional[Path] = None
+    #: every documented byte-parity escape hatch, with its pinning test
+    escape_hatches: Tuple["EscapeHatch", ...] = ()
     #: (lock-prefix, lock-prefix) pairs allowed to nest across modules
     allowed_cross_module: Tuple[Tuple[str, str], ...] = ()
     shared_state: Tuple[SharedState, ...] = ()
@@ -99,6 +126,96 @@ class Project:
             docs_api=root / "docs" / "API.md",
             metrics_path=pkg / "obs" / "metrics.py",
             spans_path=pkg / "obs" / "spans.py",
+            keycheck_path=pkg / "utils" / "keycheck.py",
+            journalspec_path=pkg / "utils" / "journalspec.py",
+            tests_dir=root / "tests",
+            escape_hatches=(
+                EscapeHatch(
+                    "fusion", "fusion",
+                    "tests/test_fusion.py::"
+                    "test_fusion_off_block_shape_and_parity",
+                    claim="`0` reproduces the pre-fusion engine "
+                          "exactly"),
+                EscapeHatch(
+                    "prefix_reuse", "prefix_reuse",
+                    "tests/test_prefix.py::"
+                    "test_shared_matches_atomic_exact",
+                    claim="`0` is the bit-exact atomic escape hatch"),
+                EscapeHatch(
+                    "heartbeat", "heartbeat",
+                    "tests/test_heartbeat.py::"
+                    "test_parity_and_cache_separation",
+                    claim="default off = exact no-op (key and traced "
+                          "program byte-identical)"),
+                EscapeHatch(
+                    "memory_ledger", "memory_ledger",
+                    "tests/test_memory.py::test_ledger_off_exact_noop",
+                    claim="False is the byte-identical pre-ledger "
+                          "escape hatch"),
+                EscapeHatch(
+                    "attribution", "attribution",
+                    "tests/test_doctor.py::"
+                    "test_attribution_off_is_absent_and_byte_identical",
+                    claim="attribution=False is a byte-identical "
+                          "escape hatch"),
+                EscapeHatch(
+                    "runlog", "runlog",
+                    "tests/test_doctor.py::"
+                    "test_runlog_off_never_touches_disk",
+                    claim="runlog=False is a byte-identical escape "
+                          "hatch"),
+                EscapeHatch(
+                    "service_journal", "service_journal_dir",
+                    "tests/test_service_journal.py::"
+                    "test_default_off_is_exact_noop",
+                    claim="unset = exact no-op (zero writes, zero "
+                          "reads)"),
+                EscapeHatch(
+                    "protection", "partial_results",
+                    "tests/test_protection.py::"
+                    "test_no_block_and_exact_when_off",
+                    claim="all-default = byte-identical "
+                          "protection-off escape hatch"),
+                EscapeHatch(
+                    "chunk_loop", "chunk_loop",
+                    "tests/test_chunkloop.py::"
+                    "test_scan_matches_per_chunk_exact",
+                    claim="per_chunk is the resumable/faultable "
+                          "baseline scan must match exactly"),
+                EscapeHatch(
+                    "pipeline_depth", "pipeline_depth",
+                    "tests/test_pipeline.py::test_family_matrix_parity",
+                    claim="0 = fully synchronous, bit-for-bit the "
+                          "pre-pipeline execution order"),
+                EscapeHatch(
+                    "fuse_fit_score", "fuse_fit_score",
+                    "tests/test_score_parity.py::"
+                    "test_logreg_multimetric_binary",
+                    claim="False restores separate fit/score launches "
+                          "everywhere"),
+                EscapeHatch(
+                    "sort_candidates", "sort_candidates",
+                    "tests/test_sorted_chunking.py::"
+                    "test_scores_match_and_iterations_drop",
+                    claim="False restores single-width unsorted "
+                          "chunking; same cv_results_ order either "
+                          "way"),
+                # surfaced by the escape-hatch audit itself: both were
+                # long-standing README/docstring parity claims with
+                # tests but no registration
+                EscapeHatch(
+                    "geometry_fixed", "geometry_mode",
+                    "tests/test_geometry.py::"
+                    "test_report_and_auto_vs_fixed_exact_parity",
+                    claim='"fixed" restores the legacy width rule '
+                          "bit-for-bit"),
+                EscapeHatch(
+                    "runlog_dir", "runlog_dir",
+                    "tests/test_doctor.py::"
+                    "test_runlog_off_never_touches_disk",
+                    claim="no configured directory = exact no-op (no "
+                          "store, no records, byte-identical reports)"),
+            ),
             allowed_cross_module=(),
             shared_state=(
                 # dataplane: process-wide transfer totals + the plane
@@ -323,6 +440,10 @@ class Project:
                 "SST_LOCKCHECK_HOLD_S": (
                     "tuning companion of SST_LOCKCHECK; same "
                     "pre-config lifetime"),
+                "SST_KEYCHECK": (
+                    "process-wide test-harness toggle (key-flow "
+                    "recorder twin of SST_LOCKCHECK); read per note() "
+                    "call, before any TpuConfig exists"),
             },
             exclude=(),
         )
